@@ -1,0 +1,43 @@
+"""hyperdrive_tpu — a TPU-native Byzantine fault tolerant consensus framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the reference
+Tendermint-BFT library ("The latest gossip on BFT consensus",
+arXiv:1807.04938; reference layout surveyed in SURVEY.md):
+
+- ``hyperdrive_tpu.process``   — the consensus state automaton (host-side).
+- ``hyperdrive_tpu.mq``        — per-sender (height, round)-sorted bounded queues.
+- ``hyperdrive_tpu.scheduler`` — deterministic proposer election.
+- ``hyperdrive_tpu.timer``     — linearly scaled timeout scheduling.
+- ``hyperdrive_tpu.replica``   — the replica driver / event loop.
+- ``hyperdrive_tpu.crypto``    — Ed25519 identity, signing, Shamir sharing (host).
+- ``hyperdrive_tpu.ops``       — TPU kernels: GF(2^255-19) limb arithmetic,
+  batched Ed25519 verification, quorum tallies, Shamir reconstruction.
+- ``hyperdrive_tpu.parallel``  — SPMD sharding of verification + tallies over
+  a ``jax.sharding.Mesh`` (ICI/DCN collectives).
+- ``hyperdrive_tpu.harness``   — deterministic in-process network simulator
+  with seeded record/replay and fault/Byzantine injection.
+
+The consensus control flow (branchy, per-message, tiny state) runs on the
+host; the TPU executes the batchable numeric work: vote signature
+verification, 2f+1 tallies, and Shamir share reconstruction, vectorized over
+validators x in-flight (height, round) pairs.
+"""
+
+from hyperdrive_tpu.types import (
+    DEFAULT_HEIGHT,
+    DEFAULT_ROUND,
+    INVALID_ROUND,
+    NIL_VALUE,
+    Step,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DEFAULT_HEIGHT",
+    "DEFAULT_ROUND",
+    "INVALID_ROUND",
+    "NIL_VALUE",
+    "Step",
+    "__version__",
+]

@@ -1,0 +1,150 @@
+"""Per-sender message queues sorted by (height, round), with bounded capacity.
+
+Capability parity with the reference's ``mq/mq.go``: every sender gets a
+dedicated queue kept in ascending (height, round) order (FIFO among equal
+keys), bounded at ``max_capacity`` messages to stop far-future flooding from
+exhausting memory; :meth:`MessageQueue.consume` drains everything at or below
+a height through per-type callbacks, applying a sender whitelist. Queues do
+no deduplication and are not safe for concurrent use (the replica serializes
+access).
+
+TPU extension: :meth:`MessageQueue.drain_window` pops up to ``window`` ready
+messages *without* dispatching them, so the replica can hand the whole window
+to the batched signature Verifier in one device launch and then feed the
+survivors to the Process in order — the "batched drain" of SURVEY.md §7.1(4).
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_right
+from typing import Callable, Iterable
+
+from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+from hyperdrive_tpu.types import Height, Signatory
+
+__all__ = ["MessageQueue", "DEFAULT_MAX_CAPACITY"]
+
+#: Default per-sender capacity (reference: mq/opt.go:19).
+DEFAULT_MAX_CAPACITY = 1000
+
+Message = Propose | Prevote | Precommit
+
+
+class MessageQueue:
+    """Sorted, bounded, per-sender buffering of consensus messages."""
+
+    __slots__ = ("max_capacity", "_queues")
+
+    def __init__(self, max_capacity: int = DEFAULT_MAX_CAPACITY):
+        self.max_capacity = int(max_capacity)
+        self._queues: dict[Signatory, list[Message]] = {}
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    # ------------------------------------------------------------------ insert
+
+    def insert_propose(self, propose: Propose) -> None:
+        """Assumes the sender was already authenticated and filtered
+        (reference: mq/mq.go:85-86)."""
+        self._insert(propose)
+
+    def insert_prevote(self, prevote: Prevote) -> None:
+        self._insert(prevote)
+
+    def insert_precommit(self, precommit: Precommit) -> None:
+        self._insert(precommit)
+
+    def _insert(self, msg: Message) -> None:
+        q = self._queues.setdefault(msg.sender, [])
+        # Insert after all entries with the same (height, round) so equal-key
+        # messages stay FIFO (reference: sort.Search semantics, mq/mq.go:117-127).
+        idx = bisect_right(q, (msg.height, msg.round), key=lambda m: (m.height, m.round))
+        q.insert(idx, msg)
+        # Drop the far-future tail when over capacity (reference: mq/mq.go:139-142).
+        if len(q) > self.max_capacity:
+            del q[self.max_capacity :]
+
+    # ----------------------------------------------------------------- consume
+
+    def consume(
+        self,
+        height: Height,
+        propose: Callable[[Propose], None],
+        prevote: Callable[[Prevote], None],
+        precommit: Callable[[Precommit], None],
+        procs_allowed: Iterable[Signatory],
+    ) -> int:
+        """Dispatch and drop every queued message with height <= ``height``.
+
+        Returns the number of messages *consumed* — including messages
+        dropped by the whitelist, which still count (reference: mq/mq.go:36-66
+        increments ``n`` before the whitelist check returns).
+        """
+        allowed = (
+            procs_allowed
+            if isinstance(procs_allowed, (set, frozenset, dict))
+            else set(procs_allowed)
+        )
+        # Two-phase drain: detach each sender's eligible prefix *before*
+        # dispatching it, so callbacks that reentrantly insert messages (a
+        # synchronous loopback broadcaster) cannot corrupt the iteration.
+        # The Go reference is immune only because broadcasts hop through a
+        # channel; the synchronous driving mode must be safe on its own.
+        n = 0
+        for sender in list(self._queues.keys()):
+            q = self._queues.get(sender)
+            if not q:
+                continue
+            i = 0
+            while i < len(q) and q[i].height <= height:
+                i += 1
+            if not i:
+                continue
+            batch = q[:i]
+            del q[:i]
+            n += len(batch)
+            if sender not in allowed:
+                continue
+            for msg in batch:
+                if isinstance(msg, Propose):
+                    propose(msg)
+                elif isinstance(msg, Prevote):
+                    prevote(msg)
+                else:
+                    precommit(msg)
+        return n
+
+    def drain_window(self, height: Height, window: int) -> list[Message]:
+        """Pop up to ``window`` messages with height <= ``height``, in
+        per-sender order, without dispatching them.
+
+        This is the wide input for the batched TPU Verifier: the caller
+        verifies the window as one launch and feeds survivors to the
+        Process. Whitelisting is the caller's job (it already is for
+        :meth:`consume`'s callback contract).
+        """
+        out: list[Message] = []
+        for _, q in self._queues.items():
+            remaining = window - len(out)
+            if remaining <= 0:
+                break
+            i = 0
+            while i < len(q) and i < remaining and q[i].height <= height:
+                i += 1
+            if i:
+                out.extend(q[:i])
+                del q[:i]
+        return out
+
+    # -------------------------------------------------------------------- drop
+
+    def drop_messages_below_height(self, height: Height) -> None:
+        """Forget everything below ``height`` (resync support; reference:
+        mq/mq.go:70-83)."""
+        for sender, q in self._queues.items():
+            i = 0
+            while i < len(q) and q[i].height < height:
+                i += 1
+            if i:
+                del q[:i]

@@ -1,0 +1,298 @@
+"""The Replica driver: an event loop around one consensus Process.
+
+Capability parity with the reference's ``replica/replica.go``: a Replica
+owns a :class:`~hyperdrive_tpu.process.Process` and a
+:class:`~hyperdrive_tpu.mq.MessageQueue`, computes ``f = n // 3`` from the
+signatory set, filters messages below the current height, whitelists
+senders, serializes all handling through a single inbox, flushes the queue
+into the Process until quiescent after every handled message, supports
+``reset_height`` for chain resync (including signatory-set rotation), and
+invokes a ``did_handle_message`` callback after each handled message (the
+harness uses it for lock-step backpressure).
+
+Two driving modes:
+
+- **Synchronous** (:meth:`Replica.handle`): the caller delivers one message
+  at a time on its own thread. This is what the deterministic harness and
+  the benchmarks use — it is the moral equivalent of the reference's
+  single-goroutine ``Run`` loop fed by a channel, with the channel hop
+  removed.
+- **Threaded** (:meth:`Replica.run`): a background thread drains a
+  ``queue.Queue`` inbox until a stop event fires, for production-style
+  integration (the analogue of ``Replica.Run`` + ``mch``,
+  replica/replica.go:88-151).
+
+TPU extension: when a ``verifier`` is supplied (see
+:mod:`hyperdrive_tpu.verifier`), queued votes are drained in wide windows
+and signature-checked in one batched device launch before the survivors are
+fed to the Process — the reference instead assumes the application
+authenticated everything upstream (process/process.go:95-98).
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from collections import deque
+from dataclasses import dataclass, replace
+from typing import Callable, Optional
+
+from hyperdrive_tpu.messages import Precommit, Prevote, Propose, Timeout
+from hyperdrive_tpu.mq import DEFAULT_MAX_CAPACITY, MessageQueue
+from hyperdrive_tpu.process import (
+    Broadcaster,
+    Catcher,
+    Committer,
+    Process,
+    Proposer,
+    Timer,
+    Validator,
+)
+from hyperdrive_tpu.scheduler import RoundRobin
+from hyperdrive_tpu.state import State
+from hyperdrive_tpu.types import DEFAULT_HEIGHT, Height, MessageType, Round, Signatory, Step
+
+__all__ = ["Replica", "ReplicaOptions", "ResetHeight"]
+
+
+@dataclass(frozen=True)
+class ReplicaOptions:
+    """Immutable functional options (reference: replica/opt.go:11-46).
+
+    ``verify_window`` sizes the batched drain handed to the Verifier; it is
+    a TPU-path tunable with no reference analogue.
+    """
+
+    starting_height: Height = DEFAULT_HEIGHT
+    max_capacity: int = DEFAULT_MAX_CAPACITY
+    verify_window: int = 1024
+
+    def with_starting_height(self, height: Height) -> "ReplicaOptions":
+        return replace(self, starting_height=height)
+
+    def with_max_capacity(self, capacity: int) -> "ReplicaOptions":
+        return replace(self, max_capacity=capacity)
+
+    def with_verify_window(self, window: int) -> "ReplicaOptions":
+        return replace(self, verify_window=window)
+
+
+@dataclass(frozen=True)
+class ResetHeight:
+    """Resync instruction: jump to ``height``, optionally rotating the
+    signatory set (reference: replica/replica.go:266-270)."""
+
+    height: Height
+    signatories: tuple[Signatory, ...] = ()
+
+
+class Replica:
+    """A replicated-state-machine participant."""
+
+    def __init__(
+        self,
+        opts: ReplicaOptions,
+        whoami: Signatory,
+        signatories: list[Signatory],
+        timer: Optional[Timer],
+        proposer: Optional[Proposer],
+        validator: Optional[Validator],
+        committer: Optional[Committer],
+        catcher: Optional[Catcher],
+        broadcaster: Optional[Broadcaster],
+        did_handle_message: Optional[Callable[[], None]] = None,
+        verifier=None,
+    ):
+        f = len(signatories) // 3
+        self.opts = opts
+        self.proc = Process(
+            whoami=whoami,
+            f=f,
+            timer=timer,
+            scheduler=RoundRobin(signatories),
+            proposer=proposer,
+            validator=validator,
+            broadcaster=broadcaster,
+            committer=committer,
+            catcher=catcher,
+            height=opts.starting_height,
+        )
+        self.procs_allowed: set[Signatory] = set(signatories)
+        self.mq = MessageQueue(max_capacity=opts.max_capacity)
+        self.did_handle_message = did_handle_message
+        self.verifier = verifier
+        self._inbox: _queue.Queue = _queue.Queue(maxsize=opts.max_capacity)
+        # Synchronous-mode reentrancy guard: a broadcaster wired straight
+        # back into handle() (loopback) must enqueue, not recurse — the
+        # moral equivalent of the reference's inbox channel hop.
+        self._handling = False
+        self._pending: deque = deque()
+
+    # ------------------------------------------------------------ sync driving
+
+    def start(self) -> None:
+        """Start the underlying Process (round 0 of the starting height)."""
+        self.proc.start()
+
+    def handle(self, msg) -> None:
+        """Synchronously handle one input message, then flush the queue.
+
+        Mirrors one iteration of the reference's Run loop
+        (replica/replica.go:104-148): timeouts dispatch straight into the
+        Process; votes are height-filtered and buffered; ResetHeight resets
+        state and optionally rotates the signatory set.
+
+        Reentrant calls (e.g. a loopback broadcaster invoked from inside the
+        Process) are buffered and drained by the outermost call, preserving
+        the reference's serialized-event-loop semantics.
+        """
+        self._pending.append(msg)
+        if self._handling:
+            return
+        self._handling = True
+        try:
+            while self._pending:
+                self._handle_one(self._pending.popleft())
+        finally:
+            self._handling = False
+
+    def _handle_one(self, msg) -> None:
+        try:
+            if isinstance(msg, Timeout):
+                if msg.message_type == MessageType.PROPOSE:
+                    self.proc.on_timeout_propose(msg.height, msg.round)
+                elif msg.message_type == MessageType.PREVOTE:
+                    self.proc.on_timeout_prevote(msg.height, msg.round)
+                elif msg.message_type == MessageType.PRECOMMIT:
+                    self.proc.on_timeout_precommit(msg.height, msg.round)
+                else:
+                    return
+            elif isinstance(msg, Propose):
+                if not self._filter_height(msg.height):
+                    return
+                self.mq.insert_propose(msg)
+            elif isinstance(msg, Prevote):
+                if not self._filter_height(msg.height):
+                    return
+                self.mq.insert_prevote(msg)
+            elif isinstance(msg, Precommit):
+                if not self._filter_height(msg.height):
+                    return
+                self.mq.insert_precommit(msg)
+            elif isinstance(msg, ResetHeight):
+                self.proc.state = State.default_with_height(msg.height)
+                self.mq.drop_messages_below_height(msg.height)
+                if msg.signatories:
+                    sigs = list(msg.signatories)
+                    self.proc.start_with_new_signatories(
+                        len(sigs) // 3, RoundRobin(sigs)
+                    )
+                    self.procs_allowed = set(sigs)
+            else:
+                return
+            self._flush()
+        finally:
+            if self.did_handle_message is not None:
+                self.did_handle_message()
+
+    def _flush(self) -> None:
+        """Drain the queue into the Process until quiescent
+        (reference: replica/replica.go:251-264).
+
+        With a Verifier installed, votes are drained in wide windows and
+        batch-verified before dispatch; without one, this is the reference's
+        synchronous consume loop.
+        """
+        if self.verifier is None:
+            while True:
+                n = self.mq.consume(
+                    self.proc.current_height,
+                    self.proc.propose,
+                    self.proc.prevote,
+                    self.proc.precommit,
+                    self.procs_allowed,
+                )
+                if n == 0:
+                    return
+        else:
+            while True:
+                window = self.mq.drain_window(
+                    self.proc.current_height, self.opts.verify_window
+                )
+                if not window:
+                    return
+                keep = self.verifier.verify_batch(window)
+                for msg, ok in zip(window, keep):
+                    if not ok or msg.sender not in self.procs_allowed:
+                        continue
+                    if isinstance(msg, Propose):
+                        self.proc.propose(msg)
+                    elif isinstance(msg, Prevote):
+                        self.proc.prevote(msg)
+                    else:
+                        self.proc.precommit(msg)
+
+    def _filter_height(self, height: Height) -> bool:
+        """Only current-or-future heights are kept
+        (reference: replica/replica.go:247-249)."""
+        return height >= self.proc.current_height
+
+    # -------------------------------------------------------- threaded driving
+
+    def run(self, stop: threading.Event) -> None:
+        """Drain the inbox until ``stop`` fires (the reference's Run loop,
+        replica/replica.go:88-151). Call from a dedicated thread."""
+        self.proc.start()
+        while not stop.is_set():
+            try:
+                msg = self._inbox.get(timeout=0.05)
+            except _queue.Empty:
+                continue
+            self.handle(msg)
+        # Match the reference: the callback also fires when the context is
+        # cancelled (replica/replica.go:16-18).
+        if self.did_handle_message is not None:
+            self.did_handle_message()
+
+    def _enqueue(self, msg, stop: Optional[threading.Event] = None) -> None:
+        while True:
+            try:
+                self._inbox.put(msg, timeout=0.05)
+                return
+            except _queue.Full:
+                if stop is not None and stop.is_set():
+                    return
+
+    def propose(self, propose: Propose, stop=None) -> None:
+        """Async insert (reference: replica/replica.go:156-161)."""
+        self._enqueue(propose, stop)
+
+    def prevote(self, prevote: Prevote, stop=None) -> None:
+        self._enqueue(prevote, stop)
+
+    def precommit(self, precommit: Precommit, stop=None) -> None:
+        self._enqueue(precommit, stop)
+
+    def timeout(self, timeout: Timeout, stop=None) -> None:
+        self._enqueue(timeout, stop)
+
+    def reset_height(
+        self, new_height: Height, signatories: list[Signatory] = (), stop=None
+    ) -> None:
+        """Jump a lagging replica to ``new_height`` (> current), dropping
+        stale queued messages (reference: replica/replica.go:222-235)."""
+        if new_height <= self.proc.current_height:
+            return
+        self._enqueue(ResetHeight(new_height, tuple(signatories)), stop)
+
+    # ------------------------------------------------------------- inspection
+
+    def current_state(self) -> tuple[Height, Round, Step]:
+        return (
+            self.proc.current_height,
+            self.proc.current_round,
+            self.proc.current_step,
+        )
+
+    def current_height(self) -> Height:
+        return self.proc.current_height

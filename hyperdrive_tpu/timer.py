@@ -1,0 +1,121 @@
+"""Timeout scheduling with linear round scaling.
+
+Capability parity with the reference's ``timer/timer.go``: a
+:class:`LinearTimer` schedules propose/prevote/precommit timeouts whose
+duration grows linearly with the round (``timeout * (1 + round * scaling)``),
+delivering a :class:`~hyperdrive_tpu.messages.Timeout` event to an injected
+handler when the deadline passes.
+
+Two implementations are provided:
+
+- :class:`LinearTimer` — wall-clock, one daemon ``threading.Timer`` per
+  scheduled timeout (the analogue of the reference's goroutine-per-timeout,
+  timer/timer.go:88-92). For production-style use.
+- :class:`VirtualTimer` — deterministic simulated time for the test/bench
+  harness: deadlines go into a heap owned by a
+  :class:`~hyperdrive_tpu.harness.sim.VirtualClock`; the simulator advances
+  time explicitly, so runs are reproducible and fast. This is this
+  framework's answer to the reference's real-sleep test timers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from hyperdrive_tpu.messages import Timeout
+from hyperdrive_tpu.types import Height, MessageType, Round
+
+__all__ = ["LinearTimer", "VirtualTimer", "DEFAULT_TIMEOUT", "DEFAULT_TIMEOUT_SCALING"]
+
+#: Default base timeout in seconds (reference: timer/opt.go:10-11).
+DEFAULT_TIMEOUT = 20.0
+#: Default linear scaling factor per round (reference: timer/opt.go:13-14).
+DEFAULT_TIMEOUT_SCALING = 0.5
+
+TimeoutHandler = Callable[[Timeout], None]
+
+
+class LinearTimer:
+    """Wall-clock timer: spawns a daemon thread per scheduled timeout."""
+
+    def __init__(
+        self,
+        handle_timeout_propose: Optional[TimeoutHandler] = None,
+        handle_timeout_prevote: Optional[TimeoutHandler] = None,
+        handle_timeout_precommit: Optional[TimeoutHandler] = None,
+        timeout: float = DEFAULT_TIMEOUT,
+        timeout_scaling: float = DEFAULT_TIMEOUT_SCALING,
+    ):
+        self._handle_propose = handle_timeout_propose
+        self._handle_prevote = handle_timeout_prevote
+        self._handle_precommit = handle_timeout_precommit
+        self.timeout = timeout
+        self.timeout_scaling = timeout_scaling
+
+    def duration_at(self, height: Height, round: Round) -> float:
+        """Timeout duration for a (height, round)
+        (reference: timer/timer.go:120-122)."""
+        return self.timeout + self.timeout * round * self.timeout_scaling
+
+    def _spawn(self, handler: TimeoutHandler, ty: MessageType, h: Height, r: Round):
+        t = threading.Timer(
+            self.duration_at(h, r),
+            handler,
+            args=(Timeout(message_type=ty, height=h, round=r),),
+        )
+        t.daemon = True
+        t.start()
+
+    def timeout_propose(self, height: Height, round: Round) -> None:
+        if self._handle_propose is not None:
+            self._spawn(self._handle_propose, MessageType.PROPOSE, height, round)
+
+    def timeout_prevote(self, height: Height, round: Round) -> None:
+        if self._handle_prevote is not None:
+            self._spawn(self._handle_prevote, MessageType.PREVOTE, height, round)
+
+    def timeout_precommit(self, height: Height, round: Round) -> None:
+        if self._handle_precommit is not None:
+            self._spawn(self._handle_precommit, MessageType.PRECOMMIT, height, round)
+
+
+class VirtualTimer:
+    """Simulated-time timer for the deterministic harness.
+
+    Schedules deadlines on a clock object exposing
+    ``schedule(delay: float, event: Timeout, handler) -> None``; the harness
+    decides when virtual time advances and then invokes ``handler(event)``
+    (or routes the event itself when ``handler`` is None).
+    """
+
+    def __init__(
+        self,
+        clock,
+        handler: Optional[TimeoutHandler] = None,
+        timeout: float = 1.0,
+        timeout_scaling: float = DEFAULT_TIMEOUT_SCALING,
+    ):
+        self._clock = clock
+        self._handler = handler
+        self.timeout = timeout
+        self.timeout_scaling = timeout_scaling
+
+    def duration_at(self, height: Height, round: Round) -> float:
+        return self.timeout + self.timeout * round * self.timeout_scaling
+
+    def _schedule(self, ty: MessageType, h: Height, r: Round) -> None:
+        self._clock.schedule(
+            self.duration_at(h, r),
+            Timeout(message_type=ty, height=h, round=r),
+            self._handler,
+        )
+
+    def timeout_propose(self, height: Height, round: Round) -> None:
+        self._schedule(MessageType.PROPOSE, height, round)
+
+    def timeout_prevote(self, height: Height, round: Round) -> None:
+        self._schedule(MessageType.PREVOTE, height, round)
+
+    def timeout_precommit(self, height: Height, round: Round) -> None:
+        self._schedule(MessageType.PRECOMMIT, height, round)

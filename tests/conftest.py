@@ -1,0 +1,25 @@
+"""Test configuration.
+
+JAX-based tests run on a virtual 8-device CPU platform so multi-chip
+sharding paths compile and execute without TPU hardware. The env vars must
+be set before the first ``import jax`` anywhere in the test process.
+"""
+
+import os
+import random
+
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+@pytest.fixture
+def rng() -> random.Random:
+    """Seeded RNG; override the seed with HYPERDRIVE_TEST_SEED for replay."""
+    seed = int(os.environ.get("HYPERDRIVE_TEST_SEED", "1337"))
+    return random.Random(seed)

@@ -1,0 +1,115 @@
+"""Codec: budget enforcement, roundtrips, fuzz-no-panic.
+
+Mirrors the reference's serde test contract (process/*_test.go): random
+byte blobs must error, never crash; undersized budgets must error;
+marshal->unmarshal must be the identity.
+"""
+
+import random
+
+import pytest
+
+from hyperdrive_tpu.codec import MAX_BYTES, Reader, SerdeError, Writer
+
+
+def test_scalar_roundtrip(rng):
+    for _ in range(200):
+        w = Writer()
+        u8 = rng.randint(0, 255)
+        u16 = rng.randint(0, 0xFFFF)
+        u32 = rng.randint(0, 0xFFFFFFFF)
+        u64 = rng.randint(0, (1 << 64) - 1)
+        i8 = rng.randint(-128, 127)
+        i64 = rng.randint(-(1 << 63), (1 << 63) - 1)
+        b32 = rng.randbytes(32)
+        raw = rng.randbytes(rng.randint(0, 64))
+        flag = rng.random() < 0.5
+        w.u8(u8); w.u16(u16); w.u32(u32); w.u64(u64)
+        w.i8(i8); w.i64(i64); w.bytes32(b32); w.raw(raw); w.bool(flag)
+        r = Reader(w.data())
+        assert r.u8() == u8
+        assert r.u16() == u16
+        assert r.u32() == u32
+        assert r.u64() == u64
+        assert r.i8() == i8
+        assert r.i64() == i64
+        assert r.bytes32() == b32
+        assert r.raw() == raw
+        assert r.bool() is flag
+        assert r.done()
+
+
+def test_write_budget_enforced():
+    w = Writer(rem=7)
+    with pytest.raises(SerdeError):
+        w.u64(1)
+    w = Writer(rem=8)
+    w.u64(1)  # exactly fits
+    with pytest.raises(SerdeError):
+        w.u8(1)
+
+
+def test_read_budget_enforced():
+    data = Writer()
+    data.u64(42)
+    r = Reader(data.data(), rem=7)
+    with pytest.raises(SerdeError):
+        r.u64()
+
+
+def test_read_underflow_raises():
+    r = Reader(b"\x01\x02")
+    with pytest.raises(SerdeError):
+        r.u32()
+
+
+def test_bad_bool_rejected():
+    r = Reader(b"\x02")
+    with pytest.raises(SerdeError):
+        r.bool()
+
+
+def test_raw_length_is_budgeted():
+    # A length prefix claiming 4GiB must die on the budget, not allocate.
+    w = Writer()
+    w.u32(0xFFFFFFFF)
+    r = Reader(w.data(), rem=1024)
+    with pytest.raises(SerdeError):
+        r.raw()
+
+
+def test_fuzz_never_crashes(rng):
+    for _ in range(500):
+        blob = rng.randbytes(rng.randint(0, 128))
+        r = Reader(blob, rem=256)
+        try:
+            while True:
+                op = rng.randint(0, 7)
+                if op == 0:
+                    r.u8()
+                elif op == 1:
+                    r.u64()
+                elif op == 2:
+                    r.i64()
+                elif op == 3:
+                    r.bytes32()
+                elif op == 4:
+                    r.raw()
+                elif op == 5:
+                    r.bool()
+                elif op == 6:
+                    r.u32()
+                else:
+                    r.u16()
+        except SerdeError:
+            pass  # errors are the contract; crashes are not
+
+
+def test_bytes32_wrong_length():
+    w = Writer()
+    with pytest.raises(SerdeError):
+        w.bytes32(b"\x00" * 31)
+
+
+def test_default_budget_is_bounded():
+    assert 0 < MAX_BYTES <= 64 * 1024 * 1024

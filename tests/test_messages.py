@@ -1,0 +1,108 @@
+"""Messages: roundtrips, digests, equality, fuzz-no-panic.
+
+Mirrors process/message_test.go's strategy: serde roundtrip equality,
+digest stability/distinctness, and random-blob unmarshal must error rather
+than crash.
+"""
+
+import pytest
+
+from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.messages import (
+    Precommit,
+    Prevote,
+    Propose,
+    Timeout,
+    marshal_message,
+    unmarshal_message,
+)
+from hyperdrive_tpu.testutil import random_precommit, random_prevote, random_propose
+from hyperdrive_tpu.types import MessageType
+
+
+def test_propose_roundtrip(rng):
+    for _ in range(100):
+        p = random_propose(rng)
+        w = Writer()
+        p.marshal(w)
+        q = Propose.unmarshal(Reader(w.data()))
+        assert p == q
+
+
+def test_prevote_roundtrip(rng):
+    for _ in range(100):
+        p = random_prevote(rng)
+        w = Writer()
+        p.marshal(w)
+        assert Prevote.unmarshal(Reader(w.data())) == p
+
+
+def test_precommit_roundtrip(rng):
+    for _ in range(100):
+        p = random_precommit(rng)
+        w = Writer()
+        p.marshal(w)
+        assert Precommit.unmarshal(Reader(w.data())) == p
+
+
+def test_timeout_roundtrip():
+    t = Timeout(message_type=MessageType.PREVOTE, height=7, round=3)
+    w = Writer()
+    t.marshal(w)
+    assert Timeout.unmarshal(Reader(w.data())) == t
+
+
+def test_tagged_roundtrip(rng):
+    msgs = [random_propose(rng), random_prevote(rng), random_precommit(rng),
+            Timeout(MessageType.PRECOMMIT, 1, 0)]
+    for m in msgs:
+        w = Writer()
+        marshal_message(m, w)
+        assert unmarshal_message(Reader(w.data())) == m
+
+
+def test_digest_excludes_sender(rng):
+    p = random_prevote(rng)
+    q = Prevote(height=p.height, round=p.round, value=p.value, sender=rng.randbytes(32))
+    assert p.digest() == q.digest()
+
+
+def test_digest_domain_separation():
+    pv = Prevote(height=1, round=0, value=b"\x01" * 32, sender=b"\x02" * 32)
+    pc = Precommit(height=1, round=0, value=b"\x01" * 32, sender=b"\x02" * 32)
+    assert pv.digest() != pc.digest()
+
+
+def test_digest_sensitive_to_fields():
+    base = Propose(height=1, round=0, valid_round=-1, value=b"\x01" * 32, sender=b"\x02" * 32)
+    assert base.digest() != Propose(2, 0, -1, b"\x01" * 32, b"\x02" * 32).digest()
+    assert base.digest() != Propose(1, 1, -1, b"\x01" * 32, b"\x02" * 32).digest()
+    assert base.digest() != Propose(1, 0, 0, b"\x01" * 32, b"\x02" * 32).digest()
+    assert base.digest() != Propose(1, 0, -1, b"\x03" * 32, b"\x02" * 32).digest()
+
+
+def test_signature_excluded_from_equality(rng):
+    p = random_prevote(rng)
+    assert p == p.with_signature(b"\x01" * 64)
+
+
+def test_unmarshal_fuzz_no_crash(rng):
+    for _ in range(300):
+        blob = rng.randbytes(rng.randint(0, 100))
+        for cls in (Propose, Prevote, Precommit, Timeout):
+            try:
+                cls.unmarshal(Reader(blob))
+            except SerdeError:
+                pass
+        try:
+            unmarshal_message(Reader(blob))
+        except SerdeError:
+            pass
+
+
+def test_int64_range_enforced_on_marshal():
+    p = Propose(height=1 << 64, round=0, valid_round=-1,
+                value=b"\x00" * 32, sender=b"\x00" * 32)
+    with pytest.raises(SerdeError):
+        w = Writer()
+        p.marshal(w)

@@ -1,0 +1,139 @@
+"""MessageQueue: ordering, whitelist, capacity, drop-below-height, windows.
+
+Mirrors mq/mq_test.go's strategy.
+"""
+
+import random
+
+from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+from hyperdrive_tpu.mq import MessageQueue
+
+
+def sig(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def pv(sender, h, r):
+    return Prevote(height=h, round=r, value=b"\x01" * 32, sender=sender)
+
+
+def collect(mq, height, allowed):
+    got = []
+    n = mq.consume(height, got.append, got.append, got.append, allowed)
+    return got, n
+
+
+def test_consume_in_height_round_order_per_sender(rng):
+    mq = MessageQueue()
+    coords = [(h, r) for h in range(1, 6) for r in range(4)]
+    shuffled = coords[:]
+    rng.shuffle(shuffled)
+    for h, r in shuffled:
+        mq.insert_prevote(pv(sig(1), h, r))
+    got, n = collect(mq, 10, {sig(1)})
+    assert n == len(coords)
+    assert [(m.height, m.round) for m in got] == coords
+
+
+def test_equal_keys_stay_fifo():
+    mq = MessageQueue()
+    a = Prevote(height=1, round=0, value=b"\x01" * 32, sender=sig(1))
+    b = Prevote(height=1, round=0, value=b"\x02" * 32, sender=sig(1))
+    c = Prevote(height=1, round=0, value=b"\x03" * 32, sender=sig(1))
+    for m in (a, b, c):
+        mq.insert_prevote(m)
+    got, _ = collect(mq, 1, {sig(1)})
+    assert got == [a, b, c]
+
+
+def test_consume_respects_height_bound():
+    mq = MessageQueue()
+    for h in (1, 2, 3, 4):
+        mq.insert_prevote(pv(sig(1), h, 0))
+    got, n = collect(mq, 2, {sig(1)})
+    assert [(m.height) for m in got] == [1, 2]
+    assert n == 2
+    got, n = collect(mq, 10, {sig(1)})
+    assert [(m.height) for m in got] == [3, 4]
+
+
+def test_whitelist_drops_but_counts():
+    # Filtered messages are consumed (and counted) but not dispatched,
+    # matching the reference's n++-before-filter behaviour (mq/mq.go:44-51).
+    mq = MessageQueue()
+    mq.insert_prevote(pv(sig(1), 1, 0))
+    mq.insert_prevote(pv(sig(2), 1, 0))
+    got, n = collect(mq, 1, {sig(1)})
+    assert n == 2
+    assert [m.sender for m in got] == [sig(1)]
+    # Nothing left afterwards — the filtered message is gone.
+    got, n = collect(mq, 10, {sig(1), sig(2)})
+    assert n == 0 and got == []
+
+
+def test_capacity_eviction_drops_far_future():
+    mq = MessageQueue(max_capacity=3)
+    for h in (5, 6, 7):
+        mq.insert_prevote(pv(sig(1), h, 0))
+    mq.insert_prevote(pv(sig(1), 1, 0))  # nearer message displaces the tail
+    got, _ = collect(mq, 100, {sig(1)})
+    assert [m.height for m in got] == [1, 5, 6]
+
+
+def test_capacity_one():
+    mq = MessageQueue(max_capacity=1)
+    mq.insert_prevote(pv(sig(1), 5, 0))
+    mq.insert_prevote(pv(sig(1), 1, 0))
+    got, _ = collect(mq, 100, {sig(1)})
+    assert [m.height for m in got] == [1]
+
+
+def test_capacity_is_per_sender():
+    mq = MessageQueue(max_capacity=2)
+    for i in (1, 2, 3):
+        mq.insert_prevote(pv(sig(i), 1, 0))
+        mq.insert_prevote(pv(sig(i), 2, 0))
+        mq.insert_prevote(pv(sig(i), 3, 0))  # evicted per sender
+    assert len(mq) == 6
+
+
+def test_drop_messages_below_height():
+    mq = MessageQueue()
+    for h in (1, 2, 3, 4):
+        mq.insert_prevote(pv(sig(1), h, 0))
+    mq.drop_messages_below_height(3)
+    got, _ = collect(mq, 100, {sig(1)})
+    assert [m.height for m in got] == [3, 4]
+
+
+def test_mixed_message_types_dispatch_correctly():
+    mq = MessageQueue()
+    p = Propose(height=1, round=0, valid_round=-1, value=b"\x01" * 32, sender=sig(1))
+    v = Prevote(height=1, round=0, value=b"\x01" * 32, sender=sig(1))
+    c = Precommit(height=1, round=0, value=b"\x01" * 32, sender=sig(1))
+    mq.insert_precommit(c)
+    mq.insert_prevote(v)
+    mq.insert_propose(p)
+    seen = {"p": [], "v": [], "c": []}
+    mq.consume(1, seen["p"].append, seen["v"].append, seen["c"].append, {sig(1)})
+    assert seen["p"] == [p] and seen["v"] == [v] and seen["c"] == [c]
+
+
+def test_drain_window_caps_and_preserves_order():
+    mq = MessageQueue()
+    for h in range(1, 8):
+        mq.insert_prevote(pv(sig(1), h, 0))
+    window = mq.drain_window(height=5, window=3)
+    assert [m.height for m in window] == [1, 2, 3]
+    window = mq.drain_window(height=5, window=10)
+    assert [m.height for m in window] == [4, 5]
+    assert len(mq) == 2  # heights 6,7 remain
+
+
+def test_drain_window_multiple_senders():
+    mq = MessageQueue()
+    for i in (1, 2, 3):
+        mq.insert_prevote(pv(sig(i), 1, 0))
+    window = mq.drain_window(height=1, window=10)
+    assert len(window) == 3
+    assert len(mq) == 0

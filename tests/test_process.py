@@ -1,0 +1,648 @@
+"""Rule-by-rule consensus automaton specs.
+
+Mirrors the reference's 4k-line process_test.go strategy: drive a raw
+Process with recording mocks and assert on the broadcast/commit/timeout/
+catch side effects for each paper rule (L11..L65), plus insert validation,
+equivocation catching, and checkpoint serde.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+from hyperdrive_tpu.process import Process
+from hyperdrive_tpu.testutil import (
+    BroadcasterCallbacks,
+    CatcherCallbacks,
+    CommitterCallback,
+    MockProposer,
+    MockScheduler,
+    MockValidator,
+    TimerCallbacks,
+)
+from hyperdrive_tpu.types import INVALID_ROUND, NIL_VALUE, Step
+
+
+def sig(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def val(i: int) -> bytes:
+    return bytes([0xA0 + i]) * 32
+
+
+WHOAMI = sig(1)
+PROPOSER = sig(2)
+OTHER_A = sig(3)
+OTHER_B = sig(4)
+OTHER_C = sig(5)
+
+
+def make_process(
+    whoami=WHOAMI,
+    f=1,
+    proposer_sig=PROPOSER,
+    proposer_value=None,
+    validator_ok=True,
+    height=1,
+):
+    """A Process wired to recording mocks; the scheduled proposer for every
+    (height, round) is ``proposer_sig``."""
+    rec = SimpleNamespace(
+        proposes=[], prevotes=[], precommits=[], commits=[],
+        timeout_proposes=[], timeout_prevotes=[], timeout_precommits=[],
+        double_proposes=[], double_prevotes=[], double_precommits=[],
+        out_of_turns=[],
+    )
+    commit_return = {"f": 0, "scheduler": None}
+
+    proc = Process(
+        whoami=whoami,
+        f=f,
+        timer=TimerCallbacks(
+            on_propose=lambda h, r: rec.timeout_proposes.append((h, r)),
+            on_prevote=lambda h, r: rec.timeout_prevotes.append((h, r)),
+            on_precommit=lambda h, r: rec.timeout_precommits.append((h, r)),
+        ),
+        scheduler=MockScheduler(proposer_sig),
+        proposer=MockProposer(value=proposer_value or val(0)),
+        validator=MockValidator(ok=validator_ok),
+        broadcaster=BroadcasterCallbacks(
+            on_propose=rec.proposes.append,
+            on_prevote=rec.prevotes.append,
+            on_precommit=rec.precommits.append,
+        ),
+        committer=CommitterCallback(
+            on_commit=lambda h, v: (
+                rec.commits.append((h, v)),
+                (commit_return["f"], commit_return["scheduler"]),
+            )[1]
+        ),
+        catcher=CatcherCallbacks(
+            on_double_propose=lambda a, b: rec.double_proposes.append((a, b)),
+            on_double_prevote=lambda a, b: rec.double_prevotes.append((a, b)),
+            on_double_precommit=lambda a, b: rec.double_precommits.append((a, b)),
+            on_out_of_turn_propose=rec.out_of_turns.append,
+        ),
+        height=height,
+    )
+    return proc, rec, commit_return
+
+
+def prevote(sender, value, round=0, height=1):
+    return Prevote(height=height, round=round, value=value, sender=sender)
+
+
+def precommit(sender, value, round=0, height=1):
+    return Precommit(height=height, round=round, value=value, sender=sender)
+
+
+def propose(value, round=0, height=1, valid_round=INVALID_ROUND, sender=PROPOSER):
+    return Propose(height=height, round=round, valid_round=valid_round,
+                   value=value, sender=sender)
+
+
+# ------------------------------------------------------------- L11 StartRound
+
+
+class TestStartRound:
+    def test_non_proposer_schedules_propose_timeout(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        assert proc.current_round == 0
+        assert proc.current_step == Step.PROPOSING
+        assert rec.timeout_proposes == [(1, 0)]
+        assert rec.proposes == []
+
+    def test_proposer_broadcasts_fresh_value(self):
+        proc, rec, _ = make_process(whoami=PROPOSER, proposer_value=val(7))
+        proc.start()
+        assert len(rec.proposes) == 1
+        p = rec.proposes[0]
+        assert (p.height, p.round, p.valid_round) == (1, 0, INVALID_ROUND)
+        assert p.value == val(7)
+        assert p.sender == PROPOSER
+        assert rec.timeout_proposes == []
+
+    def test_proposer_reproposes_valid_value(self):
+        proc, rec, _ = make_process(whoami=PROPOSER, proposer_value=val(7))
+        proc.state.valid_value = val(9)
+        proc.state.valid_round = 2
+        proc.start_round(3)
+        p = rec.proposes[0]
+        assert p.value == val(9)
+        assert p.valid_round == 2
+        assert p.round == 3
+
+    def test_no_scheduler_does_nothing(self):
+        proc, rec, _ = make_process()
+        proc.scheduler = None
+        proc.start()
+        assert rec.proposes == [] and rec.timeout_proposes == []
+
+
+# ----------------------------------------------------------- timeout handlers
+
+
+class TestTimeouts:
+    def test_on_timeout_propose_prevotes_nil(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_propose(1, 0)
+        assert len(rec.prevotes) == 1
+        assert rec.prevotes[0].value == NIL_VALUE
+        assert proc.current_step == Step.PREVOTING
+
+    @pytest.mark.parametrize("h,r", [(2, 0), (1, 1)])
+    def test_on_timeout_propose_wrong_coords_ignored(self, h, r):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_propose(h, r)
+        assert rec.prevotes == []
+        assert proc.current_step == Step.PROPOSING
+
+    def test_on_timeout_propose_wrong_step_ignored(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_propose(1, 0)
+        rec.prevotes.clear()
+        proc.on_timeout_propose(1, 0)  # now Prevoting; must not fire again
+        assert rec.prevotes == []
+
+    def test_on_timeout_prevote_precommits_nil(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_propose(1, 0)
+        proc.on_timeout_prevote(1, 0)
+        assert len(rec.precommits) == 1
+        assert rec.precommits[0].value == NIL_VALUE
+        assert proc.current_step == Step.PRECOMMITTING
+
+    def test_on_timeout_prevote_wrong_step_ignored(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_prevote(1, 0)  # still Proposing
+        assert rec.precommits == []
+
+    def test_on_timeout_precommit_starts_next_round(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_precommit(1, 0)
+        assert proc.current_round == 1
+        assert proc.current_step == Step.PROPOSING
+        # New round schedules a fresh propose timeout for round 1.
+        assert (1, 1) in rec.timeout_proposes
+
+    def test_on_timeout_precommit_wrong_coords_ignored(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_precommit(1, 5)
+        proc.on_timeout_precommit(9, 0)
+        assert proc.current_round == 0
+
+
+# ------------------------------------------------------------------------ L22
+
+
+class TestPrevoteUponPropose:
+    def test_valid_fresh_propose_prevoted(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1)))
+        assert len(rec.prevotes) == 1
+        assert rec.prevotes[0].value == val(1)
+        assert proc.current_step == Step.PREVOTING
+
+    def test_invalid_propose_prevotes_nil(self):
+        proc, rec, _ = make_process(validator_ok=False)
+        proc.start()
+        proc.propose(propose(val(1)))
+        assert rec.prevotes[0].value == NIL_VALUE
+        assert proc.current_step == Step.PREVOTING
+
+    def test_nil_value_propose_prevotes_nil(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(NIL_VALUE))
+        assert rec.prevotes[0].value == NIL_VALUE
+
+    def test_locked_on_other_value_prevotes_nil(self):
+        proc, rec, _ = make_process()
+        proc.state.locked_value = val(9)
+        proc.state.locked_round = 0
+        proc.start()
+        proc.propose(propose(val(1)))
+        assert rec.prevotes[0].value == NIL_VALUE
+
+    def test_locked_on_same_value_prevotes_value(self):
+        proc, rec, _ = make_process()
+        proc.state.locked_value = val(1)
+        proc.state.locked_round = 0
+        proc.start()
+        proc.propose(propose(val(1)))
+        assert rec.prevotes[0].value == val(1)
+
+    def test_repropose_with_valid_round_not_l22(self):
+        # A propose carrying a ValidRound is the L28 rule's job.
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1), valid_round=0, round=0))
+        # vr=0 >= current round 0, so neither L22 nor L28 fires.
+        assert rec.prevotes == []
+        assert proc.current_step == Step.PROPOSING
+
+
+# ------------------------------------------------------------------------ L28
+
+
+class TestPrevoteUponSufficientPrevotes:
+    def _arm(self, proc):
+        """Move to round 1 while keeping step Proposing."""
+        proc.start()
+        proc.on_timeout_precommit(1, 0)
+        assert (proc.current_round, proc.current_step) == (1, Step.PROPOSING)
+
+    def test_repropose_with_quorum_at_valid_round(self):
+        proc, rec, _ = make_process()
+        self._arm(proc)
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(1), round=0))
+        proc.propose(propose(val(1), round=1, valid_round=0))
+        assert [pv.value for pv in rec.prevotes] == [val(1)]
+        assert rec.prevotes[0].round == 1
+        assert proc.current_step == Step.PREVOTING
+
+    def test_insufficient_quorum_no_prevote(self):
+        proc, rec, _ = make_process()
+        self._arm(proc)
+        for s in (OTHER_A, OTHER_B):
+            proc.prevote(prevote(s, val(1), round=0))
+        proc.propose(propose(val(1), round=1, valid_round=0))
+        assert rec.prevotes == []
+        assert proc.current_step == Step.PROPOSING
+
+    def test_quorum_for_different_value_no_prevote(self):
+        proc, rec, _ = make_process()
+        self._arm(proc)
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(2), round=0))
+        proc.propose(propose(val(1), round=1, valid_round=0))
+        assert rec.prevotes == []
+
+    def test_invalid_propose_with_quorum_prevotes_nil(self):
+        proc, rec, _ = make_process(validator_ok=False)
+        self._arm(proc)
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(1), round=0))
+        proc.propose(propose(val(1), round=1, valid_round=0))
+        assert [pv.value for pv in rec.prevotes] == [NIL_VALUE]
+
+    def test_locked_above_valid_round_prevotes_nil(self):
+        proc, rec, _ = make_process()
+        self._arm(proc)
+        proc.state.locked_value = val(9)
+        proc.state.locked_round = 1
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(1), round=0))
+        proc.propose(propose(val(1), round=1, valid_round=0))
+        assert [pv.value for pv in rec.prevotes] == [NIL_VALUE]
+
+
+# ------------------------------------------------------------------------ L34
+
+
+class TestTimeoutPrevoteUponSufficientPrevotes:
+    def test_quorum_of_any_prevotes_schedules_timeout_once(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_propose(1, 0)  # -> Prevoting
+        proc.prevote(prevote(OTHER_A, val(1)))
+        proc.prevote(prevote(OTHER_B, val(2)))
+        assert rec.timeout_prevotes == []
+        proc.prevote(prevote(OTHER_C, NIL_VALUE))
+        assert rec.timeout_prevotes == [(1, 0)]
+        proc.prevote(prevote(PROPOSER, val(3)))
+        assert rec.timeout_prevotes == [(1, 0)]  # once per round
+
+    def test_not_scheduled_while_proposing(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(1)))
+        # Step is still Proposing (no propose seen): L34 must not fire...
+        assert rec.timeout_prevotes == []
+        # ...but L36 must also not have fired (no propose); check step intact.
+        assert proc.current_step == Step.PROPOSING
+
+
+# ------------------------------------------------------------------------ L36
+
+
+class TestPrecommitUponSufficientPrevotes:
+    def test_lock_and_precommit(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1)))  # L22: prevote + step Prevoting
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(1)))
+        assert [pc.value for pc in rec.precommits] == [val(1)]
+        assert proc.current_step == Step.PRECOMMITTING
+        assert proc.state.locked_value == val(1)
+        assert proc.state.locked_round == 0
+        assert proc.state.valid_value == val(1)
+        assert proc.state.valid_round == 0
+
+    def test_once_per_round(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1)))
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(1)))
+        proc.prevote(prevote(PROPOSER, val(1)))
+        assert len(rec.precommits) == 1
+
+    def test_updates_valid_value_when_already_precommitting(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_propose(1, 0)  # -> Prevoting (nil prevote)
+        proc.on_timeout_prevote(1, 0)  # -> Precommitting (nil precommit)
+        rec.precommits.clear()
+        proc.propose(propose(val(1)))
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(1)))
+        # Step was already Precommitting: no new precommit, no lock...
+        assert rec.precommits == []
+        assert proc.state.locked_round == INVALID_ROUND
+        # ...but the valid value/round are still recorded.
+        assert proc.state.valid_value == val(1)
+        assert proc.state.valid_round == 0
+
+    def test_requires_valid_propose(self):
+        proc, rec, _ = make_process(validator_ok=False)
+        proc.start()
+        proc.propose(propose(val(1)))  # L22 prevotes nil -> Prevoting
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(1)))
+        assert rec.precommits == []
+        assert proc.state.locked_round == INVALID_ROUND
+
+
+# ------------------------------------------------------------------------ L44
+
+
+class TestPrecommitNilUponSufficientPrevotes:
+    def test_nil_quorum_precommits_nil(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_propose(1, 0)  # -> Prevoting
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, NIL_VALUE))
+        assert [pc.value for pc in rec.precommits] == [NIL_VALUE]
+        assert proc.current_step == Step.PRECOMMITTING
+
+    def test_mixed_values_do_not_count(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_propose(1, 0)
+        proc.prevote(prevote(OTHER_A, NIL_VALUE))
+        proc.prevote(prevote(OTHER_B, val(1)))
+        proc.prevote(prevote(OTHER_C, NIL_VALUE))
+        assert rec.precommits == []
+
+
+# ------------------------------------------------------------------------ L47
+
+
+class TestTimeoutPrecommitUponSufficientPrecommits:
+    def test_exactly_quorum_schedules_timeout_once(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.precommit(precommit(OTHER_A, val(1)))
+        proc.precommit(precommit(OTHER_B, NIL_VALUE))
+        assert rec.timeout_precommits == []
+        proc.precommit(precommit(OTHER_C, val(2)))
+        assert rec.timeout_precommits == [(1, 0)]
+        proc.precommit(precommit(PROPOSER, val(1)))
+        assert rec.timeout_precommits == [(1, 0)]
+
+
+# ------------------------------------------------------------------------ L49
+
+
+class TestCommitUponSufficientPrecommits:
+    def test_commit_advances_height(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1)))
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.precommit(precommit(s, val(1)))
+        assert rec.commits == [(1, val(1))]
+        assert proc.current_height == 2
+        assert proc.current_round == 0
+        assert proc.current_step == Step.PROPOSING
+        assert proc.state.locked_round == INVALID_ROUND
+        assert proc.state.valid_round == INVALID_ROUND
+        assert not proc.state.propose_logs
+        # The new height's round 0 scheduled its propose timeout.
+        assert (2, 0) in rec.timeout_proposes
+
+    def test_commit_requires_valid_propose(self):
+        proc, rec, _ = make_process(validator_ok=False)
+        proc.start()
+        proc.propose(propose(val(1)))
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.precommit(precommit(s, val(1)))
+        assert rec.commits == []
+        assert proc.current_height == 1
+
+    def test_commit_requires_matching_values(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1)))
+        proc.precommit(precommit(OTHER_A, val(1)))
+        proc.precommit(precommit(OTHER_B, val(2)))
+        proc.precommit(precommit(OTHER_C, val(1)))
+        assert rec.commits == []
+
+    def test_commit_installs_rotated_validator_set(self):
+        proc, rec, ret = make_process()
+        new_sched = MockScheduler(OTHER_A)
+        ret["f"] = 5
+        ret["scheduler"] = new_sched
+        proc.start()
+        proc.propose(propose(val(1)))
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.precommit(precommit(s, val(1)))
+        assert proc.f == 5
+        assert proc.scheduler is new_sched
+
+    def test_commit_on_past_round(self):
+        # Precommits for an earlier round still commit after a round skip.
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1), round=0))
+        proc.on_timeout_precommit(1, 0)  # move to round 1
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.precommit(precommit(s, val(1), round=0))
+        assert rec.commits == [(1, val(1))]
+        assert proc.current_height == 2
+
+
+# ------------------------------------------------------------------------ L55
+
+
+class TestSkipToFutureRound:
+    def test_f_plus_one_unique_signatories_skip(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.prevote(prevote(OTHER_A, val(1), round=5))
+        assert proc.current_round == 0
+        proc.precommit(precommit(OTHER_B, val(2), round=5))
+        assert proc.current_round == 5
+        assert proc.current_step == Step.PROPOSING
+        assert (1, 5) in rec.timeout_proposes
+
+    def test_same_signatory_counts_once(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.prevote(prevote(OTHER_A, val(1), round=5))
+        proc.precommit(precommit(OTHER_A, val(1), round=5))
+        assert proc.current_round == 0
+
+    def test_invalid_propose_earns_no_trace_credit(self):
+        proc, rec, _ = make_process(validator_ok=False)
+        proc.start()
+        proc.propose(propose(val(1), round=5))
+        proc.prevote(prevote(OTHER_A, val(1), round=5))
+        assert proc.current_round == 0  # invalid propose didn't count
+
+    def test_valid_propose_earns_trace_credit(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1), round=5))
+        proc.prevote(prevote(OTHER_A, val(1), round=5))
+        assert proc.current_round == 5
+
+    def test_past_round_never_skipped(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.on_timeout_precommit(1, 0)  # round 1
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(1), round=0))
+        assert proc.current_round == 1
+
+
+# ----------------------------------------------------------- insert validation
+
+
+class TestInserts:
+    def test_wrong_height_propose_rejected(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1), height=9))
+        assert not proc.state.propose_logs
+        assert rec.prevotes == []
+
+    def test_negative_round_propose_rejected(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1), round=-1))
+        assert not proc.state.propose_logs
+
+    def test_out_of_turn_propose_caught(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        bad = propose(val(1), sender=OTHER_A)
+        proc.propose(bad)
+        assert rec.out_of_turns == [bad]
+        assert not proc.state.propose_logs
+
+    def test_double_propose_caught(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1)))
+        proc.propose(propose(val(2)))
+        assert len(rec.double_proposes) == 1
+
+    def test_identical_repropose_not_caught(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1)))
+        proc.propose(propose(val(1)))
+        assert rec.double_proposes == []
+
+    def test_double_prevote_caught(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.prevote(prevote(OTHER_A, val(1)))
+        proc.prevote(prevote(OTHER_A, val(2)))
+        assert len(rec.double_prevotes) == 1
+        # The first vote stands; the second is not logged.
+        assert proc.state.prevote_logs[0][OTHER_A].value == val(1)
+
+    def test_identical_prevote_not_caught(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.prevote(prevote(OTHER_A, val(1)))
+        proc.prevote(prevote(OTHER_A, val(1)))
+        assert rec.double_prevotes == []
+
+    def test_double_precommit_caught(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.precommit(precommit(OTHER_A, val(1)))
+        proc.precommit(precommit(OTHER_A, val(2)))
+        assert len(rec.double_precommits) == 1
+
+    def test_wrong_height_votes_rejected(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.prevote(prevote(OTHER_A, val(1), height=3))
+        proc.precommit(precommit(OTHER_A, val(1), height=0))
+        assert not proc.state.prevote_logs
+        assert not proc.state.precommit_logs
+
+
+# ------------------------------------------------------------------ serde
+
+
+class TestProcessSerde:
+    def test_roundtrip(self):
+        proc, rec, _ = make_process(f=3)
+        proc.start()
+        proc.propose(propose(val(1)))
+        proc.prevote(prevote(OTHER_A, val(1)))
+        w = Writer()
+        proc.marshal(w)
+        restored, _, _ = make_process()
+        restored.unmarshal_into(Reader(w.data()))
+        assert restored.whoami == proc.whoami
+        assert restored.f == proc.f
+        assert restored.state.equal(proc.state)
+        assert restored.state.prevote_logs == proc.state.prevote_logs
+
+    def test_fuzz_no_crash(self, rng):
+        for _ in range(200):
+            blob = rng.randbytes(rng.randint(0, 150))
+            proc, _, _ = make_process()
+            try:
+                proc.unmarshal_into(Reader(blob))
+            except SerdeError:
+                pass
+
+    def test_restored_process_keeps_making_progress(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.propose(propose(val(1)))
+        w = Writer()
+        proc.marshal(w)
+
+        # Restore into a fresh process with fresh mocks and finish the round.
+        proc2, rec2, _ = make_process()
+        proc2.unmarshal_into(Reader(w.data()))
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc2.precommit(precommit(s, val(1)))
+        assert rec2.commits == [(1, val(1))]
+        assert proc2.current_height == 2

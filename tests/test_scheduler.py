@@ -1,0 +1,70 @@
+"""Round-robin scheduler: fairness, determinism, input validation.
+
+Mirrors scheduler/scheduler_test.go.
+"""
+
+import pytest
+
+from hyperdrive_tpu.scheduler import RoundRobin
+
+
+def sig(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def test_single_signatory_always_elected():
+    rr = RoundRobin([sig(1)])
+    for h in range(1, 20):
+        for r in range(5):
+            assert rr.schedule(h, r) == sig(1)
+
+
+def test_modular_fairness():
+    sigs = [sig(i) for i in range(1, 6)]
+    rr = RoundRobin(sigs)
+    for h in range(1, 30):
+        for r in range(10):
+            assert rr.schedule(h, r) == sigs[(h + r) % 5]
+
+
+def test_rotates_with_round():
+    sigs = [sig(i) for i in range(1, 4)]
+    rr = RoundRobin(sigs)
+    elected = {rr.schedule(1, r) for r in range(3)}
+    assert elected == set(sigs)
+
+
+def test_empty_set_raises():
+    with pytest.raises(ValueError):
+        RoundRobin([]).schedule(1, 0)
+
+
+@pytest.mark.parametrize("h", [0, -1])
+def test_invalid_height_raises(h):
+    with pytest.raises(ValueError):
+        RoundRobin([sig(1)]).schedule(h, 0)
+
+
+def test_invalid_round_raises():
+    with pytest.raises(ValueError):
+        RoundRobin([sig(1)]).schedule(1, -1)
+
+
+def test_uint64_wraparound_parity():
+    # Go computes uint64(height)+uint64(round) with wraparound
+    # (scheduler/scheduler.go:52); int64 max inputs must not crash and must
+    # stay deterministic.
+    sigs = [sig(i) for i in range(1, 8)]
+    rr = RoundRobin(sigs)
+    h = (1 << 63) - 1
+    r = (1 << 63) - 1
+    idx = (((h & ((1 << 64) - 1)) + (r & ((1 << 64) - 1))) & ((1 << 64) - 1)) % 7
+    assert rr.schedule(h, r) == sigs[idx]
+
+
+def test_mutating_input_list_does_not_affect_schedule():
+    sigs = [sig(i) for i in range(1, 4)]
+    rr = RoundRobin(sigs)
+    before = rr.schedule(1, 0)
+    sigs[:] = [sig(9)] * 3
+    assert rr.schedule(1, 0) == before

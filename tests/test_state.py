@@ -1,0 +1,101 @@
+"""State: defaults, clone isolation, equality semantics, serde, fuzz.
+
+Mirrors process/state_test.go's strategy.
+"""
+
+from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.messages import Prevote
+from hyperdrive_tpu.state import State
+from hyperdrive_tpu.testutil import random_state
+from hyperdrive_tpu.types import (
+    DEFAULT_HEIGHT,
+    INVALID_ROUND,
+    NIL_VALUE,
+    Step,
+)
+
+
+def test_defaults():
+    st = State()
+    assert st.current_height == DEFAULT_HEIGHT
+    assert st.current_round == 0
+    assert st.current_step == Step.PROPOSING
+    assert st.locked_value == NIL_VALUE
+    assert st.locked_round == INVALID_ROUND
+    assert st.valid_value == NIL_VALUE
+    assert st.valid_round == INVALID_ROUND
+    assert not st.propose_logs and not st.prevote_logs and not st.precommit_logs
+
+
+def test_clone_is_deep(rng):
+    st = random_state(rng)
+    cl = st.clone()
+    assert cl.equal(st)
+    # Mutating the clone's logs must not touch the original.
+    pv = Prevote(height=1, round=0, value=b"\x05" * 32, sender=b"\x06" * 32)
+    cl.prevote_logs.setdefault(0, {})[pv.sender] = pv
+    cl.trace_logs.setdefault(0, set()).add(pv.sender)
+    assert pv.sender not in st.prevote_logs.get(0, {})
+    assert pv.sender not in st.trace_logs.get(0, set())
+
+
+def test_equality_ignores_logs(rng):
+    st = random_state(rng)
+    cl = st.clone()
+    cl.propose_logs.clear()
+    cl.once_flags.clear()
+    assert st.equal(cl)
+    cl.current_round += 1
+    assert not st.equal(cl)
+
+
+def test_serde_roundtrip(rng):
+    for _ in range(50):
+        st = random_state(rng)
+        w = Writer()
+        st.marshal(w)
+        back = State.unmarshal(Reader(w.data()))
+        assert back.equal(st)
+        assert back.propose_logs == st.propose_logs
+        assert back.propose_is_valid == st.propose_is_valid
+        assert back.prevote_logs == st.prevote_logs
+        assert back.precommit_logs == st.precommit_logs
+        assert back.once_flags == st.once_flags
+        assert back.trace_logs == st.trace_logs
+
+
+def test_undersized_budget_errors(rng):
+    st = random_state(rng)
+    w = Writer()
+    st.marshal(w)
+    data = w.data()
+    for rem in (0, 1, len(data) // 2):
+        try:
+            State.unmarshal(Reader(data, rem=rem))
+        except SerdeError:
+            continue
+        # If it succeeded, the budget must genuinely have covered it.
+        assert rem >= len(data)
+
+
+def test_unmarshal_fuzz_no_crash(rng):
+    for _ in range(300):
+        blob = rng.randbytes(rng.randint(0, 200))
+        try:
+            State.unmarshal(Reader(blob))
+        except SerdeError:
+            pass
+
+
+def test_reset_for_new_height(rng):
+    st = random_state(rng)
+    st.reset_for_new_height()
+    assert st.locked_value == NIL_VALUE
+    assert st.locked_round == INVALID_ROUND
+    assert st.valid_value == NIL_VALUE
+    assert st.valid_round == INVALID_ROUND
+    assert not st.propose_logs
+    assert not st.prevote_logs
+    assert not st.precommit_logs
+    assert not st.once_flags
+    assert not st.trace_logs

@@ -1,0 +1,65 @@
+"""Timers: scaling math, real-clock firing, nil-handler safety.
+
+Mirrors timer/timer_test.go (scaled down: millisecond timeouts).
+"""
+
+import threading
+import time
+
+from hyperdrive_tpu.messages import Timeout
+from hyperdrive_tpu.timer import LinearTimer
+from hyperdrive_tpu.types import MessageType
+
+
+def test_duration_scaling():
+    t = LinearTimer(timeout=2.0, timeout_scaling=0.5)
+    assert t.duration_at(1, 0) == 2.0
+    assert t.duration_at(1, 1) == 3.0
+    assert t.duration_at(1, 4) == 6.0
+    t2 = LinearTimer(timeout=2.0, timeout_scaling=0.0)
+    assert t2.duration_at(1, 10) == 2.0
+
+
+def test_fires_correct_handler_within_window():
+    fired = []
+    done = threading.Event()
+
+    def on_prevote(t: Timeout):
+        fired.append(t)
+        done.set()
+
+    timer = LinearTimer(
+        handle_timeout_prevote=on_prevote,
+        timeout=0.02,
+        timeout_scaling=0.5,
+    )
+    start = time.monotonic()
+    timer.timeout_prevote(3, 1)
+    assert done.wait(2.0), "timeout handler never fired"
+    elapsed = time.monotonic() - start
+    assert elapsed >= 0.02  # not early
+    assert fired == [Timeout(MessageType.PREVOTE, 3, 1)]
+
+
+def test_other_handlers_not_invoked():
+    fired = {"propose": 0, "precommit": 0}
+    done = threading.Event()
+    timer = LinearTimer(
+        handle_timeout_propose=lambda t: fired.__setitem__("propose", 1),
+        handle_timeout_precommit=lambda t: (
+            fired.__setitem__("precommit", 1),
+            done.set(),
+        ),
+        timeout=0.01,
+    )
+    timer.timeout_precommit(1, 0)
+    assert done.wait(2.0)
+    assert fired == {"propose": 0, "precommit": 1}
+
+
+def test_nil_handler_is_safe():
+    timer = LinearTimer(timeout=0.001)
+    timer.timeout_propose(1, 0)
+    timer.timeout_prevote(1, 0)
+    timer.timeout_precommit(1, 0)
+    time.sleep(0.01)  # nothing to assert — must simply not raise
