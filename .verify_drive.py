@@ -153,3 +153,19 @@ for k in range(2000):
 qlen = len(r0.mq)
 assert qlen <= 1000, f"queue exceeded capacity: {qlen}"
 print(f"PASS: far-future flood bounded at {qlen} <= 1000 (capacity eviction)")
+
+# --- probe 5: harness scenario with reorder + replay round-trip --------
+from hyperdrive_tpu.harness import ScenarioRecord, Simulation
+import tempfile, os
+
+sim = Simulation(n=10, target_height=10, seed=99, reorder=True)
+res = sim.run()
+assert res.completed, f"harness stalled at {res.heights}"
+res.assert_safety()
+with tempfile.TemporaryDirectory() as d:
+    p = os.path.join(d, "failure.dump")
+    res.record.dump(p)
+    replayed = Simulation.replay(ScenarioRecord.load(p))
+    assert replayed.commits == res.commits
+print(f"PASS: harness 10-replica reorder run to height 10 in {res.steps} steps "
+      f"({res.virtual_time:.1f}s virtual), dump+replay identical")

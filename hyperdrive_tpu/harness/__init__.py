@@ -1,0 +1,17 @@
+"""Deterministic in-process network simulation for consensus testing.
+
+The capability analogue of the reference's ``replica/replica_test.go``
+harness (an in-memory global message queue, lock-step delivery, seeded
+scenarios, fault and Byzantine injection, and record/replay of failing
+interleavings) — redesigned around a virtual clock so runs are fast and
+bit-reproducible instead of sleeping real time.
+"""
+
+from hyperdrive_tpu.harness.sim import (
+    Simulation,
+    SimulationResult,
+    ScenarioRecord,
+    VirtualClock,
+)
+
+__all__ = ["Simulation", "SimulationResult", "ScenarioRecord", "VirtualClock"]

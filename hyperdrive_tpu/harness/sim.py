@@ -1,0 +1,375 @@
+"""The simulator: virtual time, seeded adversaries, record/replay.
+
+How it maps to the reference harness (replica/replica_test.go):
+
+- One global message queue of ``(to, msg)`` records; every broadcast
+  appends one record per replica, including the sender
+  (reference: 174-208). Delivery is strictly one message at a time, so the
+  whole distributed execution is a serialized, recordable interleaving
+  (reference: 228-323).
+- Timeouts go through a :class:`VirtualClock` instead of real sleeps: when
+  the network drains, the clock jumps to the next deadline and the fired
+  timeout enters the queue addressed to its owner (the reference used
+  real-time sleeping timers; virtual time preserves the semantics and makes
+  runs instant and deterministic).
+- Faults: replicas can be killed at a chosen delivery step (reference:
+  574-589 kills via context cancel); Byzantine replicas take custom
+  proposer/validator behaviours (reference: 603-682).
+- Every delivered message is recorded into a :class:`ScenarioRecord` that
+  serializes through the canonical codec; a failing run can be dumped to
+  disk and replayed message-for-message (reference: Scenario + failure.dump
+  + REPLAY_MODE, 850-928/1049-1078).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import heapq
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.messages import (
+    Precommit,
+    Prevote,
+    Propose,
+    Timeout,
+    marshal_message,
+    unmarshal_message,
+)
+from hyperdrive_tpu.replica import Replica, ReplicaOptions
+from hyperdrive_tpu.testutil import (
+    BroadcasterCallbacks,
+    CatcherCallbacks,
+    CommitterCallback,
+    MockProposer,
+    MockValidator,
+)
+from hyperdrive_tpu.timer import VirtualTimer
+from hyperdrive_tpu.types import Height, Value
+
+__all__ = ["VirtualClock", "ScenarioRecord", "SimulationResult", "Simulation"]
+
+
+class VirtualClock:
+    """A deterministic event clock: deadlines in a heap, time advances only
+    when the simulator asks for the next due event."""
+
+    def __init__(self):
+        self.now = 0.0
+        self._seq = 0
+        self._heap: list[tuple[float, int, object, object]] = []
+
+    def schedule(self, delay: float, event, handler) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (self.now + delay, self._seq, event, handler))
+
+    def pending(self) -> int:
+        return len(self._heap)
+
+    def fire_next(self):
+        """Jump to the earliest deadline; return (event, handler)."""
+        deadline, _, event, handler = heapq.heappop(self._heap)
+        self.now = deadline
+        return event, handler
+
+
+@dataclass
+class ScenarioRecord:
+    """A reproducible account of one simulated run
+    (reference: Scenario struct, replica_test.go:850-860)."""
+
+    seed: int
+    n: int
+    f: int
+    target_height: Height
+    signatories: list[bytes] = field(default_factory=list)
+    #: Every delivered (to, message) in delivery order.
+    messages: list[tuple[int, object]] = field(default_factory=list)
+
+    def marshal(self, w: Writer) -> None:
+        w.u64(self.seed)
+        w.u32(self.n)
+        w.u32(self.f)
+        w.i64(self.target_height)
+        w.u32(len(self.signatories))
+        for s in self.signatories:
+            w.bytes32(s)
+        w.u32(len(self.messages))
+        for to, msg in self.messages:
+            w.u32(to)
+            marshal_message(msg, w)
+
+    @classmethod
+    def unmarshal(cls, r: Reader) -> "ScenarioRecord":
+        rec = cls(seed=r.u64(), n=r.u32(), f=r.u32(), target_height=r.i64())
+        nsigs = r.u32()
+        if nsigs > 1 << 20:
+            raise SerdeError("signatory count too large")
+        rec.signatories = [r.bytes32() for _ in range(nsigs)]
+        nmsgs = r.u32()
+        if nmsgs > 1 << 24:
+            raise SerdeError("message count too large")
+        rec.messages = [(r.u32(), unmarshal_message(r)) for _ in range(nmsgs)]
+        return rec
+
+    def dump(self, path: str) -> None:
+        w = Writer(rem=1 << 30)
+        self.marshal(w)
+        with open(path, "wb") as fh:
+            fh.write(w.data())
+
+    @classmethod
+    def load(cls, path: str) -> "ScenarioRecord":
+        with open(path, "rb") as fh:
+            return cls.unmarshal(Reader(fh.read(), rem=1 << 30))
+
+
+@dataclass
+class SimulationResult:
+    completed: bool
+    steps: int
+    virtual_time: float
+    heights: list[Height]
+    commits: list[dict[Height, Value]]
+    record: ScenarioRecord
+    alive: list[bool]
+
+    def assert_safety(self) -> None:
+        """All replicas — including ones that later died — must agree
+        byte-for-byte wherever their commit maps overlap (reference
+        assertion: replica_test.go:418-423). A dead replica's commits from
+        before its death are still evidence: a fork committed pre-kill must
+        fail the check."""
+        maps = self.commits
+        for h in set().union(*[set(c) for c in maps]) if maps else ():
+            vals = {c[h] for c in maps if h in c}
+            assert len(vals) <= 1, f"safety violation at height {h}: {vals}"
+
+
+class Simulation:
+    """Build and run one n-replica scenario."""
+
+    def __init__(
+        self,
+        n: int,
+        target_height: Height,
+        seed: int = 1,
+        timeout: float = 1.0,
+        timeout_scaling: float = 0.5,
+        max_capacity: int = 1000,
+        reorder: bool = False,
+        drop_rate: float = 0.0,
+        kill_at_step: Optional[dict[int, int]] = None,
+        offline: Optional[set[int]] = None,
+        byzantine_proposer: Optional[dict[int, Callable[[Height, int], Value]]] = None,
+        byzantine_validator: Optional[dict[int, Callable[[Height, int, Value], bool]]] = None,
+        verifier_for: Optional[Callable[[int], object]] = None,
+        signatories: Optional[list[bytes]] = None,
+    ):
+        self.n = n
+        self.f = n // 3
+        self.target_height = target_height
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.reorder = reorder
+        self.drop_rate = drop_rate
+        self.kill_at_step = dict(kill_at_step or {})
+        self.offline = set(offline or ())
+        self.clock = VirtualClock()
+        # The delivery queue is consumed via a head index (O(1) per step;
+        # list.pop(0) would make 256-replica x 10k-height runs quadratic).
+        self.queue: list[tuple[int, object]] = []
+        self._qhead = 0
+        self.record = ScenarioRecord(
+            seed=seed, n=n, f=self.f, target_height=target_height
+        )
+
+        self.signatories = signatories or [
+            hashlib.sha256(b"sim-replica-%d-%d" % (seed, i)).digest()
+            for i in range(n)
+        ]
+        self.record.signatories = list(self.signatories)
+        self.commits: list[dict[Height, Value]] = [dict() for _ in range(n)]
+        self.alive = [i not in self.offline for i in range(n)]
+        self.caught: list[tuple[str, int]] = []
+
+        byz_prop = byzantine_proposer or {}
+        byz_val = byzantine_validator or {}
+
+        self.replicas: list[Replica] = []
+        for i in range(n):
+            self.replicas.append(
+                self._build_replica(
+                    i,
+                    timeout,
+                    timeout_scaling,
+                    max_capacity,
+                    byz_prop.get(i),
+                    byz_val.get(i),
+                    verifier_for(i) if verifier_for else None,
+                )
+            )
+
+    # ------------------------------------------------------------- wiring
+
+    def _default_value(self, height: Height, round_: int) -> Value:
+        return hashlib.sha256(
+            b"value-%d-%d-%d" % (self.seed, height, round_)
+        ).digest()
+
+    def _build_replica(
+        self, i, timeout, scaling, capacity, byz_proposer, byz_validator, verifier
+    ) -> Replica:
+        def bcast(msg):
+            # Broadcast to all, including self (reference: 174-208).
+            for j in range(self.n):
+                self.queue.append((j, msg))
+
+        # The owned clock tags each scheduled timeout with its owner index so
+        # the delivery queue can route the fired event back to that replica.
+        timer = VirtualTimer(
+            _OwnedClock(self.clock, i),
+            handler=None,
+            timeout=timeout,
+            timeout_scaling=scaling,
+        )
+
+        return Replica(
+            ReplicaOptions(max_capacity=capacity),
+            self.signatories[i],
+            list(self.signatories),
+            timer,
+            MockProposer(fn=byz_proposer or self._default_value),
+            MockValidator(fn=byz_validator) if byz_validator else MockValidator(ok=True),
+            CommitterCallback(
+                on_commit=lambda h, v, i=i: (
+                    self.commits[i].__setitem__(h, v),
+                    (0, None),
+                )[1]
+            ),
+            CatcherCallbacks(
+                on_double_propose=lambda a, b, i=i: self.caught.append(("double_propose", i)),
+                on_double_prevote=lambda a, b, i=i: self.caught.append(("double_prevote", i)),
+                on_double_precommit=lambda a, b, i=i: self.caught.append(("double_precommit", i)),
+                on_out_of_turn_propose=lambda p, i=i: self.caught.append(("out_of_turn", i)),
+            ),
+            BroadcasterCallbacks(
+                on_propose=bcast, on_prevote=bcast, on_precommit=bcast
+            ),
+            verifier=verifier,
+        )
+
+    # -------------------------------------------------------------- running
+
+    def _completed(self) -> bool:
+        return all(
+            not alive or r.current_height() > self.target_height
+            for r, alive in zip(self.replicas, self.alive)
+        )
+
+    def run(self, max_steps: int = 2_000_000) -> SimulationResult:
+        for i, r in enumerate(self.replicas):
+            if self.alive[i]:
+                r.start()
+
+        steps = 0
+        while steps < max_steps and not self._completed():
+            if self._qhead >= len(self.queue):
+                # Network drained: advance virtual time to the next timeout.
+                if self.clock.pending() == 0:
+                    break  # genuine stall — nothing can ever happen again
+                event, owner = self.clock.fire_next()
+                self.queue.append((owner, event))
+                continue
+
+            if self.reorder:
+                # Swap a random remaining entry to the head — O(1) and the
+                # chosen delivery order is recorded, so replay is exact.
+                idx = self.rng.randrange(self._qhead, len(self.queue))
+                self.queue[self._qhead], self.queue[idx] = (
+                    self.queue[idx],
+                    self.queue[self._qhead],
+                )
+            to, msg = self.queue[self._qhead]
+            self._qhead += 1
+            if self._qhead > 8192 and self._qhead * 2 > len(self.queue):
+                del self.queue[: self._qhead]
+                self._qhead = 0
+            steps += 1
+
+            if self.drop_rate and not isinstance(msg, Timeout):
+                if self.rng.random() < self.drop_rate:
+                    continue
+            if self.kill_at_step:
+                for victim, at in list(self.kill_at_step.items()):
+                    if steps >= at and self.alive[victim]:
+                        self.alive[victim] = False
+            if not self.alive[to]:
+                continue
+
+            self.record.messages.append((to, msg))
+            self.replicas[to].handle(msg)
+
+        return SimulationResult(
+            completed=self._completed(),
+            steps=steps,
+            virtual_time=self.clock.now,
+            heights=[r.current_height() for r in self.replicas],
+            commits=self.commits,
+            record=self.record,
+            alive=self.alive,
+        )
+
+    # -------------------------------------------------------------- replay
+
+    @classmethod
+    def replay(cls, record: ScenarioRecord, **kwargs) -> SimulationResult:
+        """Re-deliver a recorded interleaving message-for-message
+        (reference: replay(), replica_test.go:325-370).
+
+        The replayed network uses the recorded signatories and delivers only
+        the recorded messages — no clock, no adversary — so a dumped failure
+        reproduces exactly.
+        """
+        sim = cls(
+            n=record.n,
+            target_height=record.target_height,
+            seed=record.seed,
+            signatories=list(record.signatories),
+            **kwargs,
+        )
+        for i, r in enumerate(sim.replicas):
+            if sim.alive[i]:
+                r.start()
+        sim.queue.clear()
+        sim._qhead = 0
+        steps = 0
+        for to, msg in record.messages:
+            if not sim.alive[to]:
+                continue
+            sim.replicas[to].handle(msg)
+            steps += 1
+        return SimulationResult(
+            completed=sim._completed(),
+            steps=steps,
+            virtual_time=sim.clock.now,
+            heights=[r.current_height() for r in sim.replicas],
+            commits=sim.commits,
+            record=record,
+            alive=sim.alive,
+        )
+
+
+class _OwnedClock:
+    """Wraps the shared clock so fired timeouts carry their owner index."""
+
+    __slots__ = ("_clock", "_owner")
+
+    def __init__(self, clock: VirtualClock, owner: int):
+        self._clock = clock
+        self._owner = owner
+
+    def schedule(self, delay: float, event, handler) -> None:
+        self._clock.schedule(delay, event, self._owner)

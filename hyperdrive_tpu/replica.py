@@ -153,6 +153,11 @@ class Replica:
         try:
             while self._pending:
                 self._handle_one(self._pending.popleft())
+        except BaseException:
+            # A failing callback aborts the cascade; the undelivered tail
+            # would otherwise leak into the next unrelated handle() call.
+            self._pending.clear()
+            raise
         finally:
             self._handling = False
 
