@@ -169,3 +169,11 @@ with tempfile.TemporaryDirectory() as d:
     assert replayed.commits == res.commits
 print(f"PASS: harness 10-replica reorder run to height 10 in {res.steps} steps "
       f"({res.virtual_time:.1f}s virtual), dump+replay identical")
+
+# --- probe 6: signed consensus end-to-end (Ed25519 host path) ----------
+sim = Simulation(n=4, target_height=3, seed=101, sign=True)
+res = sim.run()
+assert res.completed, f"signed run stalled at {res.heights}"
+res.assert_safety()
+print(f"PASS: Ed25519-signed 4-replica consensus to height 3 "
+      f"({res.steps} verified deliveries)")

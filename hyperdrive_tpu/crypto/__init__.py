@@ -1,0 +1,26 @@
+"""Host-side cryptography: Ed25519 identity/signing and Shamir sharing.
+
+The reference delegates all signing/verification to ``renproject/id``
+(secp256k1 ECDSA + Keccak) and *assumes* messages are authenticated before
+they reach the library (reference: process/process.go:95-98). This
+framework makes authentication first-class and chooses Ed25519: the curve
+arithmetic batches cleanly onto TPU int32 lanes
+(:mod:`hyperdrive_tpu.ops.ed25519_jax`), and this module provides the
+bit-exact host implementation that the device kernels are differentially
+tested against.
+"""
+
+from hyperdrive_tpu.crypto.ed25519 import (
+    public_key_from_seed,
+    sign,
+    verify,
+)
+from hyperdrive_tpu.crypto.keys import KeyPair, KeyRing
+
+__all__ = [
+    "KeyPair",
+    "KeyRing",
+    "public_key_from_seed",
+    "sign",
+    "verify",
+]

@@ -88,7 +88,14 @@ class ScenarioRecord:
     #: Every delivered (to, message) in delivery order.
     messages: list[tuple[int, object]] = field(default_factory=list)
 
+    #: Format magic+version; bump on any envelope/layout change so stale
+    #: dumps are rejected with a clear error instead of desynchronizing.
+    MAGIC = 0x48594456  # "HYDV"
+    VERSION = 2
+
     def marshal(self, w: Writer) -> None:
+        w.u32(self.MAGIC)
+        w.u32(self.VERSION)
         w.u64(self.seed)
         w.u32(self.n)
         w.u32(self.f)
@@ -103,6 +110,15 @@ class ScenarioRecord:
 
     @classmethod
     def unmarshal(cls, r: Reader) -> "ScenarioRecord":
+        magic = r.u32()
+        if magic != cls.MAGIC:
+            raise SerdeError(f"not a scenario dump (magic {magic:#x})")
+        version = r.u32()
+        if version != cls.VERSION:
+            raise SerdeError(
+                f"scenario dump version {version} unsupported "
+                f"(expected {cls.VERSION})"
+            )
         rec = cls(seed=r.u64(), n=r.u32(), f=r.u32(), target_height=r.i64())
         nsigs = r.u32()
         if nsigs > 1 << 20:
@@ -167,7 +183,13 @@ class Simulation:
         byzantine_validator: Optional[dict[int, Callable[[Height, int, Value], bool]]] = None,
         verifier_for: Optional[Callable[[int], object]] = None,
         signatories: Optional[list[bytes]] = None,
+        sign: bool = False,
     ):
+        """``sign=True`` gives every replica a deterministic Ed25519 keypair
+        (identity = public key), signs every broadcast message, and installs
+        a :class:`~hyperdrive_tpu.verifier.HostVerifier` on each replica
+        unless ``verifier_for`` overrides it — authenticated consensus end
+        to end, the host baseline of BASELINE.md config 4."""
         self.n = n
         self.f = n // 3
         self.target_height = target_height
@@ -186,10 +208,27 @@ class Simulation:
             seed=seed, n=n, f=self.f, target_height=target_height
         )
 
-        self.signatories = signatories or [
-            hashlib.sha256(b"sim-replica-%d-%d" % (seed, i)).digest()
-            for i in range(n)
-        ]
+        self.ring = None
+        if sign:
+            from hyperdrive_tpu.crypto.keys import KeyRing
+            from hyperdrive_tpu.verifier import HostVerifier
+
+            self.ring = KeyRing.deterministic(n, namespace=b"sim-%d" % seed)
+            if signatories is not None and signatories != self.ring.signatories:
+                raise ValueError(
+                    "sign=True derives identities from the keyring; a "
+                    "signatories override that differs from the ring's "
+                    "public keys would make every signature verification "
+                    "fail (replay a signed dump with the same seed instead)"
+                )
+            self.signatories = self.ring.signatories
+            if verifier_for is None:
+                verifier_for = lambda i: HostVerifier()  # noqa: E731
+        else:
+            self.signatories = signatories or [
+                hashlib.sha256(b"sim-replica-%d-%d" % (seed, i)).digest()
+                for i in range(n)
+            ]
         self.record.signatories = list(self.signatories)
         self.commits: list[dict[Height, Value]] = [dict() for _ in range(n)]
         self.alive = [i not in self.offline for i in range(n)]
@@ -222,8 +261,14 @@ class Simulation:
     def _build_replica(
         self, i, timeout, scaling, capacity, byz_proposer, byz_validator, verifier
     ) -> Replica:
+        keypair = self.ring[i] if self.ring is not None else None
+
         def bcast(msg):
-            # Broadcast to all, including self (reference: 174-208).
+            # Broadcast to all, including self (reference: 174-208). In
+            # signed mode the sender attaches its detached signature here —
+            # the outbound edge of the replica, like a real wire stack.
+            if keypair is not None:
+                msg = keypair.sign_message(msg)
             for j in range(self.n):
                 self.queue.append((j, msg))
 

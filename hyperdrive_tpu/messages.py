@@ -231,12 +231,17 @@ _TAG_CLASSES = {
 
 
 def marshal_message(msg, w: Writer) -> None:
-    """Marshal any message with a leading type tag (for scenario records)."""
+    """Marshal any message with a leading type tag (the wire envelope used
+    by scenario records). Unlike the core message serde, the envelope also
+    carries the detached signature — on a real wire the signature travels
+    with the message."""
     tag = _TYPE_TAGS.get(type(msg))
     if tag is None:
         raise SerdeError(f"unknown message type: {type(msg)!r}")
     w.i8(int(tag))
     msg.marshal(w)
+    if not isinstance(msg, Timeout):
+        w.raw(msg.signature)
 
 
 def unmarshal_message(r: Reader):
@@ -246,4 +251,9 @@ def unmarshal_message(r: Reader):
         cls = _TAG_CLASSES[MessageType(ty)]
     except (ValueError, KeyError) as e:
         raise SerdeError(f"invalid message tag: {ty}") from e
-    return cls.unmarshal(r)
+    msg = cls.unmarshal(r)
+    if cls is not Timeout:
+        signature = r.raw()
+        if signature:
+            msg = msg.with_signature(signature)
+    return msg

@@ -1,0 +1,49 @@
+"""Pluggable vote-signature verification.
+
+The reference assumes authentication happens outside the library
+(process/process.go:95-98, mq/mq.go:85-86). This framework makes it a
+first-class, injectable seam on the replica's drain loop: a Verifier
+receives a whole window of queued messages and returns an accept mask.
+
+- :class:`NullVerifier` — accept everything; byte-compatible with the
+  reference's trust model (authentication fully external). The default.
+- :class:`HostVerifier` — per-message Ed25519 verification on the host,
+  the "pure-host path" the benchmarks baseline against.
+- The TPU batch verifier lives in :mod:`hyperdrive_tpu.ops.ed25519_jax`
+  and satisfies the same protocol; host and device verifiers must agree
+  accept/reject bit-for-bit (differentially tested).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence, runtime_checkable
+
+from hyperdrive_tpu.crypto import ed25519
+
+__all__ = ["Verifier", "NullVerifier", "HostVerifier"]
+
+
+@runtime_checkable
+class Verifier(Protocol):
+    def verify_batch(self, window: Sequence) -> Sequence[bool]:
+        """Return one accept/reject per message in the window."""
+        ...
+
+
+class NullVerifier:
+    """Trusts the transport (the reference's model)."""
+
+    def verify_batch(self, window):
+        return [True] * len(window)
+
+
+class HostVerifier:
+    """Sequential host-side Ed25519 verification of each message's detached
+    signature, with the sender's public key as the verification key."""
+
+    def verify_batch(self, window):
+        return [
+            bool(msg.signature)
+            and ed25519.verify(msg.sender, msg.digest(), msg.signature)
+            for msg in window
+        ]

@@ -1,0 +1,128 @@
+"""Ed25519 host path: RFC 8032 test vectors, roundtrips, rejection cases."""
+
+import pytest
+
+from hyperdrive_tpu.crypto import ed25519
+from hyperdrive_tpu.crypto.keys import KeyPair, KeyRing
+
+# RFC 8032 section 7.1 test vectors.
+VECTORS = [
+    # (seed, public, message, signature)
+    (
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60",
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+        "",
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b",
+    ),
+    (
+        "4ccd089b28ff96da9db6c346ec114e0f5b8a319f35aba624da8cf6ed4fb8a6fb",
+        "3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+        "72",
+        "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+        "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00",
+    ),
+    (
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7",
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+        "af82",
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a",
+    ),
+]
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", VECTORS)
+def test_rfc8032_public_key_derivation(seed, pub, msg, sig):
+    assert ed25519.public_key_from_seed(bytes.fromhex(seed)).hex() == pub
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", VECTORS)
+def test_rfc8032_signatures(seed, pub, msg, sig):
+    got = ed25519.sign(bytes.fromhex(seed), bytes.fromhex(msg))
+    assert got.hex() == sig
+
+
+@pytest.mark.parametrize("seed,pub,msg,sig", VECTORS)
+def test_rfc8032_verification(seed, pub, msg, sig):
+    assert ed25519.verify(
+        bytes.fromhex(pub), bytes.fromhex(msg), bytes.fromhex(sig)
+    )
+
+
+def test_sign_verify_roundtrip(rng):
+    for _ in range(5):
+        seed = rng.randbytes(32)
+        pub = ed25519.public_key_from_seed(seed)
+        msg = rng.randbytes(rng.randint(0, 100))
+        sig = ed25519.sign(seed, msg)
+        assert ed25519.verify(pub, msg, sig)
+
+
+def test_modified_message_rejected(rng):
+    seed = rng.randbytes(32)
+    pub = ed25519.public_key_from_seed(seed)
+    sig = ed25519.sign(seed, b"hello")
+    assert not ed25519.verify(pub, b"hellp", sig)
+
+
+def test_modified_signature_rejected(rng):
+    seed = rng.randbytes(32)
+    pub = ed25519.public_key_from_seed(seed)
+    sig = bytearray(ed25519.sign(seed, b"hello"))
+    sig[0] ^= 1
+    assert not ed25519.verify(pub, b"hello", bytes(sig))
+
+
+def test_wrong_key_rejected(rng):
+    seed = rng.randbytes(32)
+    other = ed25519.public_key_from_seed(rng.randbytes(32))
+    sig = ed25519.sign(seed, b"hello")
+    assert not ed25519.verify(other, b"hello", sig)
+
+
+def test_high_s_rejected(rng):
+    # Malleability guard: s >= L must be rejected (RFC 8032 5.1.7).
+    seed = rng.randbytes(32)
+    pub = ed25519.public_key_from_seed(seed)
+    sig = ed25519.sign(seed, b"m")
+    s = int.from_bytes(sig[32:], "little")
+    forged = sig[:32] + int.to_bytes(s + ed25519.L, 32, "little")
+    assert not ed25519.verify(pub, b"m", forged)
+
+
+def test_invalid_point_rejected():
+    assert not ed25519.verify(b"\xff" * 32, b"m", b"\x00" * 64)
+    assert ed25519.point_decompress(b"\xff" * 32) is None
+
+
+def test_malformed_lengths_rejected():
+    assert not ed25519.verify(b"\x00" * 31, b"m", b"\x00" * 64)
+    assert not ed25519.verify(b"\x00" * 32, b"m", b"\x00" * 63)
+
+
+def test_keypair_and_keyring():
+    ring = KeyRing.deterministic(4)
+    assert len(ring) == 4
+    assert len(set(ring.signatories)) == 4
+    kp = ring[0]
+    assert ring.by_signatory[kp.public] is kp
+    # Deterministic: same tag, same key.
+    assert KeyPair.deterministic(b"hyperdrive-0").public == kp.public
+
+
+def test_signed_consensus_message_verifies():
+    from hyperdrive_tpu.messages import Prevote
+    from hyperdrive_tpu.verifier import HostVerifier, NullVerifier
+
+    ring = KeyRing.deterministic(2)
+    pv = Prevote(height=1, round=0, value=b"\x01" * 32, sender=ring[0].public)
+    signed = ring[0].sign_message(pv)
+    hv = HostVerifier()
+    assert hv.verify_batch([signed]) == [True]
+    # Unsigned or wrong-sender messages fail.
+    assert hv.verify_batch([pv]) == [False]
+    imposter = Prevote(height=1, round=0, value=b"\x01" * 32,
+                       sender=ring[1].public).with_signature(signed.signature)
+    assert hv.verify_batch([imposter]) == [False]
+    assert NullVerifier().verify_batch([pv, signed]) == [True, True]
