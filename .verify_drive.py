@@ -177,3 +177,17 @@ assert res.completed, f"signed run stalled at {res.heights}"
 res.assert_safety()
 print(f"PASS: Ed25519-signed 4-replica consensus to height 3 "
       f"({res.steps} verified deliveries)")
+
+# --- probe 7: TPU/device batch verifier in the consensus loop ----------
+# (runs on whatever backend this process has; tests force CPU, a bare
+# invocation uses the real chip)
+from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+shared = TpuBatchVerifier(buckets=(64,))
+sim = Simulation(n=4, target_height=2, seed=202, sign=True,
+                 verifier_for=lambda i: shared)
+res = sim.run()
+assert res.completed, f"device-verified run stalled at {res.heights}"
+res.assert_safety()
+print(f"PASS: consensus with batched device verifier to height 2 "
+      f"({res.steps} deliveries)")

@@ -1,0 +1,123 @@
+"""Benchmark: votes verified per second on one TPU chip, 256 validators.
+
+The north-star metric (BASELINE.md): batched Ed25519 verification of
+consensus votes — 256 validators' signatures over vote digests, verified
+in wide batches fused with the quorum tally — target >= 50k votes/sec on
+one v5e chip.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hyperdrive_tpu.crypto import ed25519 as host_ed
+from hyperdrive_tpu.crypto.keys import KeyRing
+from hyperdrive_tpu.messages import Prevote
+from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost, verify_kernel
+from hyperdrive_tpu.ops.tally import pack_values, tally_counts, quorum_flags
+
+N_VALIDATORS = 256
+ROUNDS = 16  # in-flight (height, round) pairs per launch
+BATCH = N_VALIDATORS * ROUNDS  # 4096 signatures per device launch
+TARGET_VOTES_PER_SEC = 50_000.0
+
+
+def build_batch():
+    """256 validators each sign one prevote per round; rounds tile the
+    batch so packing cost stays small while the device sees 4096 distinct
+    (pubkey, digest, signature) lanes."""
+    ring = KeyRing.deterministic(N_VALIDATORS, namespace=b"bench")
+    value = b"\x2a" * 32
+    items = []
+    base_msgs = []
+    for v in range(N_VALIDATORS):
+        pv = Prevote(height=1, round=0, value=value, sender=ring[v].public)
+        digest = pv.digest()
+        sig = host_ed.sign(ring[v].seed, digest)
+        base_msgs.append((ring[v].public, digest, sig))
+    for r in range(ROUNDS):
+        items.extend(base_msgs)
+
+    host = Ed25519BatchHost(buckets=(BATCH,))
+    arrays, prevalid, n = host.pack(items)
+    assert n == BATCH and prevalid.all()
+
+    vote_vals = jnp.asarray(
+        np.broadcast_to(
+            pack_values([value])[0], (ROUNDS, N_VALIDATORS, 8)
+        ).copy()
+    )
+    target_vals = jnp.asarray(pack_values([value] * ROUNDS))
+    return tuple(jnp.asarray(a) for a in arrays), vote_vals, target_vals
+
+
+@jax.jit
+def step(ax, ay, at, rx, ry, s_nib, k_nib, vote_vals, target_vals, f):
+    ok = verify_kernel(ax, ay, at, rx, ry, s_nib, k_nib)
+    counts = tally_counts(vote_vals, ok.reshape(ROUNDS, N_VALIDATORS), target_vals)
+    flags = quorum_flags(counts, f)
+    return ok, counts, flags
+
+
+def main():
+    t0 = time.time()
+    arrays, vote_vals, target_vals = build_batch()
+    f = jnp.int32(N_VALIDATORS // 3)
+    pack_s = time.time() - t0
+
+    # Warmup / compile.
+    ok, counts, flags = step(*arrays, vote_vals, target_vals, f)
+    ok.block_until_ready()
+    if not bool(np.asarray(ok).all()):
+        print(
+            json.dumps(
+                {
+                    "metric": "votes verified/sec/chip @256 validators",
+                    "value": 0.0,
+                    "unit": "votes/s",
+                    "vs_baseline": 0.0,
+                    "error": "verification kernel rejected valid signatures",
+                }
+            )
+        )
+        sys.exit(1)
+    assert bool(np.asarray(flags["quorum_matching"]).all())
+
+    # Steady state.
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ok, counts, flags = step(*arrays, vote_vals, target_vals, f)
+    ok.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    votes_per_sec = BATCH * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "votes verified/sec/chip @256 validators",
+                "value": round(votes_per_sec, 1),
+                "unit": "votes/s",
+                "vs_baseline": round(votes_per_sec / TARGET_VOTES_PER_SEC, 4),
+                "batch": BATCH,
+                "iters": iters,
+                "seconds": round(dt, 4),
+                "host_pack_seconds": round(pack_s, 2),
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

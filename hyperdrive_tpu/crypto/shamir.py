@@ -1,0 +1,116 @@
+"""Shamir secret sharing over GF(2^255 - 19) (host reference path).
+
+The framework's MPC-payload capability (BASELINE.md config 5): committed
+values can carry k-of-n secret-shared payloads which replicas reconstruct
+per committed block. The field is the same GF(2^255-19) the signature
+kernels use, so the device path (:mod:`hyperdrive_tpu.ops.shamir`) reuses
+the limb arithmetic; this module is the bignum oracle it is tested against.
+
+Payload blocks are 31 bytes: every 31-byte string is < 2^248 < p, so
+packing is injective and padding-free.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from hyperdrive_tpu.crypto.ed25519 import P
+
+__all__ = [
+    "BLOCK_BYTES",
+    "split_block",
+    "reconstruct_block",
+    "lagrange_coeffs_at_zero",
+    "split_payload",
+    "reconstruct_payload",
+]
+
+BLOCK_BYTES = 31
+
+
+def _poly_eval(coeffs: list[int], x: int) -> int:
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % P
+    return acc
+
+
+def _det_coeff(tag: bytes, i: int) -> int:
+    """Deterministic coefficient derivation (keeps the harness seedable)."""
+    return int.from_bytes(hashlib.sha512(tag + i.to_bytes(4, "little")).digest(), "little") % P
+
+
+def split_block(secret: int, k: int, n: int, tag: bytes = b"") -> list[tuple[int, int]]:
+    """Split ``secret`` (< p) into n shares, any k of which reconstruct.
+
+    Shares are (x, y) with x = 1..n. Coefficients derive deterministically
+    from ``tag`` so tests and scenario replays are reproducible; pass a
+    random tag for real secrecy.
+    """
+    if not 0 <= secret < P:
+        raise ValueError("secret out of field range")
+    if not 1 <= k <= n:
+        raise ValueError("need 1 <= k <= n")
+    coeffs = [secret] + [_det_coeff(tag, i) for i in range(1, k)]
+    return [(x, _poly_eval(coeffs, x)) for x in range(1, n + 1)]
+
+
+def lagrange_coeffs_at_zero(xs: list[int]) -> list[int]:
+    """lambda_i = prod_{j != i} x_j / (x_j - x_i) mod p — the interpolation
+    weights at 0 for the given share x-coordinates. Host-computed once per
+    share-set; the device kernel applies them across many blocks."""
+    lams = []
+    for i, xi in enumerate(xs):
+        num, den = 1, 1
+        for j, xj in enumerate(xs):
+            if i == j:
+                continue
+            num = (num * xj) % P
+            den = (den * (xj - xi)) % P
+        lams.append((num * pow(den, P - 2, P)) % P)
+    return lams
+
+
+def reconstruct_block(shares: list[tuple[int, int]]) -> int:
+    """Interpolate the secret from k (x, y) shares."""
+    xs = [x for x, _ in shares]
+    lams = lagrange_coeffs_at_zero(xs)
+    return sum(lam * y for lam, (_, y) in zip(lams, shares)) % P
+
+
+# ------------------------------------------------------- byte-payload API
+
+
+def split_payload(payload: bytes, k: int, n: int, tag: bytes = b"") -> list[list[tuple[int, int]]]:
+    """Split an arbitrary byte payload into per-block share lists.
+
+    The payload is chunked into 31-byte blocks (the final block keeps its
+    true length via a standard 0x80 pad)."""
+    padded = payload + b"\x80"
+    padded += b"\x00" * ((-len(padded)) % BLOCK_BYTES)
+    blocks = [
+        int.from_bytes(padded[i : i + BLOCK_BYTES], "little")
+        for i in range(0, len(padded), BLOCK_BYTES)
+    ]
+    return [
+        split_block(b, k, n, tag=tag + i.to_bytes(4, "little"))
+        for i, b in enumerate(blocks)
+    ]
+
+
+def unpad_payload(out: bytes) -> bytes:
+    """Strip the 0x80 padding — shared by the host and device paths so the
+    two can never desynchronize."""
+    end = out.rstrip(b"\x00")
+    if not end.endswith(b"\x80"):
+        raise ValueError("invalid payload padding")
+    return end[:-1]
+
+
+def reconstruct_payload(block_shares: list[list[tuple[int, int]]]) -> bytes:
+    """Inverse of :func:`split_payload` given >= k shares per block."""
+    out = b"".join(
+        reconstruct_block(shares).to_bytes(BLOCK_BYTES, "little")
+        for shares in block_shares
+    )
+    return unpad_payload(out)

@@ -1,0 +1,18 @@
+"""TPU device kernels (JAX/XLA, Pallas where beneficial).
+
+The batchable numeric work of the consensus framework lives here:
+
+- :mod:`hyperdrive_tpu.ops.fe25519` — GF(2^255-19) arithmetic on int32
+  limb vectors, the foundation of everything below.
+- :mod:`hyperdrive_tpu.ops.ed25519_jax` — batched Ed25519 signature
+  verification (the Verifier's device backend).
+- :mod:`hyperdrive_tpu.ops.tally` — masked quorum-tally reductions over
+  vote tensors.
+- :mod:`hyperdrive_tpu.ops.shamir` — batched Shamir share reconstruction.
+
+TPU design notes: there is no 64-bit integer multiply on the VPU, so field
+elements are 20 limbs x 13 bits in int32 — limb products are < 2^26 and a
+full 20-term column sum stays < 2^31 (no overflow), giving schoolbook
+multiplication entirely in int32 lanes. All functions are shaped
+``[..., 20]`` and are jit/vmap/shard_map-transparent.
+"""

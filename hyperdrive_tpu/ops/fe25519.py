@@ -1,0 +1,290 @@
+"""GF(2^255 - 19) arithmetic on int32 limb vectors (TPU-native).
+
+Design (SURVEY.md section 7.3 hard part #1): TPUs have no 64-bit integer
+multiply, so a field element is **20 limbs of 13 bits** in int32, value =
+sum(l_i * 2^(13 i)), capacity 260 bits. With normalized limbs (< 2^13):
+
+- a limb product is < 2^26, and a schoolbook column accumulates at most 20
+  products, staying < 2^30.4 — comfortably inside int32. (Normalization
+  leaves up to 2^10 of slack on low limbs — the micro-ripple after a fold
+  is single-step — so the worst real bound is 20 * (2^13 + 2^10)^2 < 2^31,
+  still safe);
+- 2^260 = 608 (mod p), so columns 20..39 of a product fold back into
+  columns 0..19 with a single multiply by 608;
+- bits 255..259 fold with a multiply by 19 (2^255 = 19 mod p), which keeps
+  every public result under the invariant **value < 2^256** with all limbs
+  in [0, 2^13).
+
+Every function operates on arrays shaped ``[..., 20]`` (any batch prefix),
+contains only static shapes and static Python loops over limb indices, and
+is transparent to jit/vmap/shard_map. Negative intermediates (subtraction)
+are handled by signed carries: numpy/XLA right-shift on int32 is
+arithmetic, so ``c >> 13`` is a floor division and ``c & 0x1FFF`` is the
+non-negative residue.
+
+The Python-int reference for every operation is the host crypto module
+(:mod:`hyperdrive_tpu.crypto.ed25519`); differential tests enforce exact
+agreement.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "N_LIMBS",
+    "LIMB_BITS",
+    "LIMB_MASK",
+    "P_INT",
+    "to_limbs",
+    "from_limbs",
+    "zeros_like_batch",
+    "add",
+    "sub",
+    "neg",
+    "mul",
+    "sqr",
+    "mul_small",
+    "inv",
+    "canonical",
+    "eq",
+    "is_zero",
+    "select",
+    "ZERO",
+    "ONE",
+]
+
+N_LIMBS = 20
+LIMB_BITS = 13
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+P_INT = 2**255 - 19
+#: 2^260 mod p — the fold factor for columns >= 20.
+FOLD_260 = 608
+#: 2^255 mod p — the fold factor for bits >= 255 inside limb 19.
+FOLD_255 = 19
+#: Bit position of 2^255 inside limb 19 (19 * 13 = 247; 255 - 247 = 8).
+TOP_SHIFT = 8
+TOP_MASK = (1 << TOP_SHIFT) - 1
+
+# 4p as limbs — the subtraction bias. Any operand < 2^256 < 4p, so
+# a + 4p - b is positive, and a + 4p - b < 2^256 + 4p < 2^260 fits.
+_FOUR_P = 4 * P_INT
+
+
+def to_limbs(x) -> np.ndarray:
+    """Python int(s) -> int32 limb array. Accepts a single int (-> shape
+    [20]) or any nested sequence of ints (-> shape [..., 20]). Values must
+    lie in [0, 2^260)."""
+    if isinstance(x, (int,)):
+        if not 0 <= x < 1 << 260:
+            raise ValueError("value out of limb range")
+        return np.array(
+            [(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(N_LIMBS)],
+            dtype=np.int32,
+        )
+    arr = [to_limbs(v) for v in x]
+    return np.stack(arr)
+
+
+def from_limbs(limbs) -> "int | list":
+    """Inverse of :func:`to_limbs` (host-side; accepts device arrays)."""
+    a = np.asarray(limbs)
+    if a.ndim == 1:
+        return sum(int(a[i]) << (LIMB_BITS * i) for i in range(N_LIMBS))
+    return [from_limbs(row) for row in a]
+
+
+ZERO = to_limbs(0)
+ONE = to_limbs(1)
+_P_LIMBS = to_limbs(P_INT)
+_FOUR_P_LIMBS = to_limbs(_FOUR_P)
+
+
+def zeros_like_batch(batch_shape) -> jnp.ndarray:
+    return jnp.zeros((*batch_shape, N_LIMBS), dtype=jnp.int32)
+
+
+# ------------------------------------------------------------------ carries
+
+
+def _carry(x: jnp.ndarray) -> jnp.ndarray:
+    """One full sequential carry pass: limbs -> [0, 2^13), returning the
+    final carry out of the top limb. Works for signed inputs (arithmetic
+    shift = floor division).
+
+    Implemented as a lax.scan along the limb axis so the traced graph is
+    one step deep — an unrolled 39-step chain inside a scalar-mult loop
+    made XLA compile times explode.
+    """
+    xs = jnp.moveaxis(x, -1, 0)  # [K, ...batch]
+
+    def step(carry, col):
+        c = col + carry
+        return c >> LIMB_BITS, c & LIMB_MASK
+
+    carry, cols = lax.scan(step, jnp.zeros_like(xs[0]), xs)
+    return jnp.moveaxis(cols, 0, -1), carry
+
+
+def _fold_carry_out(x: jnp.ndarray, carry: jnp.ndarray, factor: int) -> jnp.ndarray:
+    """Fold a (small) carry that left the top limb back into limb 0 with
+    the given modular factor, then ripple the micro-carry."""
+    x = x.at[..., 0].add(carry * factor)
+    # One micro ripple is enough: carry*factor < 2^23 adds at most 2^10
+    # carry units into limb 1, which has headroom.
+    c = x[..., 0]
+    x = x.at[..., 0].set(c & LIMB_MASK)
+    x = x.at[..., 1].add(c >> LIMB_BITS)
+    return x
+
+
+def _fold_top(x: jnp.ndarray) -> jnp.ndarray:
+    """Fold bits 255..259 (the high bits of limb 19) back via x19 -> 19 *
+    (x19 >> 8), establishing value < 2^256. Input limbs must be in
+    [0, 2^13) with no pending carry."""
+    hi = x[..., N_LIMBS - 1] >> TOP_SHIFT
+    x = x.at[..., N_LIMBS - 1].set(x[..., N_LIMBS - 1] & TOP_MASK)
+    x = x.at[..., 0].add(hi * FOLD_255)
+    c = x[..., 0]
+    x = x.at[..., 0].set(c & LIMB_MASK)
+    x = x.at[..., 1].add(c >> LIMB_BITS)
+    return x
+
+
+def _normalize(x: jnp.ndarray) -> jnp.ndarray:
+    """Carry + top-fold: limbs in [0, 2^13), value < 2^256."""
+    x, carry = _carry(x)
+    x = _fold_carry_out(x, carry, FOLD_260)
+    x = _fold_top(x)
+    return x
+
+
+# ---------------------------------------------------------------- operators
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a + b) mod-ish p: normalized, value < 2^256."""
+    return _normalize(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a - b) mod-ish p via the 4p bias (keeps everything non-negative
+    after carrying)."""
+    bias = jnp.asarray(_FOUR_P_LIMBS, dtype=jnp.int32)
+    return _normalize(a + bias - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    bias = jnp.asarray(_FOUR_P_LIMBS, dtype=jnp.int32)
+    return _normalize(bias - a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook product with modular folding. Inputs must be normalized
+    (limbs < 2^13); output is normalized with value < 2^256."""
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    cols = jnp.zeros((*batch, 2 * N_LIMBS - 1), dtype=jnp.int32)
+    for i in range(N_LIMBS):
+        # Column block i..i+19 accumulates a_i * b. Each product < 2^26;
+        # each column gathers at most 20 of them -> < 2^30.4, no overflow.
+        cols = cols.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
+
+    # Carry the 39 columns so every entry is < 2^13 before the x608 fold
+    # (folding unnormalized columns would overflow int32).
+    cols, carry = _carry(cols)  # carry is the virtual column 39
+
+    low = cols[..., :N_LIMBS]
+    high = cols[..., N_LIMBS:]  # columns 20..38
+    low = low.at[..., : N_LIMBS - 1].add(high * FOLD_260)
+    # Virtual column 39 folds to column 19 with the same factor.
+    low = low.at[..., 19].add(carry * FOLD_260)
+
+    low, carry = _carry(low)
+    low = _fold_carry_out(low, carry, FOLD_260)
+    return _fold_top(low)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small constant (k < 2^17 keeps products in int32)."""
+    if not 0 <= k < (1 << 17):
+        raise ValueError("constant too large for int32 limb products")
+    return _normalize(a * jnp.int32(k))
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """a^(p-2) via the standard curve25519 addition chain (254 squarings,
+    11 multiplies)."""
+
+    def nsqr(x, n):
+        # fori_loop keeps the traced graph one squaring deep instead of n
+        # deep — essential for compile times (n reaches 100 here).
+        if n < 4:
+            for _ in range(n):
+                x = sqr(x)
+            return x
+        return lax.fori_loop(0, n, lambda _, v: sqr(v), x)
+
+    z2 = sqr(a)  # 2
+    z8 = nsqr(z2, 2)  # 8
+    z9 = mul(a, z8)  # 9
+    z11 = mul(z2, z9)  # 11
+    z22 = sqr(z11)  # 22
+    z_5_0 = mul(z9, z22)  # 2^5 - 2^0
+    z_10_5 = nsqr(z_5_0, 5)
+    z_10_0 = mul(z_10_5, z_5_0)
+    z_20_10 = nsqr(z_10_0, 10)
+    z_20_0 = mul(z_20_10, z_10_0)
+    z_40_20 = nsqr(z_20_0, 20)
+    z_40_0 = mul(z_40_20, z_20_0)
+    z_50_10 = nsqr(z_40_0, 10)
+    z_50_0 = mul(z_50_10, z_10_0)
+    z_100_50 = nsqr(z_50_0, 50)
+    z_100_0 = mul(z_100_50, z_50_0)
+    z_200_100 = nsqr(z_100_0, 100)
+    z_200_0 = mul(z_200_100, z_100_0)
+    z_250_50 = nsqr(z_200_0, 50)
+    z_250_0 = mul(z_250_50, z_50_0)
+    z_255_5 = nsqr(z_250_0, 5)
+    return mul(z_255_5, z11)  # z^(2^255 - 21) = z^(p-2)
+
+
+# ------------------------------------------------------------- canonical
+
+
+def _cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    """Subtract p if x >= p (constant-time select)."""
+    p = jnp.asarray(_P_LIMBS, dtype=jnp.int32)
+    t = x - p
+    t, borrow = _carry(t)  # borrow < 0 iff x < p
+    keep = borrow < 0
+    return jnp.where(keep[..., None], x, t)
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce to the unique representative in [0, p)."""
+    x = _normalize(x)  # value < 2^256 < 2p + eps
+    x = _cond_sub_p(x)
+    x = _cond_sub_p(x)
+    return x
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field equality (handles redundant representations)."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise field-element select: mask ? a : b (mask shaped [...])."""
+    return jnp.where(mask[..., None], a, b)

@@ -1,0 +1,86 @@
+"""Batched Shamir reconstruction on device.
+
+Reconstructs many payload blocks at once: the Lagrange weights depend only
+on *which* k shares answered (host-computed once per share-set,
+:func:`hyperdrive_tpu.crypto.shamir.lagrange_coeffs_at_zero`); the device
+then computes ``secret_b = sum_i lambda_i * y_{i,b}`` for every block b —
+k field multiplies + adds over the whole block batch, on the same
+GF(2^255-19) limb kernels as signature verification (SURVEY.md 7.1(3)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hyperdrive_tpu.crypto import shamir as host_shamir
+from hyperdrive_tpu.ops import fe25519 as fe
+
+__all__ = ["reconstruct_kernel", "BatchReconstructor"]
+
+
+def reconstruct_kernel(y_shares: jnp.ndarray, lams: jnp.ndarray) -> jnp.ndarray:
+    """secrets[b] = sum_i lams[i] * y_shares[i, b]  (canonical form).
+
+    Args:
+      y_shares: [k, B, 20] int32 — share values per contributing share i
+        and block b.
+      lams:     [k, 20] int32 — Lagrange weights at zero.
+    Returns: [B, 20] canonical field elements.
+    """
+    k = y_shares.shape[0]
+    acc = jnp.zeros_like(y_shares[0])
+    for i in range(k):  # k is small and static — unrolled
+        acc = fe.add(acc, fe.mul(y_shares[i], lams[i][None, :]))
+    return fe.canonical(acc)
+
+
+class BatchReconstructor:
+    """Host wrapper: packs shares, runs the jitted kernel, unpacks bytes."""
+
+    def __init__(self):
+        self._fn = jax.jit(reconstruct_kernel)
+
+    def reconstruct_blocks(self, xs: list[int], y_blocks: list[list[int]]) -> list[int]:
+        """xs: the k share x-coordinates; y_blocks: [k][B] share values.
+
+        Returns the B reconstructed block secrets as ints.
+        """
+        lams = jnp.asarray(
+            fe.to_limbs(host_shamir.lagrange_coeffs_at_zero(xs))
+        )
+        y = jnp.asarray(fe.to_limbs(y_blocks))  # [k, B, 20]
+        out = np.asarray(self._fn(y, lams))
+        return [fe.from_limbs(row) for row in out]
+
+    def reconstruct_payload_shares(self, per_block_shares) -> bytes:
+        """per_block_shares: list over blocks of k (x, y) tuples from the
+        same k contributors per block. Device-batched equivalent of
+        :func:`hyperdrive_tpu.crypto.shamir.reconstruct_payload`.
+
+        Shares are sorted by x per block, and every block must come from
+        the same contributor set (one set of Lagrange weights covers the
+        whole batch) — mismatched sets raise instead of corrupting.
+        """
+        if not per_block_shares:
+            return b""
+        sorted_blocks = [sorted(shares) for shares in per_block_shares]
+        xs = [x for x, _ in sorted_blocks[0]]
+        for i, shares in enumerate(sorted_blocks):
+            if [x for x, _ in shares] != xs:
+                raise ValueError(
+                    f"block {i} has share x-coordinates "
+                    f"{[x for x, _ in shares]} != {xs}; all blocks must "
+                    "come from the same contributor set"
+                )
+        y_blocks = [
+            [shares[i][1] for shares in sorted_blocks]
+            for i in range(len(xs))
+        ]
+        secrets = self.reconstruct_blocks(xs, y_blocks)
+        out = b"".join(
+            s.to_bytes(host_shamir.BLOCK_BYTES, "little") for s in secrets
+        )
+        return host_shamir.unpad_payload(out)

@@ -1,0 +1,18 @@
+"""SPMD scaling over a jax.sharding.Mesh.
+
+The reference has no distributed communication backend of its own — the
+Broadcaster seam is the entire contract, and tests wire it to an in-memory
+queue (SURVEY.md section 2.3). The TPU-native equivalent: votes are
+tensors, so the wide work (signature verification + quorum tallies) shards
+across chips with ``shard_map`` and combines with XLA collectives over
+ICI/DCN, while the host network stays the control path exactly where the
+reference assumes an external network.
+"""
+
+from hyperdrive_tpu.parallel.mesh import (
+    make_mesh,
+    make_sharded_step,
+    sharded_verify_tally,
+)
+
+__all__ = ["make_mesh", "make_sharded_step", "sharded_verify_tally"]

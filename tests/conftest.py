@@ -10,12 +10,20 @@ import random
 
 import pytest
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The container exports JAX_PLATFORMS=axon and a sitecustomize that
+# re-registers the TPU plugin, so env vars alone don't stick — force the
+# platform through jax.config before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 
 @pytest.fixture

@@ -1,0 +1,108 @@
+"""Device Ed25519 verification: differential tests against the host oracle.
+
+The device kernel and the pure-Python RFC 8032 implementation must agree
+accept/reject on every input — valid signatures, corrupted signatures,
+wrong keys, malformed points, out-of-range scalars, unsigned messages.
+"""
+
+import numpy as np
+import pytest
+
+from hyperdrive_tpu.crypto import ed25519 as host_ed
+from hyperdrive_tpu.crypto.keys import KeyRing
+from hyperdrive_tpu.messages import Prevote
+from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+from hyperdrive_tpu.verifier import HostVerifier
+
+
+@pytest.fixture(scope="module")
+def verifier():
+    return TpuBatchVerifier(buckets=(16, 64))
+
+
+@pytest.fixture(scope="module")
+def ring():
+    return KeyRing.deterministic(8, namespace=b"devtest")
+
+
+def test_valid_signatures_accepted(verifier, ring, rng):
+    items = []
+    for i in range(10):
+        kp = ring[i % len(ring)]
+        msg = bytes([i]) * 24
+        items.append((kp.public, msg, host_ed.sign(kp.seed, msg)))
+    ok = verifier.verify_signatures(items)
+    assert ok.tolist() == [True] * 10
+
+
+def test_rejections_match_host(verifier, ring, rng):
+    kp = ring[0]
+    msg = b"attack at dawn"
+    sig = host_ed.sign(kp.seed, msg)
+
+    cases = [
+        (kp.public, msg, sig),  # valid
+        (kp.public, msg + b"!", sig),  # wrong message
+        (kp.public, msg, sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]),  # bad s
+        (kp.public, msg, bytes([sig[0] ^ 1]) + sig[1:]),  # bad R
+        (ring[1].public, msg, sig),  # wrong key
+        (b"\xff" * 32, msg, sig),  # invalid pubkey point
+        (kp.public, msg, b"\xff" * 32 + sig[32:]),  # invalid R point
+        (
+            kp.public,
+            msg,
+            sig[:32]
+            + int.to_bytes(
+                int.from_bytes(sig[32:], "little") + host_ed.L, 32, "little"
+            ),
+        ),  # s >= L (malleability)
+    ]
+    got = verifier.verify_signatures(cases).tolist()
+    want = [host_ed.verify(pub, m, s) for pub, m, s in cases]
+    assert got == want
+    assert want == [True] + [False] * 7
+
+
+def test_random_differential(verifier, ring, rng):
+    # Random mix of valid/corrupted; device must match host bit-for-bit.
+    items = []
+    for i in range(32):
+        kp = ring[rng.randrange(len(ring))]
+        msg = rng.randbytes(rng.randint(0, 64))
+        sig = host_ed.sign(kp.seed, msg)
+        roll = rng.random()
+        if roll < 0.3:
+            sig = bytearray(sig)
+            sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            sig = bytes(sig)
+        elif roll < 0.4:
+            msg = msg + b"x"
+        items.append((kp.public, msg, sig))
+    got = verifier.verify_signatures(items).tolist()
+    want = [host_ed.verify(p, m, s) for p, m, s in items]
+    assert got == want
+
+
+def test_batch_padding_buckets(verifier, ring):
+    # 1 item in a 16-bucket, 17 items in a 64-bucket: padding lanes must
+    # not leak into results.
+    kp = ring[0]
+    one = [(kp.public, b"m", host_ed.sign(kp.seed, b"m"))]
+    assert verifier.verify_signatures(one).tolist() == [True]
+    many = one * 17
+    assert verifier.verify_signatures(many).tolist() == [True] * 17
+
+
+def test_verifier_protocol_matches_host_verifier(verifier, ring):
+    hv = HostVerifier()
+    msgs = []
+    for i in range(6):
+        kp = ring[i]
+        pv = Prevote(height=1, round=0, value=bytes([i]) * 32, sender=kp.public)
+        if i % 3 == 0:
+            msgs.append(kp.sign_message(pv))  # valid
+        elif i % 3 == 1:
+            msgs.append(pv)  # unsigned
+        else:
+            msgs.append(pv.with_signature(b"\x01" * 64))  # garbage sig
+    assert verifier.verify_batch(msgs) == hv.verify_batch(msgs)

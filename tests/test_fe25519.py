@@ -1,0 +1,181 @@
+"""GF(2^255-19) limb arithmetic: differential tests against Python ints.
+
+Every operation must agree bit-for-bit with bignum arithmetic mod p, and
+every public result must satisfy the normalization invariant (limbs in
+[0, 2^13], value < 2^256).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperdrive_tpu.ops import fe25519 as fe
+
+P = fe.P_INT
+
+# Jitted wrappers: eager-mode dispatch of 60-op limb pipelines is ~100x
+# slower than compiled execution; tests go through these.
+jadd = jax.jit(fe.add)
+jsub = jax.jit(fe.sub)
+jmul = jax.jit(fe.mul)
+jinv = jax.jit(fe.inv)
+jcanon = jax.jit(fe.canonical)
+jmul_small = jax.jit(fe.mul_small, static_argnums=1)
+
+EDGE_VALUES = [
+    0,
+    1,
+    2,
+    19,
+    P - 1,
+    P,
+    P + 1,
+    2 * P - 1,
+    (1 << 255) - 1,
+    (1 << 256) - 1,
+    (1 << 255) + 12345,
+    0x0123456789ABCDEF_0123456789ABCDEF_0123456789ABCDEF_0123456789ABCDEF,
+]
+
+
+def rand_vals(rng, k):
+    return [rng.getrandbits(256) for _ in range(k)]
+
+
+def check_invariant(arr):
+    a = np.asarray(arr)
+    assert a.dtype == np.int32
+    assert (a >= 0).all()
+    # Normalized limbs carry up to 2^10 of fold slack (see module doc);
+    # 20 * (2^13 + 2^10)^2 still fits int32, so this is the real invariant.
+    assert (a <= (1 << 13) + (1 << 10)).all()
+    if a.ndim == 1:
+        assert fe.from_limbs(a) < 1 << 256
+    else:
+        flat = a.reshape(-1, fe.N_LIMBS)
+        for row in flat:
+            assert fe.from_limbs(row) < 1 << 256
+
+
+def test_to_from_roundtrip(rng):
+    for v in EDGE_VALUES + rand_vals(rng, 50):
+        v %= 1 << 260
+        assert fe.from_limbs(fe.to_limbs(v)) == v
+
+
+def test_add_matches_bignum(rng):
+    vals = EDGE_VALUES + rand_vals(rng, 30)
+    a = jnp.asarray(fe.to_limbs([x % (1 << 256) for x in vals]))
+    b = jnp.asarray(fe.to_limbs([(x * 7 + 13) % (1 << 256) for x in vals]))
+    out = jadd(a, b)
+    check_invariant(out)
+    for i, x in enumerate(vals):
+        got = fe.from_limbs(np.asarray(out)[i]) % P
+        want = ((x % (1 << 256)) + ((x * 7 + 13) % (1 << 256))) % P
+        assert got == want
+
+
+def test_sub_matches_bignum(rng):
+    vals = EDGE_VALUES + rand_vals(rng, 30)
+    other = [(x * 31 + 5) % (1 << 256) for x in vals]
+    a = jnp.asarray(fe.to_limbs([x % (1 << 256) for x in vals]))
+    b = jnp.asarray(fe.to_limbs(other))
+    out = jsub(a, b)
+    check_invariant(out)
+    for i, x in enumerate(vals):
+        got = fe.from_limbs(np.asarray(out)[i]) % P
+        want = ((x % (1 << 256)) - other[i]) % P
+        assert got == want
+
+
+def test_mul_matches_bignum(rng):
+    vals = EDGE_VALUES + rand_vals(rng, 30)
+    other = [(x * 131 + 7) % (1 << 256) for x in vals]
+    a = jnp.asarray(fe.to_limbs([x % (1 << 256) for x in vals]))
+    b = jnp.asarray(fe.to_limbs(other))
+    out = jmul(a, b)
+    check_invariant(out)
+    for i, x in enumerate(vals):
+        got = fe.from_limbs(np.asarray(out)[i]) % P
+        want = ((x % (1 << 256)) * other[i]) % P
+        assert got == want
+
+
+def test_mul_small_matches_bignum(rng):
+    vals = [v % (1 << 256) for v in EDGE_VALUES + rand_vals(rng, 10)]
+    a = jnp.asarray(fe.to_limbs(vals))
+    for k in (0, 1, 2, 19, 608, 121665, (1 << 17) - 1):
+        out = jmul_small(a, k)
+        check_invariant(out)
+        for i, x in enumerate(vals):
+            assert fe.from_limbs(np.asarray(out)[i]) % P == (x * k) % P
+
+
+def test_repeated_mul_stays_stable(rng):
+    # Invariant preservation over long chains (the scalar-mult workload).
+    x = rng.getrandbits(255) % P
+    a = jnp.asarray(fe.to_limbs(x))
+    acc_int = x
+    for _ in range(100):
+        a = jmul(a, a)
+        acc_int = (acc_int * acc_int) % P
+        check_invariant(a)
+    assert fe.from_limbs(np.asarray(jcanon(a))) == acc_int
+
+
+def test_inv_matches_fermat(rng):
+    vals = [v % P for v in rand_vals(rng, 5) + [1, 2, P - 1]]
+    a = jnp.asarray(fe.to_limbs(vals))
+    out = jinv(a)
+    check_invariant(out)
+    for i, x in enumerate(vals):
+        assert fe.from_limbs(np.asarray(out)[i]) % P == pow(x, P - 2, P)
+
+
+def test_canonical_full_reduction(rng):
+    vals = [v % (1 << 256) for v in EDGE_VALUES + rand_vals(rng, 30)]
+    a = jnp.asarray(fe.to_limbs(vals))
+    out = jcanon(a)
+    arr = np.asarray(out)
+    for i, x in enumerate(vals):
+        got = fe.from_limbs(arr[i])
+        assert got == x % P
+        assert got < P
+
+
+def test_eq_across_representations(rng):
+    x = rng.getrandbits(250)
+    a = jnp.asarray(fe.to_limbs(x))
+    b = jnp.asarray(fe.to_limbs(x + P))  # same element, different rep
+    c = jnp.asarray(fe.to_limbs((x + 1) % P))
+    assert bool(fe.eq(a, b))
+    assert not bool(fe.eq(a, c))
+    assert bool(fe.is_zero(jnp.asarray(fe.to_limbs(P))))
+    assert not bool(fe.is_zero(jnp.asarray(fe.to_limbs(1))))
+
+
+def test_ops_are_jit_and_vmap_transparent(rng):
+    vals = [v % (1 << 255) for v in rand_vals(rng, 8)]
+    a = jnp.asarray(fe.to_limbs(vals))
+    b = jnp.asarray(fe.to_limbs(list(reversed(vals))))
+
+    jit_mul = jax.jit(fe.mul)
+    np.testing.assert_array_equal(np.asarray(jit_mul(a, b)), np.asarray(fe.mul(a, b)))
+
+    vmul = jax.vmap(fe.mul)
+    np.testing.assert_array_equal(np.asarray(vmul(a, b)), np.asarray(fe.mul(a, b)))
+
+
+def test_batch_shapes(rng):
+    vals = [[rng.getrandbits(255) for _ in range(3)] for _ in range(2)]
+    a = jnp.asarray(fe.to_limbs(vals))  # [2, 3, 20]
+    out = jmul(a, a)
+    assert out.shape == (2, 3, fe.N_LIMBS)
+    for i in range(2):
+        for j in range(3):
+            assert (
+                fe.from_limbs(np.asarray(out)[i, j]) % P
+                == (vals[i][j] * vals[i][j]) % P
+            )

@@ -1,0 +1,67 @@
+"""Shamir sharing: host roundtrips, threshold properties, device parity."""
+
+import pytest
+
+from hyperdrive_tpu.crypto import shamir
+from hyperdrive_tpu.crypto.ed25519 import P
+from hyperdrive_tpu.ops.shamir import BatchReconstructor
+
+
+def test_block_roundtrip(rng):
+    for _ in range(10):
+        secret = rng.getrandbits(248)
+        shares = shamir.split_block(secret, k=3, n=5, tag=b"t")
+        assert len(shares) == 5
+        # Any 3 shares reconstruct.
+        subset = rng.sample(shares, 3)
+        assert shamir.reconstruct_block(subset) == secret
+
+
+def test_below_threshold_gives_wrong_secret(rng):
+    secret = rng.getrandbits(200)
+    shares = shamir.split_block(secret, k=3, n=5, tag=b"t2")
+    # 2 shares interpolate a line — almost surely not the secret.
+    assert shamir.reconstruct_block(shares[:2]) != secret
+
+
+def test_k_equals_one_is_replication():
+    shares = shamir.split_block(42, k=1, n=4)
+    assert all(y == 42 for _, y in shares)
+
+
+def test_payload_roundtrip(rng):
+    for size in (0, 1, 30, 31, 32, 100):
+        payload = rng.randbytes(size)
+        blocks = shamir.split_payload(payload, k=3, n=5, tag=b"p")
+        subset = [rng.sample(b, 3) for b in blocks]
+        assert shamir.reconstruct_payload(subset) == payload
+
+
+def test_invalid_inputs():
+    with pytest.raises(ValueError):
+        shamir.split_block(P, 2, 3)
+    with pytest.raises(ValueError):
+        shamir.split_block(1, 4, 3)
+
+
+def test_device_matches_host(rng):
+    recon = BatchReconstructor()
+    payload = rng.randbytes(200)
+    blocks = shamir.split_payload(payload, k=4, n=7, tag=b"dev")
+    # Pick the same 4 shares for every block (as a real quorum would).
+    idx = sorted(rng.sample(range(7), 4))
+    subset = [[b[i] for i in idx] for b in blocks]
+    host = shamir.reconstruct_payload(subset)
+    dev = recon.reconstruct_payload_shares(subset)
+    assert host == dev == payload
+
+
+def test_device_block_batch(rng):
+    recon = BatchReconstructor()
+    secrets = [rng.getrandbits(240) for _ in range(16)]
+    k, n = 3, 5
+    all_shares = [shamir.split_block(s, k, n, tag=bytes([i])) for i, s in enumerate(secrets)]
+    xs = [1, 3, 5]
+    y_blocks = [[sh[x - 1][1] for sh in all_shares] for x in xs]
+    got = recon.reconstruct_blocks(xs, y_blocks)
+    assert got == secrets

@@ -1,0 +1,67 @@
+"""Quorum tally kernels: differential against a naive Python count."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hyperdrive_tpu.ops import tally
+
+
+def test_pack_value_roundtrip(rng):
+    v = rng.randbytes(32)
+    words = tally.pack_value(v)
+    assert words.shape == (8,)
+    back = b"".join(
+        int(np.uint32(w)).to_bytes(4, "little") for w in words
+    )
+    assert back == v
+
+
+def test_counts_match_naive(rng):
+    R, V = 6, 32
+    f = 10
+    values = [rng.randbytes(32) for _ in range(4)] + [b"\x00" * 32]
+    votes = [[values[rng.randrange(len(values))] for _ in range(V)] for _ in range(R)]
+    present = [[rng.random() < 0.8 for _ in range(V)] for _ in range(R)]
+    targets = [values[rng.randrange(len(values) - 1)] for _ in range(R)]
+
+    vote_t = jnp.asarray(
+        np.stack([tally.pack_values(row) for row in votes])
+    )
+    present_t = jnp.asarray(np.array(present))
+    target_t = jnp.asarray(tally.pack_values(targets))
+
+    counts = jax.jit(tally.tally_counts)(vote_t, present_t, target_t)
+
+    for r in range(R):
+        want_match = sum(
+            1 for v, p in zip(votes[r], present[r]) if p and v == targets[r]
+        )
+        want_nil = sum(
+            1 for v, p in zip(votes[r], present[r]) if p and v == b"\x00" * 32
+        )
+        want_total = sum(1 for p in present[r] if p)
+        assert int(counts["matching"][r]) == want_match
+        assert int(counts["nil"][r]) == want_nil
+        assert int(counts["total"][r]) == want_total
+
+    flags = tally.quorum_flags(counts, jnp.int32(f))
+    for r in range(R):
+        assert bool(flags["quorum_matching"][r]) == (
+            int(counts["matching"][r]) >= 2 * f + 1
+        )
+        assert bool(flags["skip_eligible"][r]) == (int(counts["total"][r]) >= f + 1)
+
+
+def test_absent_votes_never_count():
+    R, V = 1, 8
+    target = b"\x07" * 32
+    vote_t = jnp.asarray(
+        np.stack([tally.pack_values([target] * V)])
+    )
+    present_t = jnp.zeros((R, V), dtype=bool)
+    target_t = jnp.asarray(tally.pack_values([target]))
+    counts = tally.tally_counts(vote_t, present_t, target_t)
+    assert int(counts["matching"][0]) == 0
+    assert int(counts["total"][0]) == 0
