@@ -70,9 +70,36 @@ FOLD_255 = 19
 TOP_SHIFT = 8
 TOP_MASK = (1 << TOP_SHIFT) - 1
 
-# 4p as limbs — the subtraction bias. Any operand < 2^256 < 4p, so
-# a + 4p - b is positive, and a + 4p - b < 2^256 + 4p < 2^260 fits.
-_FOUR_P = 4 * P_INT
+#: Invariant slack: public results have limbs in [0, 2^13 + 2^10].
+SLACK_MAX = (1 << LIMB_BITS) + (1 << 10)
+
+
+def _make_sub_bias() -> "np.ndarray":
+    """A multiple of p whose (redundant) limb decomposition dominates any
+    invariant-satisfying operand limb-wise, so ``a + bias - b`` has every
+    limb non-negative *before* carrying. Non-negative pre-carry limbs are
+    what lets subtraction normalize with a single vectorized carry pass
+    instead of a sequential borrow-propagating scan.
+
+    Construction: take the natural base-2^13 digits d_i of c*p and lend
+    2^13 from each limb i+1 to limb i (m_0 = d_0 + 2^13, m_i = d_i + 2^13
+    - 1 for 0 < i < 19, m_19 = d_19 - 1, where d_19 is the untruncated top
+    digit). Searching c finds digits big enough that every m_i >= 2^13 +
+    2^10 (the operand limb maximum)."""
+    for c in range(40, 4096):
+        v = c * P_INT
+        d = [(v >> (LIMB_BITS * i)) & LIMB_MASK for i in range(N_LIMBS - 1)]
+        d.append(v >> (LIMB_BITS * (N_LIMBS - 1)))
+        m = [d[0] + (1 << LIMB_BITS)]
+        m += [d[i] + (1 << LIMB_BITS) - 1 for i in range(1, N_LIMBS - 1)]
+        m.append(d[N_LIMBS - 1] - 1)
+        if all(SLACK_MAX <= mi < (1 << 16) for mi in m):
+            assert sum(mi << (LIMB_BITS * i) for i, mi in enumerate(m)) == v
+            return np.array(m, dtype=np.int32)
+    raise AssertionError("no subtraction bias found")
+
+
+_SUB_BIAS = _make_sub_bias()
 
 
 def to_limbs(x) -> np.ndarray:
@@ -101,7 +128,6 @@ def from_limbs(limbs) -> "int | list":
 ZERO = to_limbs(0)
 ONE = to_limbs(1)
 _P_LIMBS = to_limbs(P_INT)
-_FOUR_P_LIMBS = to_limbs(_FOUR_P)
 
 
 def zeros_like_batch(batch_shape) -> jnp.ndarray:
@@ -144,8 +170,9 @@ def _fold_carry_out(x: jnp.ndarray, carry: jnp.ndarray, factor: int) -> jnp.ndar
 
 def _fold_top(x: jnp.ndarray) -> jnp.ndarray:
     """Fold bits 255..259 (the high bits of limb 19) back via x19 -> 19 *
-    (x19 >> 8), establishing value < 2^256. Input limbs must be in
-    [0, 2^13) with no pending carry."""
+    (x19 >> 8), establishing value < 2^256. Input limbs must be
+    non-negative with limb 19 < 2^23 (so hi * 19 stays within the micro
+    ripple's headroom); callers arrive here with limbs <= 2^13 + small."""
     hi = x[..., N_LIMBS - 1] >> TOP_SHIFT
     x = x.at[..., N_LIMBS - 1].set(x[..., N_LIMBS - 1] & TOP_MASK)
     x = x.at[..., 0].add(hi * FOLD_255)
@@ -163,48 +190,88 @@ def _normalize(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
+# -------------------------------------------------- vectorized carry passes
+#
+# The scan in :func:`_carry` is exact but sequential: 20 (or 39) dependent
+# steps per normalization, each touching one limb column. The hot path
+# instead uses *vectorized* passes — one shift/mask over the whole limb
+# axis, with every limb's carry moved up one position in a single slice
+# shift. Because all pre-carry limbs on the hot path are provably
+# non-negative (schoolbook columns of non-negative limbs; sums; the
+# dominating subtraction bias), carries are non-negative and a constant
+# number of passes restores the invariant — no borrow can ripple.
+
+
+def _pass(x: jnp.ndarray):
+    """One vectorized carry pass. Returns (limbs, carry_out_of_top)."""
+    c = x >> LIMB_BITS
+    r = x & LIMB_MASK
+    shifted = jnp.concatenate([jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+    return r + shifted, c[..., -1]
+
+
+def _pass_fold(x: jnp.ndarray) -> jnp.ndarray:
+    """Carry pass on a 20-limb array, folding the 2^260 carry-out back
+    into limb 0 (x608)."""
+    x, c = _pass(x)
+    return x.at[..., 0].add(c * FOLD_260)
+
+
 # ---------------------------------------------------------------- operators
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """(a + b) mod-ish p: normalized, value < 2^256."""
-    return _normalize(a + b)
+    """(a + b) mod-ish p: normalized, value < 2^256.
+
+    Pre-carry limbs are <= 2 * SLACK_MAX < 2^15; one pass leaves limbs
+    <= 2^13 + 2, one micro-fold absorbs the (<=2) 2^260 carry."""
+    x, c = _pass(a + b)
+    x = _fold_carry_out(x, c, FOLD_260)
+    return _fold_top(x)
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """(a - b) mod-ish p via the 4p bias (keeps everything non-negative
-    after carrying)."""
-    bias = jnp.asarray(_FOUR_P_LIMBS, dtype=jnp.int32)
-    return _normalize(a + bias - b)
+    """(a - b) mod-ish p via the limb-dominating bias: every pre-carry
+    limb of ``a + bias - b`` is non-negative, so a single vectorized pass
+    normalizes (no borrow propagation possible)."""
+    bias = jnp.asarray(_SUB_BIAS, dtype=jnp.int32)
+    x, c = _pass(a + (bias - b))
+    x = _fold_carry_out(x, c, FOLD_260)
+    return _fold_top(x)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    bias = jnp.asarray(_FOUR_P_LIMBS, dtype=jnp.int32)
-    return _normalize(bias - a)
+    bias = jnp.asarray(_SUB_BIAS, dtype=jnp.int32)
+    x, c = _pass(bias - a)
+    x = _fold_carry_out(x, c, FOLD_260)
+    return _fold_top(x)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook product with modular folding. Inputs must be normalized
-    (limbs < 2^13); output is normalized with value < 2^256."""
+    """Schoolbook product with modular folding. Inputs must satisfy the
+    invariant (limbs <= 2^13 + 2^10); output does too, value < 2^256.
+
+    Bound chain: products <= SLACK_MAX^2 < 2^26.4, columns accumulate <= 20
+    of them -> < 2^30.7 (int32-safe). Two passes bring all 39 columns to
+    <= 2^13 + 26; the x608 fold then keeps everything < 2^23, and three
+    fold-passes restore the invariant."""
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     cols = jnp.zeros((*batch, 2 * N_LIMBS - 1), dtype=jnp.int32)
     for i in range(N_LIMBS):
-        # Column block i..i+19 accumulates a_i * b. Each product < 2^26;
-        # each column gathers at most 20 of them -> < 2^30.4, no overflow.
         cols = cols.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
 
-    # Carry the 39 columns so every entry is < 2^13 before the x608 fold
-    # (folding unnormalized columns would overflow int32).
-    cols, carry = _carry(cols)  # carry is the virtual column 39
+    cols, c1 = _pass(cols)
+    cols, c2 = _pass(cols)
 
     low = cols[..., :N_LIMBS]
-    high = cols[..., N_LIMBS:]  # columns 20..38
+    high = cols[..., N_LIMBS:]  # columns 20..38 fold x608 into 0..18
     low = low.at[..., : N_LIMBS - 1].add(high * FOLD_260)
-    # Virtual column 39 folds to column 19 with the same factor.
-    low = low.at[..., 19].add(carry * FOLD_260)
+    # Virtual column 39 (the passes' top carries) folds to column 19.
+    low = low.at[..., 19].add((c1 + c2) * FOLD_260)
 
-    low, carry = _carry(low)
-    low = _fold_carry_out(low, carry, FOLD_260)
+    low = _pass_fold(low)
+    low = _pass_fold(low)
+    low = _pass_fold(low)
     return _fold_top(low)
 
 
@@ -216,7 +283,10 @@ def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
     """Multiply by a small constant (k < 2^17 keeps products in int32)."""
     if not 0 <= k < (1 << 17):
         raise ValueError("constant too large for int32 limb products")
-    return _normalize(a * jnp.int32(k))
+    x = _pass_fold(a * jnp.int32(k))
+    x = _pass_fold(x)
+    x = _pass_fold(x)
+    return _fold_top(x)
 
 
 def inv(a: jnp.ndarray) -> jnp.ndarray:

@@ -34,7 +34,7 @@ def pack_value(value: bytes) -> np.ndarray:
     """32-byte value -> [8] int32 (little-endian words)."""
     if len(value) != 32:
         raise ValueError("value must be 32 bytes")
-    return np.frombuffer(value, dtype="<u4").astype(np.int64).astype(np.int32)
+    return np.frombuffer(value, dtype="<i4").astype(np.int32)
 
 
 def pack_values(values) -> np.ndarray:
