@@ -27,8 +27,8 @@ from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost, verify_kernel
 from hyperdrive_tpu.ops.tally import pack_values, tally_counts, quorum_flags
 
 N_VALIDATORS = 256
-ROUNDS = 16  # in-flight (height, round) pairs per launch
-BATCH = N_VALIDATORS * ROUNDS  # 4096 signatures per device launch
+ROUNDS = 64  # in-flight (height, round) pairs per launch
+BATCH = N_VALIDATORS * ROUNDS  # 16384 signatures per device launch
 TARGET_VOTES_PER_SEC = 50_000.0
 
 

@@ -95,7 +95,7 @@ def point_double(p):
 
 def _point_select(onehot, table):
     """Table lookup as multiply-accumulate: ``onehot`` [B, 16] x ``table``
-    (X, Y, Z, T) each [B, 16, 20] (or [16, 20] shared) -> point [B, 20].
+    components each [B, 16, 20] (or [16, 20] shared) -> component [B, 20].
 
     One-hot matmul instead of gather: gathers scatter badly on TPU; a
     [B,16] x [16,*] contraction rides the vector units.
@@ -110,6 +110,64 @@ def _point_select(onehot, table):
     return tuple(out)
 
 
+# ------------------------------------------- niels-form additions/doublings
+#
+# Table entries are stored pre-transformed ("niels" coordinates): an entry
+# (y+x, y-x, 2d*t [, z]) folds the additions and the 2d multiply of the
+# unified formula into the table once, instead of recomputing them on
+# every window (64x per signature).
+
+
+def _madd(p, n, need_t: bool):
+    """Extended point + niels entry with z2 = 1 (affine table): 7 muls,
+    6 without the T output."""
+    x1, y1, z1, t1 = p
+    yp2, ym2, t2d2 = n
+    a = fe.mul(fe.sub(y1, x1), ym2)
+    b = fe.mul(fe.add(y1, x1), yp2)
+    c = fe.mul(t1, t2d2)
+    d = fe.mul_small(z1, 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    out = (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g))
+    return (*out, fe.mul(e, h)) if need_t else out
+
+
+def _padd(p, n, need_t: bool):
+    """Extended point + projective niels entry (z2 != 1): 8 muls."""
+    x1, y1, z1, t1 = p
+    yp2, ym2, t2d2, z2 = n
+    a = fe.mul(fe.sub(y1, x1), ym2)
+    b = fe.mul(fe.add(y1, x1), yp2)
+    c = fe.mul(t1, t2d2)
+    d = fe.mul_small(fe.mul(z1, z2), 2)
+    e = fe.sub(b, a)
+    f = fe.sub(d, c)
+    g = fe.add(d, c)
+    h = fe.add(b, a)
+    out = (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g))
+    return (*out, fe.mul(e, h)) if need_t else out
+
+
+def _dbl(p3, need_t: bool):
+    """Doubling on (x, y, z) only — the extended T input is never needed
+    to double, and computing the T *output* (one mul) is skipped for the
+    three inner doublings of each window."""
+    x1, y1, z1 = p3
+    a = fe.sqr(x1)
+    b = fe.sqr(y1)
+    c = fe.mul_small(fe.sqr(z1), 2)
+    d = fe.neg(a)
+    e = fe.sub(fe.sub(fe.sqr(fe.add(x1, y1)), a), b)
+    g = fe.add(d, b)
+    f = fe.sub(g, c)
+    h = fe.sub(d, b)
+    out = (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g))
+    return (*out, fe.mul(e, h)) if need_t else out
+
+
 # --------------------------------------------------------- B window table
 
 _WINDOW = 4
@@ -117,25 +175,19 @@ _N_WINDOWS = 64  # 256 bits / 4
 
 
 @functools.lru_cache(maxsize=None)
-def _b_table_np():
-    """[v]B for v in 0..15, as numpy limb arrays (X, Y, Z=1, T)."""
-    xs, ys, ts = [], [], []
+def _b_niels_np():
+    """[v]B for v in 0..15 as affine niels limbs (y+x, y-x, 2d*x*y)."""
+    yp, ym, t2 = [], [], []
     pt = host_ed.IDENTITY
     for v in range(16):
         x, y, z, _ = pt
         zinv = pow(z, P - 2, P)
         xa, ya = (x * zinv) % P, (y * zinv) % P
-        xs.append(xa)
-        ys.append(ya)
-        ts.append((xa * ya) % P)
+        yp.append((ya + xa) % P)
+        ym.append((ya - xa) % P)
+        t2.append((K2D * xa * ya) % P)
         pt = host_ed.point_add(pt, host_ed.BASE)
-    one = [1] * 16
-    return (
-        fe.to_limbs(xs),
-        fe.to_limbs(ys),
-        fe.to_limbs(one),
-        fe.to_limbs(ts),
-    )
+    return (fe.to_limbs(yp), fe.to_limbs(ym), fe.to_limbs(t2))
 
 
 # ------------------------------------------------------------------ kernel
@@ -155,35 +207,40 @@ def verify_kernel(ax, ay, at, rx, ry, s_nibbles, k_nibbles):
     one = jnp.broadcast_to(
         jnp.asarray(fe.ONE, dtype=jnp.int32), (bsz, fe.N_LIMBS)
     )
+    zero = jnp.zeros_like(one)
+    k2d = jnp.asarray(_K2D_LIMBS, dtype=jnp.int32)
 
-    # Per-signature table of the 16 multiples of A', built with a scan so
-    # the traced graph holds a single addition (15 executed).
-    a_pt = (ax, ay, one, at)
+    # Per-signature table of the 16 multiples of A' (affine, z = 1), built
+    # with a scan so the traced graph holds a single addition (15
+    # executed), then converted to niels form in one batched shot.
+    a_niels = (fe.add(ay, ax), fe.sub(ay, ax), fe.mul(at, k2d))
 
     def table_step(pt, _):
-        return point_add(pt, a_pt), pt
+        return _madd(pt, a_niels, need_t=True), pt
 
     _, stacked = lax.scan(table_step, _identity_like((bsz,)), None, length=16)
-    ta = tuple(jnp.moveaxis(c, 0, 1) for c in stacked)  # each [B, 16, 20]
+    sx, sy, sz, st = (jnp.moveaxis(c, 0, 1) for c in stacked)  # [B, 16, 20]
+    ta = (fe.add(sy, sx), fe.sub(sy, sx), fe.mul(st, k2d), sz)
 
     tb = tuple(
-        jnp.asarray(comp, dtype=jnp.int32) for comp in _b_table_np()
+        jnp.asarray(comp, dtype=jnp.int32) for comp in _b_niels_np()
     )  # each [16, 20]
 
     lanes = jnp.arange(16, dtype=jnp.int32)
 
-    def body(i, acc):
+    def body(i, acc3):
         w = _N_WINDOWS - 1 - i
-        acc = lax.fori_loop(0, _WINDOW, lambda _, p: point_double(p), acc)
+        acc3 = lax.fori_loop(
+            0, _WINDOW - 1, lambda _, p: _dbl(p, need_t=False), acc3
+        )
+        acc4 = _dbl(acc3, need_t=True)
         k_digit = lax.dynamic_slice_in_dim(k_nibbles, w, 1, axis=1)  # [B,1]
         s_digit = lax.dynamic_slice_in_dim(s_nibbles, w, 1, axis=1)
-        acc = point_add(acc, _point_select(lanes[None, :] == k_digit, ta))
-        acc = point_add(acc, _point_select(lanes[None, :] == s_digit, tb))
-        return acc
+        acc4 = _padd(acc4, _point_select(lanes[None, :] == k_digit, ta), need_t=True)
+        return _madd(acc4, _point_select(lanes[None, :] == s_digit, tb), need_t=False)
 
-    p_acc = lax.fori_loop(0, _N_WINDOWS, body, _identity_like((bsz,)))
+    px, py, pz = lax.fori_loop(0, _N_WINDOWS, body, (zero, one, one))
 
-    px, py, pz, _ = p_acc
     ok_x = fe.eq(px, fe.mul(rx, pz))
     ok_y = fe.eq(py, fe.mul(ry, pz))
     return ok_x & ok_y

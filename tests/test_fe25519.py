@@ -103,6 +103,24 @@ def test_mul_matches_bignum(rng):
         assert got == want
 
 
+def test_sqr_matches_bignum(rng):
+    jsqr = jax.jit(fe.sqr)
+    vals = [v % (1 << 256) for v in EDGE_VALUES + rand_vals(rng, 30)]
+    a = jnp.asarray(fe.to_limbs(vals))
+    out = jsqr(a)
+    check_invariant(out)
+    for i, x in enumerate(vals):
+        assert fe.from_limbs(np.asarray(out)[i]) % P == (x * x) % P
+    # Worst-case column accumulation: all limbs at the invariant maximum.
+    worst = jnp.broadcast_to(
+        jnp.full((fe.N_LIMBS,), (1 << 13) + (1 << 10), dtype=jnp.int32),
+        (4, fe.N_LIMBS),
+    )
+    wv = fe.from_limbs(np.asarray(worst)[0])
+    got = fe.from_limbs(np.asarray(jsqr(worst))[0]) % P
+    assert got == (wv * wv) % P
+
+
 def test_mul_small_matches_bignum(rng):
     vals = [v % (1 << 256) for v in EDGE_VALUES + rand_vals(rng, 10)]
     a = jnp.asarray(fe.to_limbs(vals))
