@@ -1,0 +1,184 @@
+"""ctypes binding for the native host runtime (hd_native.cc).
+
+The shared library is compiled on demand with g++ (no pip, no pybind11) and
+cached next to the source, keyed by a hash of the source text so edits
+trigger a rebuild. Everything degrades gracefully: if a toolchain is
+missing or the compile fails, :func:`load` returns None and callers fall
+back to the pure-Python path (``HD_NO_NATIVE=1`` forces the fallback).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["load", "available", "NativePacker"]
+
+_SRC = os.path.join(os.path.dirname(__file__), "hd_native.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
+
+_lock = threading.Lock()
+_lib = None
+_lib_err: str | None = None
+
+
+def _compile() -> str:
+    with open(_SRC, "rb") as fh:
+        tag = hashlib.sha256(fh.read()).hexdigest()[:16]
+    so_path = os.path.join(_BUILD_DIR, f"libhd_native-{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = so_path + f".tmp.{os.getpid()}"
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(
+            base[:2] + ["-march=native"] + base[2:],
+            check=True,
+            capture_output=True,
+        )
+    except (subprocess.CalledProcessError, OSError):
+        subprocess.run(base, check=True, capture_output=True)
+    os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+    return so_path
+
+
+def load():
+    """Returns the loaded CDLL, or None if native is unavailable."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        if os.environ.get("HD_NO_NATIVE"):
+            _lib_err = "disabled by HD_NO_NATIVE"
+            return None
+        try:
+            lib = ctypes.CDLL(_compile())
+        except Exception as e:  # missing g++, bad toolchain, load error
+            _lib_err = f"native build failed: {e}"
+            return None
+        lib.hd_pack_batch.restype = ctypes.c_int
+        lib.hd_decompress.restype = ctypes.c_int
+        lib.hd_sha512.restype = None
+        lib.hd_mod_l.restype = None
+        lib.hd_cache_clear.restype = None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _u8ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativePacker:
+    """Batch Ed25519 packing through the native library.
+
+    Same contract as the Python loop in ``Ed25519BatchHost.pack``: given
+    parallel (pub, digest, sig) byte arrays, fill the kernel's limb/nibble
+    tensors and a prevalidity mask.
+    """
+
+    def __init__(self):
+        self._lib = load()
+        if self._lib is None:
+            raise RuntimeError(_lib_err or "native library unavailable")
+
+    def pack_into(
+        self,
+        items,
+        ax: np.ndarray,
+        ay: np.ndarray,
+        at: np.ndarray,
+        rx: np.ndarray,
+        ry: np.ndarray,
+        s_nib: np.ndarray,
+        k_nib: np.ndarray,
+    ) -> np.ndarray:
+        """items: sequence of (pub, digest, sig) byte triples (digests may
+        be any length; pub/sig must be 32/64 bytes). Writes row i of each
+        output array for every item that passes host checks; returns the
+        bool prevalid mask (length = len(items))."""
+        n = len(items)
+        dstride = max((len(d) for _, d, _ in items), default=1) or 1
+        pubs = np.zeros((n, 32), dtype=np.uint8)
+        digests = np.zeros((n, dstride), dtype=np.uint8)
+        digest_lens = np.zeros(n, dtype=np.int32)
+        sigs = np.zeros((n, 64), dtype=np.uint8)
+        in_ok = np.zeros(n, dtype=np.uint8)
+        for i, (pub, digest, sig) in enumerate(items):
+            if len(pub) != 32 or len(sig) != 64:
+                continue
+            pubs[i] = np.frombuffer(pub, dtype=np.uint8)
+            if digest:
+                digests[i, : len(digest)] = np.frombuffer(digest, dtype=np.uint8)
+            digest_lens[i] = len(digest)
+            sigs[i] = np.frombuffer(sig, dtype=np.uint8)
+            in_ok[i] = 1
+
+        prevalid = np.zeros(n, dtype=np.uint8)
+        self._lib.hd_pack_batch(
+            _u8ptr(pubs),
+            _u8ptr(digests),
+            _i32ptr(digest_lens),
+            ctypes.c_int(dstride),
+            _u8ptr(sigs),
+            _u8ptr(in_ok),
+            ctypes.c_int(n),
+            _i32ptr(ax),
+            _i32ptr(ay),
+            _i32ptr(at),
+            _i32ptr(rx),
+            _i32ptr(ry),
+            _i32ptr(s_nib),
+            _i32ptr(k_nib),
+            _u8ptr(prevalid),
+        )
+        return prevalid.astype(bool)
+
+    # ------------------------------------------------------ self-test hooks
+
+    def decompress(self, data: bytes):
+        """Mirror of crypto.ed25519.point_decompress for differential tests:
+        returns (x, y) ints or None."""
+        out = np.zeros(64, dtype=np.uint8)
+        buf = np.frombuffer(data, dtype=np.uint8) if len(data) == 32 else None
+        if buf is None:
+            return None
+        ok = self._lib.hd_decompress(_u8ptr(np.ascontiguousarray(buf)), _u8ptr(out))
+        if not ok:
+            return None
+        x = int.from_bytes(out[:32].tobytes(), "little")
+        y = int.from_bytes(out[32:].tobytes(), "little")
+        return x, y
+
+    def sha512(self, data: bytes) -> bytes:
+        buf = np.frombuffer(data, dtype=np.uint8) if data else np.zeros(0, np.uint8)
+        out = np.zeros(64, dtype=np.uint8)
+        self._lib.hd_sha512(
+            _u8ptr(np.ascontiguousarray(buf)), ctypes.c_size_t(len(data)), _u8ptr(out)
+        )
+        return out.tobytes()
+
+    def mod_l(self, data64: bytes) -> int:
+        buf = np.frombuffer(data64, dtype=np.uint8)
+        out = np.zeros(32, dtype=np.uint8)
+        self._lib.hd_mod_l(_u8ptr(np.ascontiguousarray(buf)), _u8ptr(out))
+        return int.from_bytes(out.tobytes(), "little")
+
+    def cache_clear(self) -> None:
+        self._lib.hd_cache_clear()

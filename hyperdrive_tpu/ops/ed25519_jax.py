@@ -27,6 +27,7 @@ enforce agreement.
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 
@@ -61,36 +62,6 @@ def _identity_like(batch_shape):
         jnp.asarray(fe.ONE, dtype=jnp.int32), (*batch_shape, fe.N_LIMBS)
     )
     return (zero, one, one, zero)
-
-
-def point_add(p, q):
-    """Unified extended addition (complete for a = -1; add-2008-hwcd-3)."""
-    x1, y1, z1, t1 = p
-    x2, y2, z2, t2 = q
-    k2d = jnp.asarray(_K2D_LIMBS, dtype=jnp.int32)
-    a = fe.mul(fe.sub(y1, x1), fe.sub(y2, x2))
-    b = fe.mul(fe.add(y1, x1), fe.add(y2, x2))
-    c = fe.mul(fe.mul(t1, k2d), t2)
-    d = fe.mul_small(fe.mul(z1, z2), 2)
-    e = fe.sub(b, a)
-    f = fe.sub(d, c)
-    g = fe.add(d, c)
-    h = fe.add(b, a)
-    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
-
-
-def point_double(p):
-    """Dedicated doubling (dbl-2008-hwcd, a = -1): 4 squarings + 4 muls."""
-    x1, y1, z1, _ = p
-    a = fe.sqr(x1)
-    b = fe.sqr(y1)
-    c = fe.mul_small(fe.sqr(z1), 2)
-    d = fe.neg(a)
-    e = fe.sub(fe.sub(fe.sqr(fe.add(x1, y1)), a), b)
-    g = fe.add(d, b)
-    f = fe.sub(g, c)
-    h = fe.sub(d, b)
-    return (fe.mul(e, f), fe.mul(g, h), fe.mul(f, g), fe.mul(e, h))
 
 
 def _point_select(onehot, table):
@@ -265,10 +236,36 @@ class Ed25519BatchHost:
 
     Bucketed padding: batches are padded up to the next size in ``buckets``
     so the jitted kernel sees only a handful of static shapes.
+
+    Packing runs through the native C++ runtime
+    (:mod:`hyperdrive_tpu.native`) when available — point decompression is
+    one field exponentiation per point and dominates the host cost — with
+    the pure-Python loop as the always-available fallback (``HD_NO_NATIVE=1``
+    forces it). Both paths are differentially tested to produce identical
+    tensors and masks.
     """
 
-    def __init__(self, buckets=(64, 256, 1024, 4096)):
+    def __init__(self, buckets=(64, 256, 1024, 4096), use_native: bool = True):
         self.buckets = tuple(sorted(buckets))
+        self._native = None
+        if use_native and not os.environ.get("HD_NO_NATIVE"):
+            try:
+                from hyperdrive_tpu.native import NativePacker
+
+                self._native = NativePacker()
+            except RuntimeError as e:
+                # Toolchain missing / build failed: fall back to the pure-
+                # Python loop, but say so — it is ~100x slower and would
+                # otherwise silently eat the throughput target.
+                import warnings
+
+                warnings.warn(
+                    f"native packer unavailable ({e}); falling back to the "
+                    "pure-Python packing path (expect ~100x slower host "
+                    "packing). Set HD_NO_NATIVE=1 to silence this.",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
 
     def bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -295,6 +292,12 @@ class Ed25519BatchHost:
         s_nib = np.zeros((bsz, 64), dtype=np.int32)
         k_nib = np.zeros((bsz, 64), dtype=np.int32)
         prevalid = np.zeros(bsz, dtype=bool)
+
+        if self._native is not None:
+            prevalid[:n] = self._native.pack_into(
+                items, ax, ay, at, rx, ry, s_nib, k_nib
+            )
+            return (ax, ay, at, rx, ry, s_nib, k_nib), prevalid, n
 
         for i, (pub, digest, sig) in enumerate(items):
             if len(pub) != 32 or len(sig) != 64:

@@ -247,19 +247,15 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
     return _fold_top(x)
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Schoolbook product with modular folding. Inputs must satisfy the
-    invariant (limbs <= 2^13 + 2^10); output does too, value < 2^256.
+def _reduce_cols(cols: jnp.ndarray) -> jnp.ndarray:
+    """Shared reduction tail of :func:`mul`/:func:`sqr`: take the 39 product
+    columns (each < 2^30.7 — the callers' bound analyses guarantee this),
+    normalize to 20 invariant limbs, value < 2^256.
 
-    Bound chain: products <= SLACK_MAX^2 < 2^26.4, columns accumulate <= 20
-    of them -> < 2^30.7 (int32-safe). Two passes bring all 39 columns to
-    <= 2^13 + 26; the x608 fold then keeps everything < 2^23, and three
-    fold-passes restore the invariant."""
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    cols = jnp.zeros((*batch, 2 * N_LIMBS - 1), dtype=jnp.int32)
-    for i in range(N_LIMBS):
-        cols = cols.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
-
+    Two passes bring all 39 columns to <= 2^13 + 26; the x608 fold of
+    columns 20..38 (plus the passes' top carries as virtual column 39) then
+    keeps everything < 2^23, and three fold-passes + the top fold restore
+    the invariant."""
     cols, c1 = _pass(cols)
     cols, c2 = _pass(cols)
 
@@ -275,32 +271,35 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return _fold_top(low)
 
 
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook product with modular folding. Inputs must satisfy the
+    invariant (limbs <= 2^13 + 2^10); output does too, value < 2^256.
+
+    Bound chain: products <= SLACK_MAX^2 < 2^26.4, columns accumulate <= 20
+    of them -> < 2^30.7 (int32-safe), meeting :func:`_reduce_cols`'s
+    contract."""
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    cols = jnp.zeros((*batch, 2 * N_LIMBS - 1), dtype=jnp.int32)
+    for i in range(N_LIMBS):
+        cols = cols.at[..., i : i + N_LIMBS].add(a[..., i : i + 1] * b)
+    return _reduce_cols(cols)
+
+
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
     """Squaring: symmetric schoolbook — cross products a_i*a_j (i < j)
     appear twice, so accumulate a_i * (a_i, 2a_{i+1}, ..., 2a_19) per row,
     halving the multiply work of :func:`mul`.
 
     Bound: the worst column sums 10 doubled cross products (col 19:
-    (0,19)..(9,10)) <= 10 * 2 * SLACK_MAX^2 < 2^30.7 — int32-safe."""
+    (0,19)..(9,10)) <= 10 * 2 * SLACK_MAX^2 < 2^30.7 — int32-safe, meeting
+    :func:`_reduce_cols`'s contract."""
     a2 = a + a
     batch = a.shape[:-1]
     cols = jnp.zeros((*batch, 2 * N_LIMBS - 1), dtype=jnp.int32)
     for i in range(N_LIMBS):
         row = jnp.concatenate([a[..., i : i + 1], a2[..., i + 1 :]], axis=-1)
         cols = cols.at[..., 2 * i : i + N_LIMBS].add(a[..., i : i + 1] * row)
-
-    cols, c1 = _pass(cols)
-    cols, c2 = _pass(cols)
-
-    low = cols[..., :N_LIMBS]
-    high = cols[..., N_LIMBS:]
-    low = low.at[..., : N_LIMBS - 1].add(high * FOLD_260)
-    low = low.at[..., 19].add((c1 + c2) * FOLD_260)
-
-    low = _pass_fold(low)
-    low = _pass_fold(low)
-    low = _pass_fold(low)
-    return _fold_top(low)
+    return _reduce_cols(cols)
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:

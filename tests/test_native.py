@@ -1,0 +1,173 @@
+"""Differential tests: native C++ host runtime vs the pure-Python oracle.
+
+The native packer (hyperdrive_tpu/native/hd_native.cc) must produce
+bit-identical tensors and prevalidity masks to the Python packing loop in
+``Ed25519BatchHost`` for every input class: valid signatures, malformed
+points, non-canonical encodings, out-of-range scalars, and wrong-length
+fields.
+"""
+
+import hashlib
+import os
+import random
+
+import numpy as np
+import pytest
+
+from hyperdrive_tpu.crypto import ed25519 as ed
+from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost
+
+native = pytest.importorskip("hyperdrive_tpu.native")
+if not native.available():
+    pytest.skip("native toolchain unavailable", allow_module_level=True)
+
+
+@pytest.fixture(scope="module")
+def packer():
+    p = native.NativePacker()
+    p.cache_clear()
+    return p
+
+
+def _keypair(i: int):
+    seed = hashlib.sha256(b"native-test-%d" % i).digest()
+    return seed, ed.public_key_from_seed(seed)
+
+
+def test_sha512_matches_hashlib(packer):
+    rng = random.Random(1)
+    for n in [0, 1, 63, 64, 111, 112, 127, 128, 129, 300, 1000]:
+        data = bytes(rng.randrange(256) for _ in range(n))
+        assert packer.sha512(data) == hashlib.sha512(data).digest()
+
+
+def test_mod_l_matches_python(packer):
+    rng = random.Random(2)
+    cases = [b"\x00" * 64, b"\xff" * 64]
+    cases += [bytes(rng.randrange(256) for _ in range(64)) for _ in range(200)]
+    # Values straddling multiples of L.
+    for m in (1, 2, 7, 1 << 200):
+        for delta in (-1, 0, 1):
+            v = (ed.L * m + delta) % (1 << 512)
+            cases.append(v.to_bytes(64, "little"))
+    for data in cases:
+        assert packer.mod_l(data) == int.from_bytes(data, "little") % ed.L
+
+
+def test_decompress_matches_python(packer):
+    rng = random.Random(3)
+    cases = []
+    for i in range(20):
+        _, pub = _keypair(i)
+        cases.append(pub)
+        # Flip the sign bit: usually still a valid (negated) point.
+        cases.append(bytes([*pub[:31], pub[31] ^ 0x80]))
+    # Edge encodings: y = 0, 1, 2, p-1, p, p+1, 2^255-1, and random blobs.
+    for y in (0, 1, 2, ed.P - 1, ed.P, ed.P + 1, (1 << 255) - 1):
+        for sign in (0, 1):
+            cases.append((y | (sign << 255)).to_bytes(32, "little"))
+    cases += [bytes(rng.randrange(256) for _ in range(32)) for _ in range(300)]
+
+    for data in cases:
+        ref = ed.point_decompress(data)
+        got = packer.decompress(data)
+        if ref is None:
+            assert got is None, data.hex()
+        else:
+            assert got == (ref[0], ref[1]), data.hex()
+
+
+def _pack_both(items):
+    py = Ed25519BatchHost(use_native=False)
+    cc = Ed25519BatchHost(use_native=True)
+    assert cc._native is not None, "native packer should be active"
+    a_py, v_py, n_py = py.pack(items)
+    a_cc, v_cc, n_cc = cc.pack(items)
+    return (a_py, v_py, n_py), (a_cc, v_cc, n_cc)
+
+
+def test_pack_batch_differential(packer):
+    rng = random.Random(4)
+    items = []
+    # Valid signatures.
+    for i in range(12):
+        seed, pub = _keypair(i)
+        digest = hashlib.sha256(b"msg-%d" % i).digest()
+        items.append((pub, digest, ed.sign(seed, digest)))
+    # Corrupted signatures (wrong digest — packs fine, verifies false).
+    seed, pub = _keypair(100)
+    digest = hashlib.sha256(b"real").digest()
+    sig = ed.sign(seed, digest)
+    items.append((pub, hashlib.sha256(b"fake").digest(), sig))
+    # Malformed R (not a point).
+    items.append((pub, digest, b"\x13" * 32 + sig[32:]))
+    # s >= L.
+    big_s = (ed.L).to_bytes(32, "little")
+    items.append((pub, digest, sig[:32] + big_s))
+    # s just below L (packs fine).
+    ok_s = (ed.L - 1).to_bytes(32, "little")
+    items.append((pub, digest, sig[:32] + ok_s))
+    # Malformed pubkey.
+    items.append((b"\xff" * 32, digest, sig))
+    # Wrong lengths.
+    items.append((pub[:31], digest, sig))
+    items.append((pub, digest[:16], sig))
+    items.append((pub, digest, sig[:63]))
+    items.append((b"", b"", b""))
+    # Random garbage.
+    for _ in range(20):
+        items.append(
+            (
+                bytes(rng.randrange(256) for _ in range(32)),
+                bytes(rng.randrange(256) for _ in range(32)),
+                bytes(rng.randrange(256) for _ in range(64)),
+            )
+        )
+
+    (a_py, v_py, n_py), (a_cc, v_cc, n_cc) = _pack_both(items)
+    assert n_py == n_cc == len(items)
+    np.testing.assert_array_equal(v_py, v_cc)
+    for name, x, y in zip(
+        ["ax", "ay", "at", "rx", "ry", "s_nib", "k_nib"], a_py, a_cc
+    ):
+        np.testing.assert_array_equal(x, y, err_msg=name)
+
+
+def test_pack_cache_is_correct_across_batches(packer):
+    # The pubkey cache must not confuse distinct keys or leak staleness.
+    items1, items2 = [], []
+    for i in range(8):
+        seed, pub = _keypair(200 + i)
+        d = hashlib.sha256(b"a%d" % i).digest()
+        items1.append((pub, d, ed.sign(seed, d)))
+        d2 = hashlib.sha256(b"b%d" % i).digest()
+        items2.append((pub, d2, ed.sign(seed, d2)))
+    (a_py1, v_py1, _), (a_cc1, v_cc1, _) = _pack_both(items1)
+    (a_py2, v_py2, _), (a_cc2, v_cc2, _) = _pack_both(items2)
+    np.testing.assert_array_equal(v_py1, v_cc1)
+    np.testing.assert_array_equal(v_py2, v_cc2)
+    for x, y in zip(a_py1 + a_py2, a_cc1 + a_cc2):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_env_var_disables_native():
+    # load() caches module-globally, so the kill switch must be probed in a
+    # fresh interpreter.
+    import subprocess
+    import sys
+
+    code = (
+        "import hyperdrive_tpu.native as n; assert not n.available(); "
+        "from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost; "
+        "assert Ed25519BatchHost()._native is None"
+    )
+    env = dict(os.environ, HD_NO_NATIVE="1", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_use_native_false_skips_native():
+    host = Ed25519BatchHost(use_native=False)
+    assert host._native is None
