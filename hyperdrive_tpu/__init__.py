@@ -16,6 +16,10 @@ arXiv:1807.04938; reference layout surveyed in SURVEY.md):
   a ``jax.sharding.Mesh`` (ICI/DCN collectives).
 - ``hyperdrive_tpu.harness``   — deterministic in-process network simulator
   with seeded record/replay and fault/Byzantine injection.
+- ``hyperdrive_tpu.native``    — C++ host runtime (batch signature packing:
+  point decompression, SHA-512 challenges, limb packing) via ctypes.
+- ``hyperdrive_tpu.utils``     — tracing/metrics, structured logging, and
+  crash-restart checkpointing.
 
 The consensus control flow (branchy, per-message, tiny state) runs on the
 host; the TPU executes the batchable numeric work: vote signature

@@ -200,6 +200,12 @@ class Simulation:
         self.kill_at_step = dict(kill_at_step or {})
         self.offline = set(offline or ())
         self.clock = VirtualClock()
+        # One shared tracer across all replicas, on virtual time: metrics
+        # (round latencies, verify occupancy, equivocation counts) are
+        # deterministic and replay-identical.
+        from hyperdrive_tpu.utils import Tracer
+
+        self.tracer = Tracer(time_fn=lambda: self.clock.now)
         # The delivery queue is consumed via a head index (O(1) per step;
         # list.pop(0) would make 256-replica x 10k-height runs quadratic).
         self.queue: list[tuple[int, object]] = []
@@ -282,7 +288,7 @@ class Simulation:
         )
 
         return Replica(
-            ReplicaOptions(max_capacity=capacity),
+            ReplicaOptions(max_capacity=capacity, tracer=self.tracer),
             self.signatories[i],
             list(self.signatories),
             timer,

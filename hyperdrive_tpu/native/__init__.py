@@ -61,6 +61,10 @@ def load():
             return None
         try:
             lib = ctypes.CDLL(_compile())
+        except subprocess.CalledProcessError as e:
+            stderr = (e.stderr or b"").decode("utf-8", "replace").strip()
+            _lib_err = f"native build failed: {e}: {stderr[-500:]}"
+            return None
         except Exception as e:  # missing g++, bad toolchain, load error
             _lib_err = f"native build failed: {e}"
             return None

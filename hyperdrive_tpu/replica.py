@@ -38,6 +38,8 @@ from dataclasses import dataclass, replace
 from typing import Callable, Optional
 
 from hyperdrive_tpu.messages import Precommit, Prevote, Propose, Timeout
+from hyperdrive_tpu.utils.log import get_logger, kv as _kv
+from hyperdrive_tpu.utils.trace import NULL_TRACER
 from hyperdrive_tpu.mq import DEFAULT_MAX_CAPACITY, MessageQueue
 from hyperdrive_tpu.process import (
     Broadcaster,
@@ -54,18 +56,32 @@ from hyperdrive_tpu.types import DEFAULT_HEIGHT, Height, MessageType, Round, Sig
 
 __all__ = ["Replica", "ReplicaOptions", "ResetHeight"]
 
+#: Precomputed metric names — the dispatch path must not pay string
+#: formatting per message.
+_MSG_METRIC = {
+    Propose: "replica.msg.propose",
+    Prevote: "replica.msg.prevote",
+    Precommit: "replica.msg.precommit",
+    Timeout: "replica.msg.timeout",
+}
+
 
 @dataclass(frozen=True)
 class ReplicaOptions:
     """Immutable functional options (reference: replica/opt.go:11-46).
 
     ``verify_window`` sizes the batched drain handed to the Verifier; it is
-    a TPU-path tunable with no reference analogue.
+    a TPU-path tunable with no reference analogue. ``tracer`` and
+    ``logger`` fill the reference's injectable-logger seam — except this
+    framework actually emits (the reference configures zap and never logs a
+    line; SURVEY.md §5).
     """
 
     starting_height: Height = DEFAULT_HEIGHT
     max_capacity: int = DEFAULT_MAX_CAPACITY
     verify_window: int = 1024
+    tracer: object = None
+    logger: object = None
 
     def with_starting_height(self, height: Height) -> "ReplicaOptions":
         return replace(self, starting_height=height)
@@ -75,6 +91,12 @@ class ReplicaOptions:
 
     def with_verify_window(self, window: int) -> "ReplicaOptions":
         return replace(self, verify_window=window)
+
+    def with_tracer(self, tracer) -> "ReplicaOptions":
+        return replace(self, tracer=tracer)
+
+    def with_logger(self, logger) -> "ReplicaOptions":
+        return replace(self, logger=logger)
 
 
 @dataclass(frozen=True)
@@ -105,6 +127,8 @@ class Replica:
     ):
         f = len(signatories) // 3
         self.opts = opts
+        self.tracer = opts.tracer if opts.tracer is not None else NULL_TRACER
+        self.logger = opts.logger if opts.logger is not None else get_logger()
         self.proc = Process(
             whoami=whoami,
             f=f,
@@ -113,8 +137,8 @@ class Replica:
             proposer=proposer,
             validator=validator,
             broadcaster=broadcaster,
-            committer=committer,
-            catcher=catcher,
+            committer=self._instrument_committer(committer),
+            catcher=self._instrument_catcher(catcher),
             height=opts.starting_height,
         )
         self.procs_allowed: set[Signatory] = set(signatories)
@@ -127,6 +151,64 @@ class Replica:
         # moral equivalent of the reference's inbox channel hop.
         self._handling = False
         self._pending: deque = deque()
+        self._last_commit_time: Optional[float] = None
+
+    # --------------------------------------------------------- observability
+
+    def _instrument_committer(self, committer):
+        """Wrap the app's committer with metrics + logging: commit counter,
+        per-height latency histogram, rounds-to-commit histogram."""
+        if committer is None:
+            return None
+        replica = self
+
+        class _TracingCommitter:
+            def commit(self, height, value):
+                t = replica.tracer
+                now = t.now()
+                t.count("replica.commits")
+                t.observe("replica.commit.rounds", replica.proc.current_round + 1)
+                if replica._last_commit_time is not None:
+                    t.observe("replica.height.latency", now - replica._last_commit_time)
+                replica._last_commit_time = now
+                replica.logger.info(
+                    "commit %s",
+                    _kv(height=height, round=replica.proc.current_round, value=value),
+                )
+                return committer.commit(height, value)
+
+        return _TracingCommitter()
+
+    def _instrument_catcher(self, catcher):
+        """Wrap the app's catcher: count + log every piece of evidence."""
+        if catcher is None:
+            return None
+        replica = self
+
+        class _TracingCatcher:
+            def _note(self, kind, sender):
+                replica.tracer.count(f"replica.caught.{kind}")
+                replica.logger.warning(
+                    "byzantine evidence %s", _kv(kind=kind, sender=sender)
+                )
+
+            def catch_double_propose(self, new, existing):
+                self._note("double_propose", new.sender)
+                catcher.catch_double_propose(new, existing)
+
+            def catch_double_prevote(self, new, existing):
+                self._note("double_prevote", new.sender)
+                catcher.catch_double_prevote(new, existing)
+
+            def catch_double_precommit(self, new, existing):
+                self._note("double_precommit", new.sender)
+                catcher.catch_double_precommit(new, existing)
+
+            def catch_out_of_turn_propose(self, propose):
+                self._note("out_of_turn_propose", propose.sender)
+                catcher.catch_out_of_turn_propose(propose)
+
+        return _TracingCatcher()
 
     # ------------------------------------------------------------ sync driving
 
@@ -162,6 +244,10 @@ class Replica:
             self._handling = False
 
     def _handle_one(self, msg) -> None:
+        if self.tracer is not NULL_TRACER:
+            self.tracer.count(
+                _MSG_METRIC.get(type(msg), "replica.msg.other")
+            )
         try:
             if isinstance(msg, Timeout):
                 if msg.message_type == MessageType.PROPOSE:
@@ -185,6 +271,14 @@ class Replica:
                     return
                 self.mq.insert_precommit(msg)
             elif isinstance(msg, ResetHeight):
+                self.logger.info(
+                    "reset height %s",
+                    _kv(
+                        from_height=self.proc.current_height,
+                        to_height=msg.height,
+                        rotating=bool(msg.signatories),
+                    ),
+                )
                 self.proc.state = State.default_with_height(msg.height)
                 self.mq.drop_messages_below_height(msg.height)
                 if msg.signatories:
@@ -226,7 +320,12 @@ class Replica:
                 )
                 if not window:
                     return
-                keep = self.verifier.verify_batch(window)
+                self.tracer.observe("replica.verify.window", len(window))
+                with self.tracer.span("replica.verify.latency"):
+                    keep = self.verifier.verify_batch(window)
+                n_ok = sum(map(bool, keep))
+                self.tracer.count("replica.verify.accepted", n_ok)
+                self.tracer.count("replica.verify.rejected", len(window) - n_ok)
                 for msg, ok in zip(window, keep):
                     if not ok or msg.sender not in self.procs_allowed:
                         continue

@@ -1,0 +1,22 @@
+"""Cross-cutting utilities: tracing/metrics, logging, checkpointing."""
+
+from hyperdrive_tpu.utils.log import get_logger, kv
+from hyperdrive_tpu.utils.trace import (
+    NULL_TRACER,
+    Counter,
+    Histogram,
+    NullTracer,
+    Tracer,
+    profile,
+)
+
+__all__ = [
+    "get_logger",
+    "kv",
+    "NULL_TRACER",
+    "Counter",
+    "Histogram",
+    "NullTracer",
+    "Tracer",
+    "profile",
+]

@@ -1,0 +1,38 @@
+"""Structured logging for the framework.
+
+The reference injects a ``zap.Logger`` through every Options struct but
+never writes a single log line (SURVEY.md §5 — verified against the whole
+repo). This framework keeps the injectable-logger capability and actually
+uses it: the replica driver logs commits, height resyncs, signatory
+rotations, and caught equivocations.
+
+Loggers are stdlib :mod:`logging` with a key=value structured suffix so
+output is grep-able without a dependency. A library must not configure the
+root logger; :func:`get_logger` attaches a ``NullHandler`` and leaves
+configuration (level, sinks) to the application — mirroring the
+reference's "logger comes from the embedding app" stance.
+"""
+
+from __future__ import annotations
+
+import logging
+
+__all__ = ["get_logger", "kv"]
+
+
+def get_logger(name: str = "hyperdrive_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not any(isinstance(h, logging.NullHandler) for h in logger.handlers):
+        logger.addHandler(logging.NullHandler())
+    return logger
+
+
+def kv(**fields) -> str:
+    """Render key=value pairs for a structured log suffix. Bytes are
+    hex-abbreviated so 32-byte hashes stay readable."""
+    parts = []
+    for k, v in fields.items():
+        if isinstance(v, (bytes, bytearray)):
+            v = v.hex()[:16]
+        parts.append(f"{k}={v}")
+    return " ".join(parts)

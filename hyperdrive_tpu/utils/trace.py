@@ -1,0 +1,204 @@
+"""Tracing and metrics: counters, latency histograms, spans, profiler hook.
+
+The reference has **no** observability (SURVEY.md §5: zap loggers are
+configured but never called; `TraceLogs` is a consensus data structure, not
+tracing). This module is the greenfield subsystem the survey calls for:
+
+- :class:`Counter` / :class:`Histogram` — cheap process-local metrics.
+- :class:`Tracer` — a named registry of both, with ``span()`` context
+  timing, injectable everywhere a reference Options struct carried a
+  logger. The :data:`NULL_TRACER` singleton makes every call a no-op so
+  un-instrumented hot paths pay one attribute check.
+- :func:`profile` — wraps ``jax.profiler.trace`` when JAX is importable so
+  device traces (XLA ops, fusion, HBM traffic) land in TensorBoard format.
+
+Time sources are injectable: the deterministic harness passes its
+VirtualClock so round latencies are measured in simulated seconds, exactly
+reproducible across record/replay.
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+__all__ = ["Counter", "Histogram", "Tracer", "NullTracer", "NULL_TRACER", "profile"]
+
+
+class Counter:
+    """A monotonically increasing count."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Histogram:
+    """Fixed-bucket histogram with exact quantiles over a bounded sample.
+
+    Buckets follow a log-ish layout suited to latencies (seconds) and batch
+    sizes. The most recent ``max_samples`` raw observations are kept for
+    exact quantile queries; bucket counts never drop.
+    """
+
+    __slots__ = ("buckets", "counts", "total", "sum", "_samples", "_max_samples")
+
+    DEFAULT_BUCKETS = (
+        1e-6, 1e-5, 1e-4, 1e-3, 3e-3,
+        1e-2, 3e-2, 0.1, 0.3, 1.0,
+        3.0, 10.0, 30.0, 100.0, 1000.0,
+    )
+
+    def __init__(self, buckets=DEFAULT_BUCKETS, max_samples: int = 4096):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.total = 0
+        self.sum = 0.0
+        self._samples: list[float] = []
+        self._max_samples = max_samples
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+        self.total += 1
+        self.sum += v
+        if len(self._samples) < self._max_samples:
+            self._samples.append(v)
+        else:
+            # Reservoir-less ring overwrite: cheap, recent-biased.
+            self._samples[self.total % self._max_samples] = v
+
+    def quantile(self, q: float) -> float:
+        """Exact quantile over the retained sample window (0 if empty)."""
+        if not self._samples:
+            return 0.0
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, max(0, int(q * (len(s) - 1))))
+        return s[idx]
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.total if self.total else 0.0
+
+
+class Tracer:
+    """Named registry of counters and histograms with span timing.
+
+    ``time_fn`` defaults to ``time.perf_counter``; the simulator injects its
+    virtual clock so traces are deterministic.
+    """
+
+    def __init__(self, time_fn: Optional[Callable[[], float]] = None):
+        self.counters: dict[str, Counter] = {}
+        self.histograms: dict[str, Histogram] = {}
+        self._time = time_fn or time.perf_counter
+        # One tracer is typically shared by many replicas, and replicas may
+        # run on their own threads (Replica.run): all updates lock.
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- recording
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            c = self.counters.get(name)
+            if c is None:
+                c = self.counters[name] = Counter()
+            c.inc(n)
+
+    def observe(self, name: str, v: float) -> None:
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram()
+            h.observe(v)
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        """Time a block into histogram ``name`` (seconds)."""
+        t0 = self._time()
+        try:
+            yield
+        finally:
+            self.observe(name, self._time() - t0)
+
+    def now(self) -> float:
+        return self._time()
+
+    # ------------------------------------------------------------- reporting
+
+    def snapshot(self) -> dict:
+        """A JSON-friendly view of everything recorded."""
+        out: dict = {"counters": {}, "histograms": {}}
+        for name, c in sorted(self.counters.items()):
+            out["counters"][name] = c.value
+        for name, h in sorted(self.histograms.items()):
+            out["histograms"][name] = {
+                "count": h.total,
+                "mean": h.mean,
+                "p50": h.quantile(0.50),
+                "p95": h.quantile(0.95),
+                "p99": h.quantile(0.99),
+            }
+        return out
+
+    def render(self) -> str:
+        """Human-readable table of the snapshot."""
+        snap = self.snapshot()
+        lines = []
+        if snap["counters"]:
+            width = max(len(k) for k in snap["counters"])
+            lines.append("counters:")
+            for k, v in snap["counters"].items():
+                lines.append(f"  {k:<{width}}  {v}")
+        if snap["histograms"]:
+            lines.append("histograms (count / mean / p50 / p95 / p99):")
+            width = max(len(k) for k in snap["histograms"])
+            for k, h in snap["histograms"].items():
+                lines.append(
+                    f"  {k:<{width}}  {h['count']:>8}  {h['mean']:.6g}  "
+                    f"{h['p50']:.6g}  {h['p95']:.6g}  {h['p99']:.6g}"
+                )
+        return "\n".join(lines)
+
+
+class NullTracer(Tracer):
+    """All recording is a no-op; reporting returns empty structures."""
+
+    def __init__(self):
+        super().__init__(time_fn=lambda: 0.0)
+
+    def count(self, name: str, n: int = 1) -> None:
+        pass
+
+    def observe(self, name: str, v: float) -> None:
+        pass
+
+    @contextlib.contextmanager
+    def span(self, name: str) -> Iterator[None]:
+        yield
+
+
+#: Shared no-op tracer — the default everywhere a tracer is injectable.
+NULL_TRACER = NullTracer()
+
+
+@contextlib.contextmanager
+def profile(log_dir: str) -> Iterator[None]:
+    """Capture a JAX/XLA device profile into ``log_dir`` (TensorBoard
+    format). No-ops cleanly when the profiler is unavailable (e.g. pure
+    host runs)."""
+    try:
+        import jax
+
+        ctx = jax.profiler.trace(log_dir)
+    except Exception:
+        yield
+        return
+    with ctx:
+        yield
