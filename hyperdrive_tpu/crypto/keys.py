@@ -18,6 +18,15 @@ from hyperdrive_tpu.types import Signatory
 __all__ = ["KeyPair", "KeyRing"]
 
 
+def _backend():
+    """The shared C++ signer/verifier when buildable, else None (Python
+    oracle path). Resolved lazily so importing crypto never forces a
+    compile."""
+    from hyperdrive_tpu import native
+
+    return native.instance()
+
+
 @dataclass(frozen=True)
 class KeyPair:
     """A replica's Ed25519 seed and derived public identity."""
@@ -27,6 +36,9 @@ class KeyPair:
 
     @classmethod
     def from_seed(cls, seed: bytes) -> "KeyPair":
+        n = _backend()
+        if n is not None:
+            return cls(seed=seed, public=n.public_from_seed(seed))
         return cls(seed=seed, public=ed25519.public_key_from_seed(seed))
 
     @classmethod
@@ -39,6 +51,9 @@ class KeyPair:
         return self.public
 
     def sign_digest(self, digest: bytes) -> bytes:
+        n = _backend()
+        if n is not None:
+            return n.sign(self.seed, digest, pub=self.public)
         return ed25519.sign(self.seed, digest)
 
     def sign_message(self, msg):

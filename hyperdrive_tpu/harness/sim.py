@@ -69,9 +69,13 @@ class VirtualClock:
         return len(self._heap)
 
     def fire_next(self):
-        """Jump to the earliest deadline; return (event, handler)."""
+        """Jump to the earliest deadline; return (event, handler).
+
+        Delivery pacing can push ``now`` past a pending deadline — firing
+        must never move time backwards (monotonicity keeps the tracer's
+        latencies and subsequently scheduled deadlines coherent)."""
         deadline, _, event, handler = heapq.heappop(self._heap)
-        self.now = deadline
+        self.now = max(self.now, deadline)
         return event, handler
 
 
@@ -184,6 +188,7 @@ class Simulation:
         verifier_for: Optional[Callable[[int], object]] = None,
         signatories: Optional[list[bytes]] = None,
         sign: bool = False,
+        delivery_cost: float = 0.0,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -197,6 +202,11 @@ class Simulation:
         self.rng = random.Random(seed)
         self.reorder = reorder
         self.drop_rate = drop_rate
+        #: Virtual seconds charged per delivered message (the reference
+        #: harness paces deliveries at 1 ms, replica_test.go:291; 0 = free
+        #: delivery). With pacing on, per-height latency histograms measure
+        #: something real and stay deterministic.
+        self.delivery_cost = delivery_cost
         self.kill_at_step = dict(kill_at_step or {})
         self.offline = set(offline or ())
         self.clock = VirtualClock()
@@ -360,6 +370,8 @@ class Simulation:
             if not self.alive[to]:
                 continue
 
+            if self.delivery_cost:
+                self.clock.now += self.delivery_cost
             self.record.messages.append((to, msg))
             self.replicas[to].handle(msg)
 

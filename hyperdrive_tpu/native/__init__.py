@@ -73,6 +73,10 @@ def load():
         lib.hd_sha512.restype = None
         lib.hd_mod_l.restype = None
         lib.hd_cache_clear.restype = None
+        lib.hd_public_from_seed.restype = None
+        lib.hd_sign.restype = None
+        lib.hd_verify_batch.restype = ctypes.c_int
+        lib.hd_verify_one.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -81,12 +85,58 @@ def available() -> bool:
     return load() is not None
 
 
+def last_error() -> str | None:
+    """Why native is unavailable (None if it loaded or wasn't tried)."""
+    return _lib_err
+
+
+_packer = None
+_packer_failed = False
+
+
+def instance():
+    """Shared NativePacker, or None when native is unavailable — the one
+    place fallback policy lives (callers: verifier, keys, batch host)."""
+    global _packer, _packer_failed
+    if _packer is None and not _packer_failed:
+        try:
+            _packer = NativePacker()
+        except RuntimeError:
+            _packer_failed = True
+    return _packer
+
+
 def _u8ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
 def _i32ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _marshal_items(items):
+    """Marshal (pub32, payload, sig64) triples into the contiguous buffers
+    the C ABI consumes: (pubs, payloads, payload_lens, payload_stride,
+    sigs, in_ok). Wrong-length pubs/sigs get in_ok=0; payloads may be any
+    length. Shared by packing and host batch verification so the two paths
+    can never diverge."""
+    n = len(items)
+    stride = max((len(m) for _, m, _ in items), default=1) or 1
+    pubs = np.zeros((n, 32), dtype=np.uint8)
+    payloads = np.zeros((n, stride), dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.int32)
+    sigs = np.zeros((n, 64), dtype=np.uint8)
+    in_ok = np.zeros(n, dtype=np.uint8)
+    for i, (pub, payload, sig) in enumerate(items):
+        if len(pub) != 32 or len(sig) != 64:
+            continue
+        pubs[i] = np.frombuffer(pub, dtype=np.uint8)
+        if payload:
+            payloads[i, : len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+        lens[i] = len(payload)
+        sigs[i] = np.frombuffer(sig, dtype=np.uint8)
+        in_ok[i] = 1
+    return pubs, payloads, lens, stride, sigs, in_ok
 
 
 class NativePacker:
@@ -118,22 +168,7 @@ class NativePacker:
         output array for every item that passes host checks; returns the
         bool prevalid mask (length = len(items))."""
         n = len(items)
-        dstride = max((len(d) for _, d, _ in items), default=1) or 1
-        pubs = np.zeros((n, 32), dtype=np.uint8)
-        digests = np.zeros((n, dstride), dtype=np.uint8)
-        digest_lens = np.zeros(n, dtype=np.int32)
-        sigs = np.zeros((n, 64), dtype=np.uint8)
-        in_ok = np.zeros(n, dtype=np.uint8)
-        for i, (pub, digest, sig) in enumerate(items):
-            if len(pub) != 32 or len(sig) != 64:
-                continue
-            pubs[i] = np.frombuffer(pub, dtype=np.uint8)
-            if digest:
-                digests[i, : len(digest)] = np.frombuffer(digest, dtype=np.uint8)
-            digest_lens[i] = len(digest)
-            sigs[i] = np.frombuffer(sig, dtype=np.uint8)
-            in_ok[i] = 1
-
+        pubs, digests, digest_lens, dstride, sigs, in_ok = _marshal_items(items)
         prevalid = np.zeros(n, dtype=np.uint8)
         self._lib.hd_pack_batch(
             _u8ptr(pubs),
@@ -186,3 +221,71 @@ class NativePacker:
 
     def cache_clear(self) -> None:
         self._lib.hd_cache_clear()
+
+    # -------------------------------------------------------- sign / verify
+
+    def public_from_seed(self, seed: bytes) -> bytes:
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        out = np.zeros(32, dtype=np.uint8)
+        buf = np.frombuffer(seed, dtype=np.uint8)
+        self._lib.hd_public_from_seed(_u8ptr(np.ascontiguousarray(buf)), _u8ptr(out))
+        return out.tobytes()
+
+    def sign(self, seed: bytes, msg: bytes, pub: bytes | None = None) -> bytes:
+        """Sign ``msg``. Passing the (derivable) cached ``pub`` skips one of
+        the three base-point scalar multiplications."""
+        if len(seed) != 32:
+            raise ValueError("seed must be 32 bytes")
+        if pub is not None and len(pub) != 32:
+            raise ValueError("pub must be 32 bytes")
+        out = np.zeros(64, dtype=np.uint8)
+        sbuf = np.ascontiguousarray(np.frombuffer(seed, dtype=np.uint8))
+        mbuf = (
+            np.ascontiguousarray(np.frombuffer(msg, dtype=np.uint8))
+            if msg
+            else np.zeros(0, np.uint8)
+        )
+        pbuf = (
+            _u8ptr(np.ascontiguousarray(np.frombuffer(pub, dtype=np.uint8)))
+            if pub is not None
+            else None
+        )
+        self._lib.hd_sign(
+            _u8ptr(sbuf), pbuf, _u8ptr(mbuf), ctypes.c_size_t(len(msg)), _u8ptr(out)
+        )
+        return out.tobytes()
+
+    def verify(self, pub: bytes, msg: bytes, sig: bytes) -> bool:
+        if len(pub) != 32 or len(sig) != 64:
+            return False
+        pbuf = np.ascontiguousarray(np.frombuffer(pub, dtype=np.uint8))
+        mbuf = (
+            np.ascontiguousarray(np.frombuffer(msg, dtype=np.uint8))
+            if msg
+            else np.zeros(0, np.uint8)
+        )
+        sbuf = np.ascontiguousarray(np.frombuffer(sig, dtype=np.uint8))
+        return bool(
+            self._lib.hd_verify_one(
+                _u8ptr(pbuf), _u8ptr(mbuf), ctypes.c_size_t(len(msg)), _u8ptr(sbuf)
+            )
+        )
+
+    def verify_batch(self, items) -> np.ndarray:
+        """items: sequence of (pub, msg, sig); returns bool[n] of results.
+        Host-CPU batch verification (no device involved)."""
+        n = len(items)
+        pubs, msgs, lens, dstride, sigs, in_ok = _marshal_items(items)
+        out = np.zeros(n, dtype=np.uint8)
+        self._lib.hd_verify_batch(
+            _u8ptr(pubs),
+            _u8ptr(msgs),
+            _i32ptr(lens),
+            ctypes.c_int(dstride),
+            _u8ptr(sigs),
+            _u8ptr(in_ok),
+            ctypes.c_int(n),
+            _u8ptr(out),
+        )
+        return out.astype(bool)

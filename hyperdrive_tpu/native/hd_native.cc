@@ -510,6 +510,150 @@ int cached_decompress(const u8 key[32], Fe &x, Fe &y) {
   return ok ? 1 : 0;
 }
 
+// ------------------------------------------------------- point arithmetic
+//
+// Extended homogeneous coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z,
+// T = XY/Z on -x^2 + y^2 = 1 + d x^2 y^2 — the same unified a = -1
+// formulas as the Python oracle and the TPU kernel.
+
+struct Pt {
+  Fe x, y, z, t;
+};
+
+const Fe FE_2D = {{0x69b9426b2f159ULL, 0x35050762add7aULL, 0x3cf44c0038052ULL,
+                   0x6738cc7407977ULL, 0x2406d9dc56dffULL}};  // 2d mod p
+
+inline Pt pt_identity() {
+  return Pt{fe_zero(), fe_one(), fe_one(), fe_zero()};
+}
+
+Pt pt_add(const Pt &p, const Pt &q) {
+  Fe a = fe_mul(fe_sub(p.y, p.x), fe_sub(q.y, q.x));
+  Fe b = fe_mul(fe_add(p.y, p.x), fe_add(q.y, q.x));
+  Fe c = fe_mul(fe_mul(p.t, FE_2D), q.t);
+  Fe zz = fe_mul(p.z, q.z);
+  Fe d = fe_add(zz, zz);
+  Fe e = fe_sub(b, a);
+  Fe f = fe_sub(d, c);
+  Fe g = fe_add(d, c);
+  Fe h = fe_add(b, a);
+  return Pt{fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h)};
+}
+
+Pt pt_double(const Pt &p) { return pt_add(p, p); }
+
+// Scalar multiplication, 4-bit fixed windows (Horner from the top digit):
+// ~252 doublings + 63 additions + a 16-entry table.
+Pt pt_scalar_mul(const u8 scalar_le[32], const Pt &base) {
+  Pt table[16];
+  table[0] = pt_identity();
+  for (int i = 1; i < 16; i++) table[i] = pt_add(table[i - 1], base);
+  Pt acc = pt_identity();
+  for (int i = 31; i >= 0; i--) {
+    for (int half = 1; half >= 0; half--) {
+      int digit = (scalar_le[i] >> (4 * half)) & 0xF;
+      if (!(i == 31 && half == 1)) {
+        acc = pt_double(acc);
+        acc = pt_double(acc);
+        acc = pt_double(acc);
+        acc = pt_double(acc);
+      }
+      acc = pt_add(acc, table[digit]);
+    }
+  }
+  return acc;
+}
+
+// Projective equality: X1 Z2 == X2 Z1 && Y1 Z2 == Y2 Z1.
+bool pt_equal(const Pt &p, const Pt &q) {
+  return fe_eq(fe_mul(p.x, q.z), fe_mul(q.x, p.z)) &&
+         fe_eq(fe_mul(p.y, q.z), fe_mul(q.y, p.z));
+}
+
+// Base point B (y = 4/5, even x), affine limbs precomputed offline and
+// cross-checked by the differential tests.
+const Pt PT_BASE = {
+    {{0x62d608f25d51aULL, 0x412a4b4f6592aULL, 0x75b7171a4b31dULL,
+      0x1ff60527118feULL, 0x216936d3cd6e5ULL}},
+    {{0x6666666666658ULL, 0x4ccccccccccccULL, 0x1999999999999ULL,
+      0x3333333333333ULL, 0x6666666666666ULL}},
+    {{1, 0, 0, 0, 0}},
+    {{0x68ab3a5b7dda3ULL, 0xeea2a5eadbbULL, 0x2af8df483c27eULL,
+      0x332b375274732ULL, 0x67875f0fd78b7ULL}},
+};
+
+inline void pt_compress(u8 out[32], const Pt &p) {
+  Fe zinv = fe_invert(p.z);
+  Fe x = fe_mul(p.x, zinv);
+  Fe y = fe_mul(p.y, zinv);
+  fe_tobytes(out, y);
+  out[31] |= (u8)(fe_isodd(x) << 7);
+}
+
+// s = (a + b * c) mod L on little-endian 32-byte scalars (all < L).
+void sc_muladd(u8 out[32], const u8 a[32], const u8 b[32], const u8 c[32]) {
+  // Schoolbook 256x256 -> 512-bit product of b*c, plus a, then mod L.
+  u64 bw[4], cw[4], aw[4];
+  memcpy(bw, b, 32);
+  memcpy(cw, c, 32);
+  memcpy(aw, a, 32);
+  u64 prod[8] = {0};
+  for (int i = 0; i < 4; i++) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; j++) {
+      carry += (u128)bw[i] * cw[j] + prod[i + j];
+      prod[i + j] = (u64)carry;
+      carry >>= 64;
+    }
+    prod[i + 4] = (u64)carry;
+  }
+  u128 carry = 0;
+  for (int i = 0; i < 4; i++) {
+    carry += (u128)prod[i] + aw[i];
+    prod[i] = (u64)carry;
+    carry >>= 64;
+  }
+  for (int i = 4; i < 8 && carry; i++) {
+    carry += prod[i];
+    prod[i] = (u64)carry;
+    carry >>= 64;
+  }
+  u64 r[4];
+  sc_mod_l_512(prod, r);
+  memcpy(out, r, 32);
+}
+
+// Full RFC 8032 verification of one signature (host CPU path).
+bool verify_one(const u8 pub[32], const u8 *msg, size_t msg_len,
+                const u8 sig[64]) {
+  Fe ax, ay;
+  if (!cached_decompress(pub, ax, ay)) return false;
+  Fe rx, ry;
+  if (!point_decompress(sig, rx, ry)) return false;
+  u64 s_words[4];
+  memcpy(s_words, sig + 32, 32);
+  if (!sc_lt_l(s_words)) return false;
+
+  Sha512 h;
+  h.update(sig, 32);
+  h.update(pub, 32);
+  h.update(msg, msg_len);
+  u8 kh[64];
+  h.final(kh);
+  u64 kw[8], kr[4];
+  memcpy(kw, kh, 64);
+  sc_mod_l_512(kw, kr);
+  u8 kbytes[32];
+  memcpy(kbytes, kr, 32);
+
+  Pt a{ax, ay, fe_one(), fe_mul(ax, ay)};
+  Pt r{rx, ry, fe_one(), fe_mul(rx, ry)};
+  Pt sb = pt_scalar_mul(sig + 32, PT_BASE);
+  Pt ka = pt_scalar_mul(kbytes, a);
+  Pt rka = pt_add(r, ka);
+  return pt_equal(sb, rka);
+}
+
 }  // namespace
 
 // ------------------------------------------------------------------- C ABI
@@ -540,6 +684,98 @@ void hd_mod_l(const u8 *in64, u8 *out32) {
   u64 r[4];
   sc_mod_l_512(x, r);
   memcpy(out32, r, 32);
+}
+
+// Derive the public key (compressed point) from a 32-byte seed.
+void hd_public_from_seed(const u8 *seed, u8 *pub_out) {
+  u8 h[64];
+  Sha512 sh;
+  sh.update(seed, 32);
+  sh.final(h);
+  h[0] &= 248;
+  h[31] &= 127;
+  h[31] |= 64;
+  pt_compress(pub_out, pt_scalar_mul(h, PT_BASE));
+}
+
+// RFC 8032 Ed25519 signing: out = R (32B) || s (32B LE). ``pub_opt`` may
+// carry the caller's cached public key (it is always derivable from the
+// seed, but deriving costs a full base-point scalar multiplication —
+// callers that hold a KeyPair skip it); pass NULL to derive.
+void hd_sign(const u8 *seed, const u8 *pub_opt, const u8 *msg, size_t msg_len,
+             u8 *sig_out) {
+  u8 h[64];
+  Sha512 sh;
+  sh.update(seed, 32);
+  sh.final(h);
+  u8 a_scalar[32];
+  memcpy(a_scalar, h, 32);
+  a_scalar[0] &= 248;
+  a_scalar[31] &= 127;
+  a_scalar[31] |= 64;
+  u8 pub[32];
+  if (pub_opt) {
+    memcpy(pub, pub_opt, 32);
+  } else {
+    pt_compress(pub, pt_scalar_mul(a_scalar, PT_BASE));
+  }
+
+  // r = SHA-512(prefix || msg) mod L.
+  Sha512 hr;
+  hr.update(h + 32, 32);
+  hr.update(msg, msg_len);
+  u8 rh[64];
+  hr.final(rh);
+  u64 rw[8], rr[4];
+  memcpy(rw, rh, 64);
+  sc_mod_l_512(rw, rr);
+  u8 rbytes[32];
+  memcpy(rbytes, rr, 32);
+  pt_compress(sig_out, pt_scalar_mul(rbytes, PT_BASE));
+
+  // k = SHA-512(R || A || msg) mod L.
+  Sha512 hk;
+  hk.update(sig_out, 32);
+  hk.update(pub, 32);
+  hk.update(msg, msg_len);
+  u8 kh[64];
+  hk.final(kh);
+  u64 kw[8], kr[4];
+  memcpy(kw, kh, 64);
+  sc_mod_l_512(kw, kr);
+  u8 kbytes[32];
+  memcpy(kbytes, kr, 32);
+
+  // s = (r + k * a) mod L. The clamped a is < 2^255 but not < L; reduce it
+  // first so sc_muladd's inputs satisfy its contract.
+  u64 aw8[8] = {0}, ar[4];
+  memcpy(aw8, a_scalar, 32);
+  sc_mod_l_512(aw8, ar);
+  u8 abytes[32];
+  memcpy(abytes, ar, 32);
+  sc_muladd(sig_out + 32, rbytes, kbytes, abytes);
+}
+
+// Batch verification on the host CPU (the wire-speed fallback when no
+// device is attached). Layout mirrors hd_pack_batch; out[i] = 1 iff item i
+// is well-formed and its signature verifies.
+int hd_verify_batch(const u8 *pubs, const u8 *digests, const int32_t *digest_lens,
+                    int dstride, const u8 *sigs, const u8 *in_ok, int n,
+                    u8 *out) {
+  for (int i = 0; i < n; i++) {
+    out[i] = 0;
+    if (in_ok && !in_ok[i]) continue;
+    out[i] = verify_one(pubs + 32 * i, digests + (size_t)dstride * i,
+                        (size_t)digest_lens[i], sigs + 64 * i)
+                 ? 1
+                 : 0;
+  }
+  return 0;
+}
+
+// Single-shot verify (self-test hook / small paths).
+int hd_verify_one(const u8 *pub, const u8 *msg, size_t msg_len, const u8 *sig) {
+  return verify_one(pub, msg, msg_len, sig) ? 1 : 0;
 }
 
 // Reset the pubkey decompression cache (e.g. between unrelated tests).

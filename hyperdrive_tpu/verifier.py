@@ -38,10 +38,34 @@ class NullVerifier:
 
 
 class HostVerifier:
-    """Sequential host-side Ed25519 verification of each message's detached
-    signature, with the sender's public key as the verification key."""
+    """Host-CPU Ed25519 verification of each message's detached signature,
+    with the sender's public key as the verification key.
+
+    Uses the native C++ batch path (hyperdrive_tpu.native, ~35x the pure-
+    Python oracle) when the toolchain allows, falling back to per-message
+    Python verification. Both agree bit-for-bit (differentially tested).
+    """
+
+    def __init__(self):
+        from hyperdrive_tpu import native
+
+        self._native = native.instance()
 
     def verify_batch(self, window):
+        if self._native is not None:
+            items = [
+                (
+                    msg.sender,
+                    msg.digest(),
+                    msg.signature if len(msg.signature) == 64 else b"\x00" * 64,
+                )
+                for msg in window
+            ]
+            mask = self._native.verify_batch(items)
+            return [
+                bool(ok) and bool(msg.signature)
+                for ok, msg in zip(mask, window)
+            ]
         return [
             bool(msg.signature)
             and ed25519.verify(msg.sender, msg.digest(), msg.signature)

@@ -171,3 +171,76 @@ def test_env_var_disables_native():
 def test_use_native_false_skips_native():
     host = Ed25519BatchHost(use_native=False)
     assert host._native is None
+
+
+# --------------------------------------------------- sign / verify parity
+
+
+def test_sign_and_public_match_oracle(packer):
+    rng = random.Random(11)
+    for i in range(8):
+        seed = hashlib.sha256(b"sp%d" % i).digest()
+        msg = bytes(rng.randrange(256) for _ in range(rng.randrange(0, 80)))
+        assert packer.public_from_seed(seed) == ed.public_key_from_seed(seed)
+        assert packer.sign(seed, msg) == ed.sign(seed, msg)
+
+
+def test_verify_one_matches_oracle(packer):
+    rng = random.Random(12)
+    seed = hashlib.sha256(b"vo").digest()
+    pub = ed.public_key_from_seed(seed)
+    msg = b"the vote digest"
+    sig = ed.sign(seed, msg)
+    cases = [
+        (pub, msg, sig, True),
+        (pub, msg + b"!", sig, False),
+        (pub, msg, sig[:32] + bytes([sig[32] ^ 1]) + sig[33:], False),
+        (pub, msg, bytes([sig[0] ^ 1]) + sig[1:], False),
+        (b"\xff" * 32, msg, sig, False),
+        (pub, msg, sig[:32] + (ed.L).to_bytes(32, "little"), False),
+    ]
+    for p_, m, s, want in cases:
+        assert packer.verify(p_, m, s) == want
+        assert ed.verify(p_, m, s) == want
+    # Random garbage agreement.
+    for _ in range(30):
+        p_ = bytes(rng.randrange(256) for _ in range(32))
+        s = bytes(rng.randrange(256) for _ in range(64))
+        assert packer.verify(p_, msg, s) == ed.verify(p_, msg, s)
+
+
+def test_verify_batch_matches_singles(packer):
+    seeds = [hashlib.sha256(b"vb%d" % i).digest() for i in range(6)]
+    items = []
+    for i, seed in enumerate(seeds):
+        pub = ed.public_key_from_seed(seed)
+        msg = hashlib.sha256(b"payload%d" % i).digest()
+        sig = ed.sign(seed, msg)
+        if i % 3 == 2:  # corrupt every third
+            sig = sig[:40] + bytes([sig[40] ^ 0xFF]) + sig[41:]
+        items.append((pub, msg, sig))
+    items.append((b"short", b"msg", b"sig"))  # malformed lengths
+    mask = packer.verify_batch(items)
+    expect = [ed.verify(p_, m, s) for p_, m, s in items]
+    assert mask.tolist() == expect
+
+
+def test_host_verifier_uses_native_and_agrees():
+    from hyperdrive_tpu.crypto.keys import KeyPair
+    from hyperdrive_tpu.messages import Prevote
+    from hyperdrive_tpu.verifier import HostVerifier
+
+    kp = KeyPair.deterministic(b"hv-native")
+    good = kp.sign_message(
+        Prevote(height=1, round=0, value=b"\x01" * 32, sender=kp.public)
+    )
+    bad = Prevote(
+        height=1, round=0, value=b"\x02" * 32, sender=kp.public
+    ).with_signature(b"\x00" * 64)
+    unsigned = Prevote(height=1, round=0, value=b"\x03" * 32, sender=kp.public)
+    hv = HostVerifier()
+    assert hv._native is not None
+    assert hv.verify_batch([good, bad, unsigned]) == [True, False, False]
+    # Python fallback agrees.
+    hv._native = None
+    assert hv.verify_batch([good, bad, unsigned]) == [True, False, False]
