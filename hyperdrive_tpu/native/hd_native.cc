@@ -395,6 +395,10 @@ inline bool sc_lt_l(const u64 r[4]) {
 // Binary long division: 512-bit (8 LE words) mod L -> 4 LE words.
 // ~512 cheap word ops per call; exactness over speed (this is a few percent
 // of the packing cost; the exponentiations dominate).
+//
+// Constant-time: signing reduces the secret nonce r and challenge products
+// through here, so the per-bit conditional subtract is a branch-free masked
+// select — the instruction trace is identical for every input.
 void sc_mod_l_512(const u64 x[8], u64 out[4]) {
   u64 r[4] = {0, 0, 0, 0};
   for (int bit = 511; bit >= 0; bit--) {
@@ -406,16 +410,17 @@ void sc_mod_l_512(const u64 x[8], u64 out[4]) {
     r[0] = (r[0] << 1) | ((x[bit >> 6] >> (bit & 63)) & 1);
     // top can only be set transiently right after shifting; since r < L <
     // 2^253 before each shift, r_new < 2^254, so top is always 0 — but the
-    // compare-subtract below is what maintains that invariant.
-    if (top || !sc_lt_l(r)) {
-      u64 borrow = 0;
-      for (int i = 0; i < 4; i++) {
-        u64 s = r[i] - L_WORDS[i] - borrow;
-        borrow = (r[i] < L_WORDS[i] + borrow) ||
-                 (borrow && L_WORDS[i] + borrow == 0);
-        r[i] = s;
-      }
+    // masked subtract below is what maintains that invariant.
+    u64 t[4], borrow = 0;
+    for (int i = 0; i < 4; i++) {
+      u128 d = (u128)r[i] - L_WORDS[i] - borrow;
+      t[i] = (u64)d;
+      borrow = (u64)(d >> 64) & 1;
     }
+    // Use t iff the subtraction did not borrow (r >= L) or a bit shifted
+    // out (top): mask = all-ones when subtracting.
+    u64 mask = 0 - (top | (borrow ^ 1));
+    for (int i = 0; i < 4; i++) r[i] ^= mask & (r[i] ^ t[i]);
   }
   memcpy(out, r, 32);
 }
@@ -542,26 +547,66 @@ Pt pt_add(const Pt &p, const Pt &q) {
 
 Pt pt_double(const Pt &p) { return pt_add(p, p); }
 
+inline void fe_cmov(Fe &r, const Fe &a, u64 mask) {
+  for (int i = 0; i < 5; i++) r.v[i] ^= mask & (r.v[i] ^ a.v[i]);
+}
+
+inline void pt_cmov(Pt &r, const Pt &a, u64 mask) {
+  fe_cmov(r.x, a.x, mask);
+  fe_cmov(r.y, a.y, mask);
+  fe_cmov(r.z, a.z, mask);
+  fe_cmov(r.t, a.t, mask);
+}
+
 // Scalar multiplication, 4-bit fixed windows (Horner from the top digit):
-// ~252 doublings + 63 additions + a 16-entry table.
-Pt pt_scalar_mul(const u8 scalar_le[32], const Pt &base) {
+// ~252 doublings + 63 additions + a 16-entry table. One ladder serves both
+// trust models; only the table-lookup step differs:
+//
+// - kConstTime=false: direct indexed lookup. For public scalars only
+//   (verification: s, k are attacker-known).
+// - kConstTime=true: reads all 16 entries and selects with branch-free
+//   masked moves, so neither the memory trace nor the branch pattern
+//   depends on the scalar. For secret scalars (signing / key derivation).
+//   The field arithmetic itself (fe_mul etc.) is already constant-time
+//   (fixed-shape u64 limb schoolbook, no secret branches), and the only
+//   branch in the ladder is on the loop index.
+template <bool kConstTime>
+Pt pt_scalar_mul_impl(const u8 scalar_le[32], const Pt &base) {
   Pt table[16];
   table[0] = pt_identity();
   for (int i = 1; i < 16; i++) table[i] = pt_add(table[i - 1], base);
   Pt acc = pt_identity();
   for (int i = 31; i >= 0; i--) {
     for (int half = 1; half >= 0; half--) {
-      int digit = (scalar_le[i] >> (4 * half)) & 0xF;
-      if (!(i == 31 && half == 1)) {
+      u64 digit = (u64)((scalar_le[i] >> (4 * half)) & 0xF);
+      if (!(i == 31 && half == 1)) {  // loop-index branch, not secret
         acc = pt_double(acc);
         acc = pt_double(acc);
         acc = pt_double(acc);
         acc = pt_double(acc);
       }
-      acc = pt_add(acc, table[digit]);
+      if constexpr (kConstTime) {
+        Pt entry = table[0];
+        for (u64 j = 1; j < 16; j++) {
+          u64 eq = digit ^ j;  // 0 iff j == digit
+          u64 mask = (u64)(((eq | (0 - eq)) >> 63) ^ 1) * ~0ULL;
+          pt_cmov(entry, table[j], mask);
+        }
+        acc = pt_add(acc, entry);
+      } else {
+        acc = pt_add(acc, table[digit]);
+      }
     }
   }
   return acc;
+}
+
+Pt pt_scalar_mul(const u8 scalar_le[32], const Pt &base) {
+  return pt_scalar_mul_impl<false>(scalar_le, base);
+}
+
+Pt pt_scalar_mul_ct(const u8 scalar_le[32], const Pt &base) {
+  return pt_scalar_mul_impl<true>(scalar_le, base);
 }
 
 // Projective equality: X1 Z2 == X2 Z1 && Y1 Z2 == Y2 Z1.
@@ -613,7 +658,10 @@ void sc_muladd(u8 out[32], const u8 a[32], const u8 b[32], const u8 c[32]) {
     prod[i] = (u64)carry;
     carry >>= 64;
   }
-  for (int i = 4; i < 8 && carry; i++) {
+  // Fixed-shape carry propagation (no early exit): the inputs are the
+  // secret nonce and secret-key products, so whether the carry ripples
+  // must not show in the branch pattern.
+  for (int i = 4; i < 8; i++) {
     carry += prod[i];
     prod[i] = (u64)carry;
     carry >>= 64;
@@ -695,7 +743,7 @@ void hd_public_from_seed(const u8 *seed, u8 *pub_out) {
   h[0] &= 248;
   h[31] &= 127;
   h[31] |= 64;
-  pt_compress(pub_out, pt_scalar_mul(h, PT_BASE));
+  pt_compress(pub_out, pt_scalar_mul_ct(h, PT_BASE));
 }
 
 // RFC 8032 Ed25519 signing: out = R (32B) || s (32B LE). ``pub_opt`` may
@@ -717,7 +765,7 @@ void hd_sign(const u8 *seed, const u8 *pub_opt, const u8 *msg, size_t msg_len,
   if (pub_opt) {
     memcpy(pub, pub_opt, 32);
   } else {
-    pt_compress(pub, pt_scalar_mul(a_scalar, PT_BASE));
+    pt_compress(pub, pt_scalar_mul_ct(a_scalar, PT_BASE));
   }
 
   // r = SHA-512(prefix || msg) mod L.
@@ -731,7 +779,7 @@ void hd_sign(const u8 *seed, const u8 *pub_opt, const u8 *msg, size_t msg_len,
   sc_mod_l_512(rw, rr);
   u8 rbytes[32];
   memcpy(rbytes, rr, 32);
-  pt_compress(sig_out, pt_scalar_mul(rbytes, PT_BASE));
+  pt_compress(sig_out, pt_scalar_mul_ct(rbytes, PT_BASE));
 
   // k = SHA-512(R || A || msg) mod L.
   Sha512 hk;

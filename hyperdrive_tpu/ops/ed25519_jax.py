@@ -345,14 +345,11 @@ class TpuBatchVerifier:
 
     def verify_batch(self, window):
         """Verifier-protocol entry: messages with detached signatures."""
-        items = [
-            (
-                msg.sender,
-                msg.digest(),
-                msg.signature if len(msg.signature) == 64 else b"\x00" * 64,
-            )
-            for msg in window
-        ]
+        # Signatures pass through unchanged: the packer (native or Python)
+        # length-checks and leaves wrong-length lanes prevalid=False, so
+        # rejection is deterministic — never substitute zeros, which could
+        # verify under an adversarial small-order pubkey.
+        items = [(msg.sender, msg.digest(), msg.signature) for msg in window]
         # Messages with no signature at all fail immediately (parity with
         # HostVerifier), but still occupy a lane for shape stability.
         unsigned = np.array([not msg.signature for msg in window], dtype=bool)

@@ -657,10 +657,19 @@ class Process:
 
     def unmarshal_into(self, r: Reader) -> None:
         """Restore identity, f, and State from a checkpoint
-        (reference: process/process.go:209-223)."""
-        self.whoami = r.bytes32()
-        self.f = r.u64()
-        self.state = State.unmarshal(r)
+        (reference: process/process.go:209-223).
+
+        All fields are parsed into locals first and assigned only once the
+        whole payload has deserialized, so a malformed checkpoint (even one
+        that passes the envelope CRC) raises without leaving the Process
+        torn between old and new state.
+        """
+        whoami = r.bytes32()
+        f = r.u64()
+        state = State.unmarshal(r)
+        self.whoami = whoami
+        self.f = f
+        self.state = state
 
     # ------------------------------------------------------------ properties
 

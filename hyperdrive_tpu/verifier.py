@@ -53,13 +53,13 @@ class HostVerifier:
 
     def verify_batch(self, window):
         if self._native is not None:
+            # Signatures pass through unchanged: the native marshaller
+            # length-checks and marks wrong-length signatures invalid, so
+            # rejection is deterministic and identical to the Python path
+            # (never substitute a zero signature — with an adversarial
+            # small-order pubkey a zero signature can *verify*).
             items = [
-                (
-                    msg.sender,
-                    msg.digest(),
-                    msg.signature if len(msg.signature) == 64 else b"\x00" * 64,
-                )
-                for msg in window
+                (msg.sender, msg.digest(), msg.signature) for msg in window
             ]
             mask = self._native.verify_batch(items)
             return [

@@ -106,3 +106,23 @@ def test_verifier_protocol_matches_host_verifier(verifier, ring):
         else:
             msgs.append(pv.with_signature(b"\x01" * 64))  # garbage sig
     assert verifier.verify_batch(msgs) == hv.verify_batch(msgs)
+
+
+def test_wrong_length_signatures_reject_deterministically(verifier, ring):
+    # Wrong-length signatures must be structurally rejected on every path
+    # (never zero-padded and verified: with an adversarial small-order
+    # pubkey a zero signature can probabilistically verify). Host native,
+    # host Python, and device paths must all agree: deterministic False.
+    hv = HostVerifier()
+    kp = ring[0]
+    msgs = []
+    for n in (0, 1, 32, 63, 65, 128):
+        pv = Prevote(height=1, round=0, value=bytes([n % 256]) * 32, sender=kp.public)
+        msgs.append(pv.with_signature(b"\x07" * n))
+    # One valid message so the batch isn't all-rejected.
+    good = Prevote(height=1, round=0, value=b"\x2a" * 32, sender=kp.public)
+    msgs.append(kp.sign_message(good))
+
+    want = [False] * 6 + [True]
+    assert hv.verify_batch(msgs) == want
+    assert verifier.verify_batch(msgs) == want

@@ -242,3 +242,31 @@ def test_restart_mid_consensus_rejoins(tmp_path):
     # commits (deterministic by-height values).
     for h, v in commits_b.items():
         assert v == bytes([h % 256]) * 32
+
+
+def test_checkpoint_semantic_corruption_leaves_proc_untouched():
+    # A payload that passes the envelope CRC but fails mid-State-parse must
+    # not leave the Process torn (whoami/f updated, state old). Rebuild a
+    # valid envelope around a truncated payload body so only the inner
+    # State.unmarshal raises.
+    import zlib
+
+    from hyperdrive_tpu.codec import Writer
+
+    proc = _make_proc(8)
+    blob = checkpoint_bytes(proc)
+    payload = blob[20:-7]  # cut into the State section
+    head = Writer(rem=64)
+    head.u32(0x48594350)
+    head.u32(1)
+    head.u64(len(payload))
+    head.u32(zlib.crc32(payload) & 0xFFFFFFFF)
+    evil = head.data() + payload
+
+    target = Process(whoami=b"\x11" * 32, f=9)
+    before_state = target.state.clone()
+    with pytest.raises(SerdeError):
+        restore_bytes(target, evil)
+    assert target.whoami == b"\x11" * 32
+    assert target.f == 9
+    assert target.state == before_state
