@@ -16,6 +16,7 @@ survivors to the Process in order — the "batched drain" of SURVEY.md §7.1(4).
 
 from __future__ import annotations
 
+import heapq
 from bisect import bisect_right
 from typing import Callable, Iterable
 
@@ -117,24 +118,43 @@ class MessageQueue:
 
     def drain_window(self, height: Height, window: int) -> list[Message]:
         """Pop up to ``window`` messages with height <= ``height``, in
-        per-sender order, without dispatching them.
+        **global ascending (height, round) order across senders**, without
+        dispatching them.
 
         This is the wide input for the batched TPU Verifier: the caller
         verifies the window as one launch and feeds survivors to the
         Process. Whitelisting is the caller's job (it already is for
         :meth:`consume`'s callback contract).
+
+        Ordering contract: a capped window always contains the globally
+        smallest (height, round) keys among eligible messages, merged
+        across the per-sender queues (stable: FIFO within a sender, and
+        senders tie-break in queue-creation order). This means the Process
+        can never be fed a later round before an earlier one within a
+        window — the interleave a per-message consume loop would produce —
+        so batching changes *when* rules fire, never the key order votes
+        arrive in.
         """
+        # k-way merge of the per-sender eligible prefixes. Entries carry
+        # (key..., sender_order, index) so heap comparison never reaches
+        # the non-comparable queue object and equal keys stay deterministic.
+        heap: list[tuple[int, int, int, int, list]] = []
+        for order, q in enumerate(self._queues.values()):
+            if q and q[0].height <= height:
+                heap.append((q[0].height, q[0].round, order, 0, q))
+        heapq.heapify(heap)
+
         out: list[Message] = []
-        for _, q in self._queues.items():
-            remaining = window - len(out)
-            if remaining <= 0:
-                break
-            i = 0
-            while i < len(q) and i < remaining and q[i].height <= height:
-                i += 1
-            if i:
-                out.extend(q[:i])
-                del q[:i]
+        taken: dict[int, tuple[list, int]] = {}
+        while heap and len(out) < window:
+            h, r, order, i, q = heapq.heappop(heap)
+            out.append(q[i])
+            taken[order] = (q, i + 1)
+            i += 1
+            if i < len(q) and q[i].height <= height:
+                heapq.heappush(heap, (q[i].height, q[i].round, order, i, q))
+        for q, count in taken.values():
+            del q[:count]
         return out
 
     # -------------------------------------------------------------------- drop

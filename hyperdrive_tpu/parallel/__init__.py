@@ -10,9 +10,10 @@ reference assumes an external network.
 """
 
 from hyperdrive_tpu.parallel.mesh import (
+    grid_pack,
     make_mesh,
     make_sharded_step,
     sharded_verify_tally,
 )
 
-__all__ = ["make_mesh", "make_sharded_step", "sharded_verify_tally"]
+__all__ = ["grid_pack", "make_mesh", "make_sharded_step", "sharded_verify_tally"]

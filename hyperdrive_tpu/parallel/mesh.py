@@ -34,7 +34,7 @@ from hyperdrive_tpu.ops import fe25519 as fe
 from hyperdrive_tpu.ops import tally as tally_ops
 from hyperdrive_tpu.ops.ed25519_jax import verify_kernel
 
-__all__ = ["make_mesh", "sharded_verify_tally", "make_sharded_step"]
+__all__ = ["make_mesh", "sharded_verify_tally", "make_sharded_step", "grid_pack"]
 
 
 def make_mesh(devices=None, hr: int = 1, val: int | None = None) -> Mesh:
@@ -106,6 +106,38 @@ def sharded_verify_tally(mesh: Mesh):
         check_vma=False,
     )
     return jax.jit(shard_fn)
+
+
+def grid_pack(ring, rounds: int, validators: int, values, corrupt=()):
+    """Sign one vote per (round, validator) and pack to [R, V, ...] arrays
+    ready for :func:`sharded_verify_tally`.
+
+    ``values``: one 32-byte proposal value per round (each vote's digest is
+    ``values[r] + bytes([r])``). ``corrupt``: set of (r, v) pairs whose
+    signature scalar s gets one bit flipped — the lane still *parses*
+    (prevalid stays True; s remains < L except with negligible probability)
+    so rejection exercises the device kernel, not the host packer.
+    Returns (shaped_arrays, prevalid[R, V]).
+    """
+    from hyperdrive_tpu.crypto import ed25519 as host_ed
+    from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost
+
+    host = Ed25519BatchHost(buckets=(rounds * validators,))
+    items = []
+    for r in range(rounds):
+        for v in range(validators):
+            kp = ring[v]
+            digest = values[r] + bytes([r])
+            sig = host_ed.sign(kp.seed, digest)
+            if (r, v) in corrupt:
+                sig = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
+            items.append((kp.public, digest, sig))
+    arrays, prevalid, n = host.pack(items)
+    assert n == rounds * validators
+    shaped = tuple(
+        jnp.asarray(a).reshape(rounds, validators, *a.shape[1:]) for a in arrays
+    )
+    return shaped, prevalid.reshape(rounds, validators)
 
 
 def make_sharded_step(mesh: Mesh):

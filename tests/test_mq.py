@@ -137,3 +137,70 @@ def test_drain_window_multiple_senders():
     window = mq.drain_window(height=1, window=10)
     assert len(window) == 3
     assert len(mq) == 0
+
+
+def test_drain_window_global_hr_interleave(rng):
+    """A multi-sender multi-height backlog drains in global ascending
+    (height, round) order — not per-sender blocks."""
+    mq = MessageQueue()
+    coords = [(h, r) for h in range(1, 5) for r in range(3)]
+    inserts = [(s, h, r) for s in (1, 2, 3, 4) for (h, r) in coords]
+    rng.shuffle(inserts)
+    for s, h, r in inserts:
+        mq.insert_prevote(pv(sig(s), h, r))
+
+    window = mq.drain_window(height=10, window=10_000)
+    keys = [(m.height, m.round) for m in window]
+    assert keys == sorted(keys)
+    assert len(window) == len(inserts)
+    # Every (h, r) key appears once per sender, grouped together.
+    for h, r in coords:
+        assert keys.count((h, r)) == 4
+
+
+def test_drain_window_cap_takes_globally_smallest_keys():
+    """When the window caps, the drained prefix is the globally smallest
+    (h, r) keys — a later round can never jump ahead of an earlier one."""
+    mq = MessageQueue()
+    # Sender 1 holds early rounds, sender 2 holds later rounds.
+    for r in (0, 1, 2):
+        mq.insert_prevote(pv(sig(1), 1, r))
+    for r in (3, 4, 5):
+        mq.insert_prevote(pv(sig(2), 1, r))
+    window = mq.drain_window(height=1, window=4)
+    assert [(m.height, m.round) for m in window] == [(1, 0), (1, 1), (1, 2), (1, 3)]
+    # The remainder is intact and drains next.
+    window = mq.drain_window(height=1, window=4)
+    assert [(m.height, m.round) for m in window] == [(1, 4), (1, 5)]
+
+
+def test_drain_window_fifo_within_equal_keys():
+    """Equal (h, r) keys from one sender stay FIFO through the merge."""
+    mq = MessageQueue()
+    a = Prevote(height=1, round=0, value=b"\x0a" * 32, sender=sig(1))
+    b = Prevote(height=1, round=0, value=b"\x0b" * 32, sender=sig(1))
+    mq.insert_prevote(a)
+    mq.insert_prevote(b)
+    window = mq.drain_window(height=1, window=10)
+    assert window == [a, b]
+
+
+def test_drain_window_matches_consume_key_order(rng):
+    """The window's (h, r) key sequence equals the sorted key sequence a
+    consume drain dispatches — batching must not reorder keys."""
+    mq1, mq2 = MessageQueue(), MessageQueue()
+    inserts = []
+    for s in range(1, 6):
+        for _ in range(20):
+            inserts.append((s, rng.randrange(1, 4), rng.randrange(0, 5)))
+    rng.shuffle(inserts)
+    for s, h, r in inserts:
+        m = pv(sig(s), h, r)
+        mq1.insert_prevote(m)
+        mq2.insert_prevote(m)
+
+    window = mq1.drain_window(height=3, window=10_000)
+    got, _ = collect(mq2, 3, {sig(s) for s in range(1, 6)})
+    assert sorted((m.height, m.round) for m in got) == [
+        (m.height, m.round) for m in window
+    ]
