@@ -248,6 +248,10 @@ class Simulation:
         self.record.signatories = list(self.signatories)
         self.commits: list[dict[Height, Value]] = [dict() for _ in range(n)]
         self.alive = [i not in self.offline for i in range(n)]
+        # Incremental completion tracking: a replica leaves the pending set
+        # when it commits the target height (or dies), so the per-step
+        # completion check is O(1) instead of O(n).
+        self._pending_replicas = {i for i in range(n) if self.alive[i]}
         self.caught: list[tuple[str, int]] = []
 
         byz_prop = byzantine_proposer or {}
@@ -304,12 +308,7 @@ class Simulation:
             timer,
             MockProposer(fn=byz_proposer or self._default_value),
             MockValidator(fn=byz_validator) if byz_validator else MockValidator(ok=True),
-            CommitterCallback(
-                on_commit=lambda h, v, i=i: (
-                    self.commits[i].__setitem__(h, v),
-                    (0, None),
-                )[1]
-            ),
+            CommitterCallback(on_commit=lambda h, v, i=i: self._on_commit(i, h, v)),
             CatcherCallbacks(
                 on_double_propose=lambda a, b, i=i: self.caught.append(("double_propose", i)),
                 on_double_prevote=lambda a, b, i=i: self.caught.append(("double_prevote", i)),
@@ -324,11 +323,14 @@ class Simulation:
 
     # -------------------------------------------------------------- running
 
+    def _on_commit(self, i: int, height: Height, value: Value):
+        self.commits[i][height] = value
+        if height >= self.target_height:
+            self._pending_replicas.discard(i)
+        return (0, None)
+
     def _completed(self) -> bool:
-        return all(
-            not alive or r.current_height() > self.target_height
-            for r, alive in zip(self.replicas, self.alive)
-        )
+        return not self._pending_replicas
 
     def run(self, max_steps: int = 2_000_000) -> SimulationResult:
         for i, r in enumerate(self.replicas):
@@ -365,8 +367,11 @@ class Simulation:
                     continue
             if self.kill_at_step:
                 for victim, at in list(self.kill_at_step.items()):
-                    if steps >= at and self.alive[victim]:
-                        self.alive[victim] = False
+                    if steps >= at:
+                        if self.alive[victim]:
+                            self.alive[victim] = False
+                            self._pending_replicas.discard(victim)
+                        del self.kill_at_step[victim]  # fired — stop rescanning
             if not self.alive[to]:
                 continue
 

@@ -32,16 +32,68 @@ Message = Propose | Prevote | Precommit
 
 
 class MessageQueue:
-    """Sorted, bounded, per-sender buffering of consensus messages."""
+    """Sorted, bounded, per-sender buffering of consensus messages.
 
-    __slots__ = ("max_capacity", "_queues")
+    A persistent head-heap indexes each non-empty sender queue by its head
+    (height, round) key, so :meth:`consume` and :meth:`drain_window` cost
+    O(eligible log senders) instead of scanning every sender — the flush
+    loop runs after *every* handled message (replica/replica.go:148), so a
+    full scan per flush is O(n) per message and dominates at n=256.
+    Heap entries are lazily invalidated: ``_head_key`` records the key each
+    sender is currently registered under; popped entries that disagree are
+    stale and dropped.
+    """
+
+    __slots__ = ("max_capacity", "_queues", "_order", "_heads", "_head_key")
 
     def __init__(self, max_capacity: int = DEFAULT_MAX_CAPACITY):
         self.max_capacity = int(max_capacity)
         self._queues: dict[Signatory, list[Message]] = {}
+        #: sender -> stable tiebreak index (queue-creation order).
+        self._order: dict[Signatory, int] = {}
+        #: lazy min-heap of (height, round, order, sender) head keys.
+        self._heads: list[tuple[Height, int, int, Signatory]] = []
+        #: sender -> the (height, round, order) its live heap entry carries.
+        self._head_key: dict[Signatory, tuple[Height, int, int]] = {}
 
     def __len__(self) -> int:
         return sum(len(q) for q in self._queues.values())
+
+    def _register_head(self, sender: Signatory) -> None:
+        """(Re)register ``sender``'s current queue head in the heap."""
+        q = self._queues.get(sender)
+        if not q:
+            self._head_key.pop(sender, None)
+            return
+        key = (q[0].height, q[0].round, self._order[sender])
+        if self._head_key.get(sender) != key:
+            self._head_key[sender] = key
+            heapq.heappush(self._heads, (*key, sender))
+
+    def _pop_eligible_sender(self, height: Height):
+        """Pop the sender with the smallest head key <= ``height``; returns
+        (sender, queue) or None. Discards stale entries as it goes."""
+        while self._heads:
+            h, r, order, sender = self._heads[0]
+            if self._head_key.get(sender) != (h, r, order):
+                heapq.heappop(self._heads)  # stale
+                continue
+            if h > height:
+                return None
+            heapq.heappop(self._heads)
+            del self._head_key[sender]
+            return sender, self._queues[sender]
+        return None
+
+    def _peek_head(self):
+        """The smallest live head key (height, round, order), or None."""
+        while self._heads:
+            h, r, order, sender = self._heads[0]
+            if self._head_key.get(sender) != (h, r, order):
+                heapq.heappop(self._heads)  # stale
+                continue
+            return (h, r, order)
+        return None
 
     # ------------------------------------------------------------------ insert
 
@@ -57,7 +109,10 @@ class MessageQueue:
         self._insert(precommit)
 
     def _insert(self, msg: Message) -> None:
-        q = self._queues.setdefault(msg.sender, [])
+        q = self._queues.get(msg.sender)
+        if q is None:
+            q = self._queues[msg.sender] = []
+            self._order[msg.sender] = len(self._order)
         # Insert after all entries with the same (height, round) so equal-key
         # messages stay FIFO (reference: sort.Search semantics, mq/mq.go:117-127).
         idx = bisect_right(q, (msg.height, msg.round), key=lambda m: (m.height, m.round))
@@ -65,6 +120,8 @@ class MessageQueue:
         # Drop the far-future tail when over capacity (reference: mq/mq.go:139-142).
         if len(q) > self.max_capacity:
             del q[self.max_capacity :]
+        if idx == 0:
+            self._register_head(msg.sender)
 
     # ----------------------------------------------------------------- consume
 
@@ -87,26 +144,28 @@ class MessageQueue:
             if isinstance(procs_allowed, (set, frozenset, dict))
             else set(procs_allowed)
         )
-        # Two-phase drain: detach each sender's eligible prefix *before*
-        # dispatching it, so callbacks that reentrantly insert messages (a
-        # synchronous loopback broadcaster) cannot corrupt the iteration.
-        # The Go reference is immune only because broadcasts hop through a
-        # channel; the synchronous driving mode must be safe on its own.
+        # Two-phase drain: detach every eligible prefix *before* dispatching,
+        # so callbacks that reentrantly insert messages (a synchronous
+        # loopback broadcaster) cannot corrupt the iteration. The Go
+        # reference is immune only because broadcasts hop through a channel;
+        # the synchronous driving mode must be safe on its own.
         n = 0
-        for sender in list(self._queues.keys()):
-            q = self._queues.get(sender)
-            if not q:
-                continue
+        batches: list[list[Message]] = []
+        while True:
+            popped = self._pop_eligible_sender(height)
+            if popped is None:
+                break
+            sender, q = popped
             i = 0
             while i < len(q) and q[i].height <= height:
                 i += 1
-            if not i:
-                continue
             batch = q[:i]
             del q[:i]
+            self._register_head(sender)
             n += len(batch)
-            if sender not in allowed:
-                continue
+            if sender in allowed:
+                batches.append(batch)
+        for batch in batches:
             for msg in batch:
                 if isinstance(msg, Propose):
                     propose(msg)
@@ -135,26 +194,25 @@ class MessageQueue:
         so batching changes *when* rules fire, never the key order votes
         arrive in.
         """
-        # k-way merge of the per-sender eligible prefixes. Entries carry
-        # (key..., sender_order, index) so heap comparison never reaches
-        # the non-comparable queue object and equal keys stay deterministic.
-        heap: list[tuple[int, int, int, int, list]] = []
-        for order, q in enumerate(self._queues.values()):
-            if q and q[0].height <= height:
-                heap.append((q[0].height, q[0].round, order, 0, q))
-        heapq.heapify(heap)
-
+        # k-way merge over the persistent head-heap: pop the smallest-headed
+        # sender, take its run of messages while they stay eligible and
+        # ahead of the next-best head, then re-register its new head.
         out: list[Message] = []
-        taken: dict[int, tuple[list, int]] = {}
-        while heap and len(out) < window:
-            h, r, order, i, q = heapq.heappop(heap)
-            out.append(q[i])
-            taken[order] = (q, i + 1)
-            i += 1
-            if i < len(q) and q[i].height <= height:
-                heapq.heappush(heap, (q[i].height, q[i].round, order, i, q))
-        for q, count in taken.values():
-            del q[:count]
+        while len(out) < window:
+            popped = self._pop_eligible_sender(height)
+            if popped is None:
+                break
+            sender, q = popped
+            my_order = self._order[sender]
+            nxt = self._peek_head()
+            i = 0
+            while i < len(q) and len(out) < window and q[i].height <= height:
+                if nxt is not None and (q[i].height, q[i].round, my_order) > nxt:
+                    break
+                out.append(q[i])
+                i += 1
+            del q[:i]
+            self._register_head(sender)
         return out
 
     # -------------------------------------------------------------------- drop
@@ -168,3 +226,4 @@ class MessageQueue:
                 i += 1
             if i:
                 del q[:i]
+                self._register_head(sender)

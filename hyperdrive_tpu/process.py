@@ -371,12 +371,9 @@ class Process:
             self.state.current_round, False
         )
 
-        prevotes_at_vr = sum(
-            1
-            for pv in self.state.prevote_logs.get(vr, {}).values()
-            if pv.value == propose.value
-        )
-        if prevotes_at_vr < 2 * self.f + 1:
+        # O(1) tally lookup (the reference scans the round's votes here,
+        # process/process.go:486-491).
+        if self.state.count_prevotes_for(vr, propose.value) < 2 * self.f + 1:
             return
 
         if self.broadcaster is not None:
@@ -437,12 +434,11 @@ class Process:
             return
         if not self.state.propose_is_valid.get(self.state.current_round, False):
             return
-        prevotes_for_value = sum(
-            1
-            for pv in self.state.prevote_logs.get(self.state.current_round, {}).values()
-            if pv.value == propose.value
-        )
-        if prevotes_for_value < 2 * self.f + 1:
+        # O(1) tally lookup (reference scan: process/process.go:574-579).
+        if (
+            self.state.count_prevotes_for(self.state.current_round, propose.value)
+            < 2 * self.f + 1
+        ):
             return
 
         was_prevoting = self.state.current_step == Step.PREVOTING
@@ -475,12 +471,11 @@ class Process:
         (reference: process/process.go:622-643)."""
         if self.state.current_step != Step.PREVOTING:
             return
-        prevotes_for_nil = sum(
-            1
-            for pv in self.state.prevote_logs.get(self.state.current_round, {}).values()
-            if pv.value == NIL_VALUE
-        )
-        if prevotes_for_nil >= 2 * self.f + 1:
+        # O(1) tally lookup (reference scan: process/process.go:626-631).
+        if (
+            self.state.count_prevotes_for(self.state.current_round, NIL_VALUE)
+            >= 2 * self.f + 1
+        ):
             if self.broadcaster is not None:
                 self.broadcaster.broadcast_precommit(
                     Precommit(
@@ -525,12 +520,8 @@ class Process:
             return
         if not self.state.propose_is_valid.get(round, False):
             return
-        precommits_for_value = sum(
-            1
-            for pc in self.state.precommit_logs.get(round, {}).values()
-            if pc.value == propose.value
-        )
-        if precommits_for_value < 2 * self.f + 1:
+        # O(1) tally lookup (reference scan: process/process.go:696-701).
+        if self.state.count_precommits_for(round, propose.value) < 2 * self.f + 1:
             return
 
         new_f, new_scheduler = self.committer.commit(
@@ -598,28 +589,22 @@ class Process:
         """Validate and log a Prevote (reference: process/process.go:823-855)."""
         if prevote.height != self.state.current_height:
             return False
-        votes = self.state.prevote_logs.setdefault(prevote.round, {})
-        existing = votes.get(prevote.sender)
+        existing = self.state.add_prevote(prevote)
         if existing is not None:
             if prevote != existing and self.catcher is not None:
                 self.catcher.catch_double_prevote(prevote, existing)
             return False
-        votes[prevote.sender] = prevote
-        self.state.trace_logs.setdefault(prevote.round, set()).add(prevote.sender)
         return True
 
     def _insert_precommit(self, precommit: Precommit) -> bool:
         """Validate and log a Precommit (reference: process/process.go:860-892)."""
         if precommit.height != self.state.current_height:
             return False
-        votes = self.state.precommit_logs.setdefault(precommit.round, {})
-        existing = votes.get(precommit.sender)
+        existing = self.state.add_precommit(precommit)
         if existing is not None:
             if precommit != existing and self.catcher is not None:
                 self.catcher.catch_double_precommit(precommit, existing)
             return False
-        votes[precommit.sender] = precommit
-        self.state.trace_logs.setdefault(precommit.round, set()).add(precommit.sender)
         return True
 
     # ------------------------------------------------------------ step moves

@@ -67,6 +67,16 @@ class State:
     # round -> set of unique signatories seen this round (L55 round skip)
     trace_logs: dict[int, set[bytes]] = field(default_factory=dict)
 
+    # Derived tallies: round -> {value -> count} over the vote logs,
+    # maintained incrementally by :meth:`add_prevote`/:meth:`add_precommit`
+    # so every quorum rule reads one dict lookup instead of scanning the
+    # round's votes (the reference's four O(n) hot loops,
+    # process/process.go:487-491, 574-579, 626-631, 696-701 — at n=256
+    # those scans are the host bottleneck). Not serialized: rebuilt from
+    # the logs on unmarshal, so the checkpoint format is unchanged.
+    prevote_counts: dict[int, dict[bytes, int]] = field(default_factory=dict)
+    precommit_counts: dict[int, dict[bytes, int]] = field(default_factory=dict)
+
     # ------------------------------------------------------------------ basics
 
     @classmethod
@@ -89,6 +99,8 @@ class State:
             precommit_logs={r: dict(m) for r, m in self.precommit_logs.items()},
             once_flags=dict(self.once_flags),
             trace_logs={r: set(s) for r, s in self.trace_logs.items()},
+            prevote_counts={r: dict(c) for r, c in self.prevote_counts.items()},
+            precommit_counts={r: dict(c) for r, c in self.precommit_counts.items()},
         )
 
     def equal(self, other: "State") -> bool:
@@ -117,6 +129,63 @@ class State:
         self.precommit_logs = {}
         self.once_flags = {}
         self.trace_logs = {}
+        self.prevote_counts = {}
+        self.precommit_counts = {}
+
+    # ------------------------------------------------------------ vote logging
+
+    def add_prevote(self, prevote: Prevote):
+        """Log a prevote, updating the round's tally and trace log.
+
+        Returns the already-logged vote from the same sender (without
+        mutating anything) if one exists — the caller decides whether that
+        is a duplicate or equivocation — else None after inserting.
+        """
+        votes = self.prevote_logs.setdefault(prevote.round, {})
+        existing = votes.get(prevote.sender)
+        if existing is not None:
+            return existing
+        votes[prevote.sender] = prevote
+        counts = self.prevote_counts.setdefault(prevote.round, {})
+        counts[prevote.value] = counts.get(prevote.value, 0) + 1
+        self.trace_logs.setdefault(prevote.round, set()).add(prevote.sender)
+        return None
+
+    def add_precommit(self, precommit: Precommit):
+        """Log a precommit; same contract as :meth:`add_prevote`."""
+        votes = self.precommit_logs.setdefault(precommit.round, {})
+        existing = votes.get(precommit.sender)
+        if existing is not None:
+            return existing
+        votes[precommit.sender] = precommit
+        counts = self.precommit_counts.setdefault(precommit.round, {})
+        counts[precommit.value] = counts.get(precommit.value, 0) + 1
+        self.trace_logs.setdefault(precommit.round, set()).add(precommit.sender)
+        return None
+
+    def count_prevotes_for(self, round: int, value: bytes) -> int:
+        """Prevotes at ``round`` whose value equals ``value`` — O(1)."""
+        counts = self.prevote_counts.get(round)
+        return counts.get(value, 0) if counts else 0
+
+    def count_precommits_for(self, round: int, value: bytes) -> int:
+        """Precommits at ``round`` whose value equals ``value`` — O(1)."""
+        counts = self.precommit_counts.get(round)
+        return counts.get(value, 0) if counts else 0
+
+    def rebuild_counts(self) -> None:
+        """Recompute the derived tallies from the logs — for states whose
+        logs were populated directly (unmarshal, test generators)."""
+        self.prevote_counts = {}
+        for rnd, votes in self.prevote_logs.items():
+            counts = self.prevote_counts.setdefault(rnd, {})
+            for v in votes.values():
+                counts[v.value] = counts.get(v.value, 0) + 1
+        self.precommit_counts = {}
+        for rnd, votes in self.precommit_logs.items():
+            counts = self.precommit_counts.setdefault(rnd, {})
+            for v in votes.values():
+                counts[v.value] = counts.get(v.value, 0) + 1
 
     # ------------------------------------------------------------------- serde
 
@@ -218,4 +287,5 @@ class State:
             for _ in range(_count()):
                 sigs.add(r.bytes32())
             st.trace_logs[rnd] = sigs
+        st.rebuild_counts()
         return st

@@ -292,4 +292,7 @@ def random_state(rng: random.Random) -> State:
         st.trace_logs[rng.randint(0, 100)] = {
             random_signatory(rng) for _ in range(rng.randint(0, 4))
         }
+    # The logs above were populated directly; bring the derived tallies in
+    # sync so the state behaves like one built through add_prevote/precommit.
+    st.rebuild_counts()
     return st

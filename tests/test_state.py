@@ -99,3 +99,46 @@ def test_reset_for_new_height(rng):
     assert not st.precommit_logs
     assert not st.once_flags
     assert not st.trace_logs
+
+
+def test_derived_counts_track_logs(rng):
+    from hyperdrive_tpu.messages import Precommit, Prevote
+
+    st = State()
+    values = [bytes([i + 1]) * 32 for i in range(3)]
+    expect = {}
+    first = None
+    for i in range(60):
+        rnd = rng.randrange(3)
+        v = values[rng.randrange(3)]
+        sender = bytes([i]) * 32
+        msg = Prevote(height=1, round=rnd, value=v, sender=sender)
+        if first is None:
+            first = msg
+        assert st.add_prevote(msg) is None
+        expect[(rnd, v)] = expect.get((rnd, v), 0) + 1
+    for (rnd, v), n in expect.items():
+        assert st.count_prevotes_for(rnd, v) == n
+    # Same (sender, round) again: returned, not counted.
+    count_before = st.count_prevotes_for(first.round, first.value)
+    assert st.add_prevote(first) is first
+    assert st.count_prevotes_for(first.round, first.value) == count_before
+
+    # Counts survive a serde round-trip (rebuilt, not serialized).
+    w = Writer(rem=1 << 20)
+    st.marshal(w)
+    back = State.unmarshal(Reader(w.data(), rem=1 << 20))
+    assert back.prevote_counts == st.prevote_counts
+    assert back.precommit_counts == st.precommit_counts
+
+    # And reset wipes them.
+    st.reset_for_new_height()
+    assert st.count_prevotes_for(0, values[0]) == 0
+    assert not st.prevote_counts
+
+    # Precommit side: same contract.
+    pc = Precommit(height=1, round=0, value=values[1], sender=b"\x77" * 32)
+    assert st.add_precommit(pc) is None
+    assert st.count_precommits_for(0, values[1]) == 1
+    assert st.add_precommit(pc) is not None
+    assert st.count_precommits_for(0, values[1]) == 1
