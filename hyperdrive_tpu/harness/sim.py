@@ -91,11 +91,15 @@ class ScenarioRecord:
     signatories: list[bytes] = field(default_factory=list)
     #: Every delivered (to, message) in delivery order.
     messages: list[tuple[int, object]] = field(default_factory=list)
+    #: Burst-mode runs record each superstep's delivered-message count so
+    #: replay reproduces the same window boundaries (empty = lock-step run).
+    bursts: list[int] = field(default_factory=list)
 
     #: Format magic+version; bump on any envelope/layout change so stale
     #: dumps are rejected with a clear error instead of desynchronizing.
+    #: v3 appends the burst-size trailer; v2 dumps (no trailer) still load.
     MAGIC = 0x48594456  # "HYDV"
-    VERSION = 2
+    VERSION = 3
 
     def marshal(self, w: Writer) -> None:
         w.u32(self.MAGIC)
@@ -111,6 +115,9 @@ class ScenarioRecord:
         for to, msg in self.messages:
             w.u32(to)
             marshal_message(msg, w)
+        w.u32(len(self.bursts))
+        for b in self.bursts:
+            w.u32(b)
 
     @classmethod
     def unmarshal(cls, r: Reader) -> "ScenarioRecord":
@@ -118,7 +125,7 @@ class ScenarioRecord:
         if magic != cls.MAGIC:
             raise SerdeError(f"not a scenario dump (magic {magic:#x})")
         version = r.u32()
-        if version != cls.VERSION:
+        if version not in (2, cls.VERSION):
             raise SerdeError(
                 f"scenario dump version {version} unsupported "
                 f"(expected {cls.VERSION})"
@@ -132,6 +139,11 @@ class ScenarioRecord:
         if nmsgs > 1 << 24:
             raise SerdeError("message count too large")
         rec.messages = [(r.u32(), unmarshal_message(r)) for _ in range(nmsgs)]
+        if version >= 3:
+            nb = r.u32()
+            if nb > 1 << 24:
+                raise SerdeError("burst count too large")
+            rec.bursts = [r.u32() for _ in range(nb)]
         return rec
 
     def dump(self, path: str) -> None:
@@ -189,12 +201,26 @@ class Simulation:
         signatories: Optional[list[bytes]] = None,
         sign: bool = False,
         delivery_cost: float = 0.0,
+        burst: bool = False,
+        batch_verifier=None,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
         a :class:`~hyperdrive_tpu.verifier.HostVerifier` on each replica
         unless ``verifier_for`` overrides it — authenticated consensus end
-        to end, the host baseline of BASELINE.md config 4."""
+        to end, the host baseline of BASELINE.md config 4.
+
+        ``burst=True`` switches delivery from lock-step (one message, one
+        flush) to supersteps: every pending delivery is buffered into its
+        destination's queue, then the whole network settles through the
+        two-phase drain/dispatch protocol — all replicas' windows are
+        signature-checked in ONE ``batch_verifier`` launch per settle pass
+        (:class:`~hyperdrive_tpu.ops.ed25519_jax.TpuBatchVerifier` for the
+        device path, :class:`~hyperdrive_tpu.verifier.HostVerifier` for the
+        host baseline). This is the batched replica driving mode of
+        SURVEY.md §7.1(4): per-message interleaving becomes per-burst, each
+        replica still sees its messages in global (height, round) order, and
+        burst boundaries are recorded for exact replay."""
         self.n = n
         self.f = n // 3
         self.target_height = target_height
@@ -224,6 +250,15 @@ class Simulation:
             seed=seed, n=n, f=self.f, target_height=target_height
         )
 
+        self.burst = burst
+        self.batch_verifier = batch_verifier
+        if batch_verifier is not None and not burst:
+            raise ValueError("batch_verifier requires burst=True")
+        if burst and verifier_for is not None:
+            raise ValueError(
+                "burst mode verifies at the network settle layer; pass "
+                "batch_verifier instead of per-replica verifier_for"
+            )
         self.ring = None
         if sign:
             from hyperdrive_tpu.crypto.keys import KeyRing
@@ -238,7 +273,10 @@ class Simulation:
                     "fail (replay a signed dump with the same seed instead)"
                 )
             self.signatories = self.ring.signatories
-            if verifier_for is None:
+            if burst:
+                if batch_verifier is None:
+                    self.batch_verifier = HostVerifier()
+            elif verifier_for is None:
                 verifier_for = lambda i: HostVerifier()  # noqa: E731
         else:
             self.signatories = signatories or [
@@ -302,7 +340,11 @@ class Simulation:
         )
 
         return Replica(
-            ReplicaOptions(max_capacity=capacity, tracer=self.tracer),
+            ReplicaOptions(
+                max_capacity=capacity,
+                tracer=self.tracer,
+                external_flush=self.burst,
+            ),
             self.signatories[i],
             list(self.signatories),
             timer,
@@ -336,6 +378,8 @@ class Simulation:
         for i, r in enumerate(self.replicas):
             if self.alive[i]:
                 r.start()
+        if self.burst:
+            return self._run_burst(max_steps)
 
         steps = 0
         while steps < max_steps and not self._completed():
@@ -390,6 +434,100 @@ class Simulation:
             alive=self.alive,
         )
 
+    # ---------------------------------------------------------- burst mode
+
+    def _run_burst(self, max_steps: int) -> SimulationResult:
+        """Superstep delivery: buffer every pending message into its
+        destination, then settle the whole network through aggregated
+        verification. Delivery order within a superstep is recorded, so
+        replay is exact; faults/drops/reorder apply per message exactly as
+        in lock-step mode."""
+        steps = 0
+        while steps < max_steps and not self._completed():
+            if self._qhead >= len(self.queue):
+                if self.clock.pending() == 0:
+                    break  # genuine stall
+                event, owner = self.clock.fire_next()
+                self.queue.append((owner, event))
+
+            # Take the whole pending slice; broadcasts emitted while
+            # delivering (timeout dispatches, settle-phase votes) append to
+            # the fresh queue and form the NEXT superstep.
+            batch = self.queue[self._qhead :]
+            self.queue = []
+            self._qhead = 0
+            if self.reorder:
+                self.rng.shuffle(batch)
+
+            # Kills apply at superstep boundaries (not mid-burst): a replica
+            # alive for any part of a superstep settles the whole superstep,
+            # so every recorded delivery was also dispatched — replay (where
+            # kills don't exist) then reproduces the run exactly.
+            if self.kill_at_step:
+                for victim, at in list(self.kill_at_step.items()):
+                    if steps >= at:
+                        if self.alive[victim]:
+                            self.alive[victim] = False
+                            self._pending_replicas.discard(victim)
+                        del self.kill_at_step[victim]
+
+            delivered = 0
+            for to, msg in batch:
+                steps += 1
+                if self.drop_rate and not isinstance(msg, Timeout):
+                    if self.rng.random() < self.drop_rate:
+                        continue
+                if not self.alive[to]:
+                    continue
+                if self.delivery_cost:
+                    self.clock.now += self.delivery_cost
+                self.record.messages.append((to, msg))
+                self.replicas[to].handle(msg)  # buffers only: external_flush
+                delivered += 1
+            self.record.bursts.append(delivered)
+            self._settle()
+
+        return SimulationResult(
+            completed=self._completed(),
+            steps=steps,
+            virtual_time=self.clock.now,
+            heights=[r.current_height() for r in self.replicas],
+            commits=self.commits,
+            record=self.record,
+            alive=self.alive,
+        )
+
+    def _settle(self) -> None:
+        """Drain every live replica's window, verify ALL windows in one
+        aggregated ``batch_verifier`` launch, dispatch the survivors; repeat
+        until the network is quiescent — the flush-until-quiescent contract
+        (reference: replica/replica.go:251-264) lifted to the superstep."""
+        while True:
+            windows: list[tuple[int, list]] = []
+            for i, r in enumerate(self.replicas):
+                if not self.alive[i]:
+                    continue
+                w = r.drain_pending()
+                if w:
+                    windows.append((i, w))
+            if not windows:
+                return
+            if self.batch_verifier is None:
+                for i, w in windows:
+                    self.replicas[i].dispatch_window(w)
+                continue
+            items = [
+                (m.sender, m.digest(), m.signature)
+                for _, w in windows
+                for m in w
+            ]
+            self.tracer.observe("sim.verify.launch", len(items))
+            mask = self.batch_verifier.verify_signatures(items)
+            off = 0
+            for i, w in windows:
+                self.replicas[i].dispatch_window(w, mask[off : off + len(w)])
+                off += len(w)
+
     # -------------------------------------------------------------- replay
 
     @classmethod
@@ -399,13 +537,17 @@ class Simulation:
 
         The replayed network uses the recorded signatories and delivers only
         the recorded messages — no clock, no adversary — so a dumped failure
-        reproduces exactly.
+        reproduces exactly. Burst-mode records (non-empty ``bursts``) replay
+        superstep-for-superstep: each recorded burst is buffered then
+        settled, reproducing the original window boundaries (pass
+        ``batch_verifier=`` to re-verify during replay).
         """
         sim = cls(
             n=record.n,
             target_height=record.target_height,
             seed=record.seed,
             signatories=list(record.signatories),
+            burst=bool(record.bursts),
             **kwargs,
         )
         for i, r in enumerate(sim.replicas):
@@ -414,11 +556,23 @@ class Simulation:
         sim.queue.clear()
         sim._qhead = 0
         steps = 0
-        for to, msg in record.messages:
-            if not sim.alive[to]:
-                continue
-            sim.replicas[to].handle(msg)
-            steps += 1
+        if record.bursts:
+            idx = 0
+            for b in record.bursts:
+                for to, msg in record.messages[idx : idx + b]:
+                    if sim.alive[to]:
+                        sim.replicas[to].handle(msg)
+                        steps += 1
+                idx += b
+                sim.queue.clear()  # replay ignores re-broadcasts
+                sim._qhead = 0
+                sim._settle()
+        else:
+            for to, msg in record.messages:
+                if not sim.alive[to]:
+                    continue
+                sim.replicas[to].handle(msg)
+                steps += 1
         return SimulationResult(
             completed=sim._completed(),
             steps=steps,

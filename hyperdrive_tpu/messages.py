@@ -58,6 +58,7 @@ class Propose:
     value: bytes
     sender: bytes
     signature: bytes = field(default=b"", compare=False)
+    _digest: bytes = field(default=b"", init=False, repr=False, compare=False)
 
     def digest(self) -> bytes:
         """Signing digest over (height, round, valid_round, value).
@@ -67,13 +68,23 @@ class Propose:
         The leading byte is a per-type domain-separation tag (the
         MessageType) so digests of different message types can never
         collide, regardless of field layout.
+
+        Memoized: in the harness one broadcast object fans out to every
+        replica, so the digest is computed once per broadcast instead of
+        once per delivery. (The cache never covers the signature, so
+        ``with_signature`` copies need no invalidation.)
         """
+        d = self._digest
+        if d:
+            return d
         w = Writer()
         w.i64(self.height)
         w.i64(self.round)
         w.i64(self.valid_round)
         w.bytes32(self.value)
-        return hashlib.sha256(b"\x01" + w.data()).digest()
+        d = hashlib.sha256(b"\x01" + w.data()).digest()
+        object.__setattr__(self, "_digest", d)
+        return d
 
     def size_hint(self) -> int:
         return 8 + 8 + 8 + 32 + 32
@@ -111,14 +122,21 @@ class Prevote:
     value: bytes
     sender: bytes
     signature: bytes = field(default=b"", compare=False)
+    _digest: bytes = field(default=b"", init=False, repr=False, compare=False)
 
     def digest(self) -> bytes:
-        """Mirrors ``NewPrevoteHash`` (reference: process/message.go:165-186)."""
+        """Mirrors ``NewPrevoteHash`` (reference: process/message.go:165-186).
+        Memoized (see :meth:`Propose.digest`)."""
+        d = self._digest
+        if d:
+            return d
         w = Writer()
         w.i64(self.height)
         w.i64(self.round)
         w.bytes32(self.value)
-        return hashlib.sha256(b"\x02" + w.data()).digest()
+        d = hashlib.sha256(b"\x02" + w.data()).digest()
+        object.__setattr__(self, "_digest", d)
+        return d
 
     def size_hint(self) -> int:
         return 8 + 8 + 32 + 32
@@ -153,18 +171,25 @@ class Precommit:
     value: bytes
     sender: bytes
     signature: bytes = field(default=b"", compare=False)
+    _digest: bytes = field(default=b"", init=False, repr=False, compare=False)
 
     def digest(self) -> bytes:
         """Mirrors ``NewPrecommitHash`` (reference: process/message.go:263-284).
 
         A distinct domain-separation tag keeps prevote and precommit digests
         for the same (height, round, value) from colliding.
+        Memoized (see :meth:`Propose.digest`).
         """
+        d = self._digest
+        if d:
+            return d
         w = Writer()
         w.i64(self.height)
         w.i64(self.round)
         w.bytes32(self.value)
-        return hashlib.sha256(b"\x03" + w.data()).digest()
+        d = hashlib.sha256(b"\x03" + w.data()).digest()
+        object.__setattr__(self, "_digest", d)
+        return d
 
     def size_hint(self) -> int:
         return 8 + 8 + 32 + 32

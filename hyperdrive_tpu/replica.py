@@ -80,6 +80,12 @@ class ReplicaOptions:
     starting_height: Height = DEFAULT_HEIGHT
     max_capacity: int = DEFAULT_MAX_CAPACITY
     verify_window: int = 1024
+    #: When True, :meth:`Replica.handle` buffers into the mq but never
+    #: flushes — an external driver runs the two-phase
+    #: :meth:`Replica.drain_pending` / :meth:`Replica.dispatch_window`
+    #: protocol so many replicas' windows can be signature-verified in one
+    #: aggregated device launch (the harness burst mode).
+    external_flush: bool = False
     tracer: object = None
     logger: object = None
 
@@ -289,7 +295,8 @@ class Replica:
                     self.procs_allowed = set(sigs)
             else:
                 return
-            self._flush()
+            if not self.opts.external_flush:
+                self._flush()
         finally:
             if self.did_handle_message is not None:
                 self.did_handle_message()
@@ -323,18 +330,51 @@ class Replica:
                 self.tracer.observe("replica.verify.window", len(window))
                 with self.tracer.span("replica.verify.latency"):
                     keep = self.verifier.verify_batch(window)
-                n_ok = sum(map(bool, keep))
-                self.tracer.count("replica.verify.accepted", n_ok)
-                self.tracer.count("replica.verify.rejected", len(window) - n_ok)
-                for msg, ok in zip(window, keep):
-                    if not ok or msg.sender not in self.procs_allowed:
-                        continue
-                    if isinstance(msg, Propose):
-                        self.proc.propose(msg)
-                    elif isinstance(msg, Prevote):
-                        self.proc.prevote(msg)
-                    else:
-                        self.proc.precommit(msg)
+                self.dispatch_window(window, keep)
+
+    # ------------------------------------------------- external (burst) flush
+    #
+    # The two-phase protocol behind ``external_flush=True``: a driver that
+    # owns many replicas pulls each one's eligible window (phase 1), verifies
+    # every window in one aggregated batch — one device launch for the whole
+    # network instead of one per replica — then hands each replica its
+    # verdict slice to dispatch (phase 2). Repeating until every window is
+    # empty reproduces the flush-until-quiescent contract
+    # (reference: replica/replica.go:251-264) at the network level.
+
+    def drain_pending(self) -> list:
+        """Phase 1: pop this replica's eligible window without dispatching."""
+        return self.mq.drain_window(
+            self.proc.current_height, self.opts.verify_window
+        )
+
+    def dispatch_window(self, window, keep=None) -> None:
+        """Phase 2: feed the verified survivors of ``window`` to the Process.
+
+        ``keep`` is the external verifier's accept mask (None = all
+        accepted). Whitelisting stays here — it is replica state
+        (reference: replica/replica.go:69-72), not a property of the
+        signature. A mid-window commit advances the height; stale survivors
+        are rejected by the Process's own height check, matching what the
+        per-message consume loop would have dropped.
+        """
+        verified = keep is not None
+        n_ok = 0
+        for j, msg in enumerate(window):
+            if verified and not keep[j]:
+                continue
+            if msg.sender not in self.procs_allowed:
+                continue
+            n_ok += 1
+            if isinstance(msg, Propose):
+                self.proc.propose(msg)
+            elif isinstance(msg, Prevote):
+                self.proc.prevote(msg)
+            else:
+                self.proc.precommit(msg)
+        if verified and self.tracer is not NULL_TRACER:
+            self.tracer.count("replica.verify.accepted", n_ok)
+            self.tracer.count("replica.verify.rejected", len(window) - n_ok)
 
     def _filter_height(self, height: Height) -> bool:
         """Only current-or-future heights are kept

@@ -71,3 +71,12 @@ class HostVerifier:
             and ed25519.verify(msg.sender, msg.digest(), msg.signature)
             for msg in window
         ]
+
+    def verify_signatures(self, items):
+        """Raw (pub, digest, sig) triples -> bool mask (sliceable,
+        per-element assignable); the aggregated-batch entry point shared
+        with TpuBatchVerifier so harness drivers can swap host and device
+        backends freely."""
+        if self._native is not None:
+            return self._native.verify_batch(items)
+        return [ed25519.verify(pub, digest, sig) for pub, digest, sig in items]
