@@ -203,6 +203,7 @@ class Simulation:
         delivery_cost: float = 0.0,
         burst: bool = False,
         batch_verifier=None,
+        dedup_verify: bool = False,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -220,7 +221,17 @@ class Simulation:
         host baseline). This is the batched replica driving mode of
         SURVEY.md §7.1(4): per-message interleaving becomes per-burst, each
         replica still sees its messages in global (height, round) order, and
-        burst boundaries are recorded for exact replay."""
+        burst boundaries are recorded for exact replay.
+
+        ``dedup_verify=True`` verifies each distinct (sender, digest,
+        signature) once per settle launch and fans the verdict out to every
+        receiver. One simulated chip then performs one replica's
+        verification load (each broadcast checked once), which is the
+        per-chip work of a real deployment where every validator owns its
+        chip; with it off, the single chip redundantly re-verifies each
+        broadcast for all n receivers — n× the deployment's per-chip load.
+        Acceptance decisions are identical either way (verification is
+        deterministic), so safety/replay semantics do not change."""
         self.n = n
         self.f = n // 3
         self.target_height = target_height
@@ -252,6 +263,7 @@ class Simulation:
 
         self.burst = burst
         self.batch_verifier = batch_verifier
+        self.dedup_verify = dedup_verify
         if batch_verifier is not None and not burst:
             raise ValueError("batch_verifier requires burst=True")
         if burst and verifier_for is not None:
@@ -516,17 +528,41 @@ class Simulation:
                 for i, w in windows:
                     self.replicas[i].dispatch_window(w)
                 continue
-            items = [
-                (m.sender, m.digest(), m.signature)
-                for _, w in windows
-                for m in w
-            ]
-            self.tracer.observe("sim.verify.launch", len(items))
-            mask = self.batch_verifier.verify_signatures(items)
-            off = 0
-            for i, w in windows:
-                self.replicas[i].dispatch_window(w, mask[off : off + len(w)])
-                off += len(w)
+            if self.dedup_verify:
+                # One lane per distinct broadcast: the same message object
+                # fans out to all receivers, so key on the triple and give
+                # every receiver its broadcast's single verdict.
+                index: dict[tuple, int] = {}
+                items = []
+                slots: list[list[int]] = []
+                for _, w in windows:
+                    row = []
+                    for m in w:
+                        key = (m.sender, m.digest(), m.signature)
+                        j = index.get(key)
+                        if j is None:
+                            j = index[key] = len(items)
+                            items.append(key)
+                        row.append(j)
+                    slots.append(row)
+                self.tracer.observe("sim.verify.launch", len(items))
+                mask = self.batch_verifier.verify_signatures(items)
+                for (i, w), row in zip(windows, slots):
+                    self.replicas[i].dispatch_window(w, [mask[j] for j in row])
+            else:
+                items = [
+                    (m.sender, m.digest(), m.signature)
+                    for _, w in windows
+                    for m in w
+                ]
+                self.tracer.observe("sim.verify.launch", len(items))
+                mask = self.batch_verifier.verify_signatures(items)
+                off = 0
+                for i, w in windows:
+                    self.replicas[i].dispatch_window(
+                        w, mask[off : off + len(w)]
+                    )
+                    off += len(w)
 
     # -------------------------------------------------------------- replay
 

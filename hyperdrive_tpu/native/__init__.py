@@ -119,8 +119,36 @@ def _marshal_items(items):
     the C ABI consumes: (pubs, payloads, payload_lens, payload_stride,
     sigs, in_ok). Wrong-length pubs/sigs get in_ok=0; payloads may be any
     length. Shared by packing and host batch verification so the two paths
-    can never diverge."""
+    can never diverge.
+
+    Fast path: when every row is well-formed and payloads share one length
+    (consensus digests are always 32 bytes), the buffers are built with
+    three byte-joins instead of a per-row numpy loop — the loop was ~40% of
+    end-to-end pack cost at 100k+ windows.
+    """
     n = len(items)
+    if n and all(
+        len(p) == 32 and len(s) == 64 and len(m) == len(items[0][1])
+        for p, m, s in items
+    ):
+        mlen = len(items[0][1])
+        stride = mlen or 1
+        pubs = np.frombuffer(
+            b"".join(p for p, _, _ in items), dtype=np.uint8
+        ).reshape(n, 32)
+        sigs = np.frombuffer(
+            b"".join(s for _, _, s in items), dtype=np.uint8
+        ).reshape(n, 64)
+        if mlen:
+            payloads = np.frombuffer(
+                b"".join(m for _, m, _ in items), dtype=np.uint8
+            ).reshape(n, mlen)
+        else:
+            payloads = np.zeros((n, 1), dtype=np.uint8)
+        lens = np.full(n, mlen, dtype=np.int32)
+        in_ok = np.ones(n, dtype=np.uint8)
+        return pubs, payloads, lens, stride, sigs, in_ok
+
     stride = max((len(m) for _, m, _ in items), default=1) or 1
     pubs = np.zeros((n, 32), dtype=np.uint8)
     payloads = np.zeros((n, stride), dtype=np.uint8)

@@ -16,11 +16,12 @@ receives a whole window of queued messages and returns an accept mask.
 
 from __future__ import annotations
 
+import time
 from typing import Protocol, Sequence, runtime_checkable
 
 from hyperdrive_tpu.crypto import ed25519
 
-__all__ = ["Verifier", "NullVerifier", "HostVerifier"]
+__all__ = ["Verifier", "NullVerifier", "HostVerifier", "AdaptiveVerifier"]
 
 
 @runtime_checkable
@@ -80,3 +81,87 @@ class HostVerifier:
         if self._native is not None:
             return self._native.verify_batch(items)
         return [ed25519.verify(pub, digest, sig) for pub, digest, sig in items]
+
+
+class AdaptiveVerifier:
+    """Routes each window to the host or the device backend by size.
+
+    The latency/throughput tension of SURVEY.md §7.3(2): a device launch
+    has a fixed dispatch+transfer overhead but far higher sustained
+    throughput, so small windows (a lone propose, a timeout-round trickle)
+    verify faster on the host while vote storms belong on the device. The
+    crossover is measured, not guessed: the first window at least as large
+    as ``calibrate_at`` is timed through BOTH backends (their verdicts also
+    cross-checked), and the per-signature rates + device overhead solve for
+    the break-even size. Until calibration, windows route by the
+    provisional ``crossover`` guess.
+
+    Both backends implement the same ``verify_signatures`` contract and
+    must agree bit-for-bit, so routing is a pure performance decision.
+    """
+
+    def __init__(
+        self,
+        device=None,
+        host=None,
+        crossover: int = 192,
+        calibrate_at: int = 384,
+    ):
+        if device is None:
+            from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+            device = TpuBatchVerifier()
+        self.device = device
+        self.host = host if host is not None else HostVerifier()
+        self.crossover = int(crossover)
+        self.calibrate_at = int(calibrate_at)
+        self.calibrated = False
+        #: (host_sigs_per_s, device_sigs_per_s, device_overhead_s) once
+        #: measured — exposed for benchmark reporting.
+        self.rates = None
+
+    def _calibrate(self, items):
+        # Warm BOTH device shapes first so XLA compilation isn't billed as
+        # launch overhead (the kernel compiles once per bucket shape; the
+        # tiny probe below typically lands in a different bucket than the
+        # full window).
+        mask_dev = self.device.verify_signatures(items)
+        self.device.verify_signatures(items[:1])
+        t0 = time.perf_counter()
+        mask_dev = self.device.verify_signatures(items)
+        t_dev_full = time.perf_counter() - t0
+        # A tiny launch isolates the fixed overhead (dispatch + transfer).
+        t0 = time.perf_counter()
+        self.device.verify_signatures(items[:1])
+        t_dev_one = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        mask_host = self.host.verify_signatures(items)
+        t_host = time.perf_counter() - t0
+        if list(mask_dev) != list(mask_host):
+            raise RuntimeError(
+                "host and device verifiers disagree during calibration — "
+                "refusing to route on performance while correctness differs"
+            )
+        n = len(items)
+        host_rate = n / t_host if t_host > 0 else float("inf")
+        dev_per_sig = max(t_dev_full - t_dev_one, 1e-9) / max(n - 1, 1)
+        dev_rate = 1.0 / dev_per_sig
+        # Break-even: n/host_rate == overhead + n*dev_per_sig.
+        denom = 1.0 / host_rate - dev_per_sig
+        self.crossover = (
+            int(t_dev_one / denom) + 1 if denom > 0 else 1 << 30
+        )
+        self.rates = (host_rate, dev_rate, t_dev_one)
+        self.calibrated = True
+        return mask_dev
+
+    def verify_signatures(self, items):
+        if not self.calibrated and len(items) >= self.calibrate_at:
+            return self._calibrate(list(items))
+        backend = self.device if len(items) >= self.crossover else self.host
+        return backend.verify_signatures(items)
+
+    def verify_batch(self, window):
+        items = [(m.sender, m.digest(), m.signature) for m in window]
+        mask = self.verify_signatures(items)
+        return [bool(ok) and bool(m.signature) for ok, m in zip(mask, window)]

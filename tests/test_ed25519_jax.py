@@ -108,6 +108,69 @@ def test_verifier_protocol_matches_host_verifier(verifier, ring):
     assert verifier.verify_batch(msgs) == hv.verify_batch(msgs)
 
 
+# ------------------------------------------------------ RLC batch equation
+
+
+@pytest.fixture(scope="module")
+def rlc_verifier():
+    return TpuBatchVerifier(buckets=(16, 64), rlc=True)
+
+
+def _signed_items(ring, n, tag=0):
+    items = []
+    for i in range(n):
+        kp = ring[i % len(ring)]
+        msg = bytes([i, tag]) * 16
+        items.append((kp.public, msg, host_ed.sign(kp.seed, msg)))
+    return items
+
+
+def test_rlc_accepts_valid_batch_without_fallback(rlc_verifier, ring):
+    items = _signed_items(ring, 16)
+    ok = rlc_verifier.verify_signatures(items)
+    assert ok.tolist() == [True] * 16
+    assert rlc_verifier.rlc_fallbacks == 0
+
+
+def test_rlc_fallback_localizes_forgery(rlc_verifier, ring):
+    items = _signed_items(ring, 16, tag=1)
+    s = items[7][2]
+    items[7] = (items[7][0], items[7][1], s[:40] + bytes([s[40] ^ 1]) + s[41:])
+    before = rlc_verifier.rlc_fallbacks
+    ok = rlc_verifier.verify_signatures(items)
+    assert ok.tolist() == [i != 7 for i in range(16)]
+    assert rlc_verifier.rlc_fallbacks == before + 1
+
+
+def test_rlc_malformed_lanes_skip_fallback(rlc_verifier, ring):
+    # Host-rejected lanes (bad point, wrong-length sig) are excluded from
+    # the combined equation entirely: the batch of remaining valid lanes
+    # still passes in one launch, and the malformed lanes read False.
+    items = _signed_items(ring, 8, tag=2)
+    items[2] = (b"\xff" * 32, items[2][1], items[2][2])
+    items[5] = (items[5][0], items[5][1], b"\x01" * 63)
+    before = rlc_verifier.rlc_fallbacks
+    ok = rlc_verifier.verify_signatures(items)
+    assert ok.tolist() == [i not in (2, 5) for i in range(8)]
+    assert rlc_verifier.rlc_fallbacks == before
+
+
+def test_rlc_differential_random(rlc_verifier, ring, rng):
+    items = []
+    for i in range(24):
+        kp = ring[rng.randrange(len(ring))]
+        msg = rng.randbytes(rng.randint(0, 48))
+        sig = host_ed.sign(kp.seed, msg)
+        if rng.random() < 0.25:
+            sig = bytearray(sig)
+            sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            sig = bytes(sig)
+        items.append((kp.public, msg, sig))
+    got = rlc_verifier.verify_signatures(items).tolist()
+    want = [host_ed.verify(p, m, s) for p, m, s in items]
+    assert got == want
+
+
 def test_wrong_length_signatures_reject_deterministically(verifier, ring):
     # Wrong-length signatures must be structurally rejected on every path
     # (never zero-padded and verified: with an adversarial small-order
