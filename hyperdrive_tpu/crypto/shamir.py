@@ -23,6 +23,8 @@ __all__ = [
     "lagrange_coeffs_at_zero",
     "split_payload",
     "reconstruct_payload",
+    "encode_share_bundle",
+    "decode_share_bundle",
 ]
 
 BLOCK_BYTES = 31
@@ -114,3 +116,48 @@ def reconstruct_payload(block_shares: list[list[tuple[int, int]]]) -> bytes:
         for shares in block_shares
     )
     return unpad_payload(out)
+
+
+# ----------------------------------------------------- wire bundle format
+#
+# The byte encoding a Propose's ``payload`` field carries: every replica
+# receives the full n-share bundle and any k shares reconstruct at commit
+# (BASELINE config 5). x-coordinates are implicit (split_payload always
+# emits x = 1..n in order), so the bundle is just the y-value matrix.
+
+
+def encode_share_bundle(block_shares: list[list[tuple[int, int]]]) -> bytes:
+    """[blocks][n] (x, y) shares -> bytes: u32 blocks, u32 n, then y values
+    as 32-byte little-endian rows, block-major."""
+    blocks = len(block_shares)
+    n = len(block_shares[0]) if blocks else 0
+    parts = [blocks.to_bytes(4, "little"), n.to_bytes(4, "little")]
+    for shares in block_shares:
+        if len(shares) != n or [x for x, _ in shares] != list(range(1, n + 1)):
+            raise ValueError("bundle blocks must carry shares x = 1..n in order")
+        parts.extend(y.to_bytes(32, "little") for _, y in shares)
+    return b"".join(parts)
+
+
+def decode_share_bundle(data: bytes) -> list[list[tuple[int, int]]]:
+    """Inverse of :func:`encode_share_bundle`; raises ValueError on any
+    malformed input (never crashes — proposal payloads are attacker-
+    controlled bytes)."""
+    if len(data) < 8:
+        raise ValueError("bundle too short")
+    blocks = int.from_bytes(data[0:4], "little")
+    n = int.from_bytes(data[4:8], "little")
+    if blocks > 1 << 20 or n > 1 << 20 or len(data) != 8 + 32 * blocks * n:
+        raise ValueError("bundle size mismatch")
+    out = []
+    off = 8
+    for _ in range(blocks):
+        shares = []
+        for x in range(1, n + 1):
+            y = int.from_bytes(data[off : off + 32], "little")
+            if y >= P:
+                raise ValueError("share value out of field range")
+            shares.append((x, y))
+            off += 32
+        out.append(shares)
+    return out

@@ -204,6 +204,8 @@ class Simulation:
         burst: bool = False,
         batch_verifier=None,
         dedup_verify: bool = False,
+        payload_bytes: int = 0,
+        dedup_reconstruct: bool = True,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -231,7 +233,18 @@ class Simulation:
         chip; with it off, the single chip redundantly re-verifies each
         broadcast for all n receivers — n× the deployment's per-chip load.
         Acceptance decisions are identical either way (verification is
-        deterministic), so safety/replay semantics do not change."""
+        deterministic), so safety/replay semantics do not change.
+
+        ``payload_bytes > 0`` turns on the MPC payload path (BASELINE
+        config 5): every proposed value carries a (2f+1)-of-n Shamir share
+        bundle for a payload of that many bytes, validators accept only
+        proposals whose bundle matches the value commitment, and on every
+        commit the committer reconstructs the payload from k shares on the
+        device (:class:`~hyperdrive_tpu.ops.shamir.BatchReconstructor`),
+        recording it in ``self.reconstructed[replica][height]``.
+        ``dedup_reconstruct`` mirrors dedup_verify: reconstruct each
+        distinct committed value once per chip (the per-replica load of a
+        real deployment) instead of once per simulated replica."""
         self.n = n
         self.f = n // 3
         self.target_height = target_height
@@ -296,6 +309,19 @@ class Simulation:
                 for i in range(n)
             ]
         self.record.signatories = list(self.signatories)
+        self.payload_bytes = payload_bytes
+        self.dedup_reconstruct = dedup_reconstruct
+        self._bundle_cache: dict[Value, bytes] = {}
+        self._recon_cache: dict[Value, bytes] = {}
+        if payload_bytes:
+            from hyperdrive_tpu.ops.shamir import BatchReconstructor
+
+            self.k = 2 * self.f + 1
+            self.reconstructor = BatchReconstructor()
+            #: Per-replica height -> reconstructed payload bytes.
+            self.reconstructed: list[dict[Height, bytes]] = [
+                dict() for _ in range(n)
+            ]
         self.commits: list[dict[Height, Value]] = [dict() for _ in range(n)]
         self.alive = [i not in self.offline for i in range(n)]
         # Incremental completion tracking: a replica leaves the pending set
@@ -328,6 +354,88 @@ class Simulation:
             b"value-%d-%d-%d" % (self.seed, height, round_)
         ).digest()
 
+    # ---------------------------------------------------- payload (config 5)
+
+    def _payload_for_value(self, value: Value) -> bytes:
+        """The deterministic payload a value commits to: a SHA-256 stream
+        keyed by (seed, value), expanded to ``payload_bytes``."""
+        out = bytearray()
+        counter = 0
+        while len(out) < self.payload_bytes:
+            out += hashlib.sha256(
+                b"payload-%d-" % self.seed + value + counter.to_bytes(4, "little")
+            ).digest()
+            counter += 1
+        return bytes(out[: self.payload_bytes])
+
+    def _bundle_for_value(self, value: Value) -> bytes:
+        """The encoded (2f+1)-of-n share bundle for a value's payload.
+        Deterministic (tagged by the value), so every replica — proposer,
+        validator, re-proposer — derives the identical bundle; cached
+        because splitting is the expensive host-side step."""
+        bundle = self._bundle_cache.get(value)
+        if bundle is None:
+            from hyperdrive_tpu.crypto import shamir as host_shamir
+
+            blocks = host_shamir.split_payload(
+                self._payload_for_value(value), self.k, self.n, tag=value
+            )
+            bundle = host_shamir.encode_share_bundle(blocks)
+            self._bundle_cache[value] = bundle
+        return bundle
+
+    def _reconstruct_commit(self, i: int, height: Height, value: Value) -> None:
+        """Committer half of the payload path: pull the committed round's
+        bundle from replica i's propose log, reconstruct from k shares on
+        device, check the payload against the value's commitment."""
+        payload = (
+            self._recon_cache.get(value) if self.dedup_reconstruct else None
+        )
+        if payload is None:
+            import time as _time
+
+            from hyperdrive_tpu.crypto import shamir as host_shamir
+
+            state = self.replicas[i].proc.state
+            # Only a propose that passed validation can be the committed
+            # one — an earlier-round tampered propose for the same value
+            # sits in the logs marked invalid and must not be picked.
+            propose = next(
+                (
+                    p
+                    for rnd, p in state.propose_logs.items()
+                    if p.value == value
+                    and p.payload
+                    and state.propose_is_valid.get(rnd)
+                ),
+                None,
+            )
+            if propose is None:  # committed without a payload-carrying propose
+                return
+            blocks = host_shamir.decode_share_bundle(propose.payload)
+            # Any k of the n shares reconstruct; rotate the contributor set
+            # by height so different subsets (hence different Lagrange
+            # weight sets) are exercised across the run.
+            start = height % self.n
+            picked = [
+                (start + j) % self.n for j in range(self.k)
+            ]
+            subset = [[shares[x] for x in picked] for shares in blocks]
+            # Wall-clock timing: the sim tracer's virtual clock does not
+            # advance inside host/device calls, so a span would read 0.
+            t0 = _time.perf_counter()
+            payload = self.reconstructor.reconstruct_payload_shares(subset)
+            self.tracer.observe(
+                "sim.reconstruct.latency", _time.perf_counter() - t0
+            )
+            if payload != self._payload_for_value(value):
+                raise AssertionError(
+                    f"reconstructed payload mismatch at height {height}"
+                )
+            if self.dedup_reconstruct:
+                self._recon_cache[value] = payload
+        self.reconstructed[i][height] = payload
+
     def _build_replica(
         self, i, timeout, scaling, capacity, byz_proposer, byz_validator, verifier
     ) -> Replica:
@@ -351,6 +459,17 @@ class Simulation:
             timeout_scaling=scaling,
         )
 
+        proposer = MockProposer(fn=byz_proposer or self._default_value)
+        validator = (
+            MockValidator(fn=byz_validator)
+            if byz_validator
+            else MockValidator(ok=True)
+        )
+        if self.payload_bytes:
+            proposer = _PayloadProposer(self, byz_proposer or self._default_value)
+            if not byz_validator:
+                validator = _PayloadValidator(self)
+
         return Replica(
             ReplicaOptions(
                 max_capacity=capacity,
@@ -360,8 +479,8 @@ class Simulation:
             self.signatories[i],
             list(self.signatories),
             timer,
-            MockProposer(fn=byz_proposer or self._default_value),
-            MockValidator(fn=byz_validator) if byz_validator else MockValidator(ok=True),
+            proposer,
+            validator,
             CommitterCallback(on_commit=lambda h, v, i=i: self._on_commit(i, h, v)),
             CatcherCallbacks(
                 on_double_propose=lambda a, b, i=i: self.caught.append(("double_propose", i)),
@@ -379,6 +498,8 @@ class Simulation:
 
     def _on_commit(self, i: int, height: Height, value: Value):
         self.commits[i][height] = value
+        if self.payload_bytes:
+            self._reconstruct_commit(i, height, value)
         if height >= self.target_height:
             self._pending_replicas.discard(i)
         return (0, None)
@@ -618,6 +739,41 @@ class Simulation:
             record=record,
             alive=sim.alive,
         )
+
+
+class _PayloadProposer:
+    """Proposer for the MPC payload path: values as usual, with the
+    value-keyed share bundle attached via the Process's duck-typed
+    ``payload_for_value`` hook (so re-proposed ValidValues re-derive their
+    original bundle)."""
+
+    __slots__ = ("_sim", "_fn")
+
+    def __init__(self, sim: "Simulation", fn):
+        self._sim = sim
+        self._fn = fn
+
+    def propose(self, height, round_):
+        return self._fn(height, round_)
+
+    def payload_for_value(self, value):
+        return self._sim._bundle_for_value(value)
+
+
+class _PayloadValidator:
+    """Accepts a proposal iff its payload is exactly the share bundle its
+    value commits to (the Process's duck-typed ``valid_propose`` hook)."""
+
+    __slots__ = ("_sim",)
+
+    def __init__(self, sim: "Simulation"):
+        self._sim = sim
+
+    def valid(self, height, round_, value):
+        return True
+
+    def valid_propose(self, propose):
+        return propose.payload == self._sim._bundle_for_value(propose.value)
 
 
 class _OwnedClock:

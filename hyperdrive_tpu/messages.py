@@ -50,6 +50,13 @@ class Propose:
     Sent at most once per round by the scheduled proposer (reference:
     ``process/message.go:43-50``). ``valid_round`` carries the proposer's
     ValidRound for the L28 re-propose rule.
+
+    ``payload`` is this framework's MPC extension (no reference analogue):
+    an optional opaque byte blob riding with the proposal — in the Shamir
+    path it carries the k-of-n share bundle the committer reconstructs per
+    committed block (BASELINE config 5). It participates in equality (two
+    proposals differing only in payload are equivocation) and is committed
+    to by the signing digest.
     """
 
     height: int
@@ -57,17 +64,21 @@ class Propose:
     valid_round: int
     value: bytes
     sender: bytes
+    payload: bytes = b""
     signature: bytes = field(default=b"", compare=False)
     _digest: bytes = field(default=b"", init=False, repr=False, compare=False)
 
     def digest(self) -> bytes:
-        """Signing digest over (height, round, valid_round, value).
+        """Signing digest over (height, round, valid_round, value[,
+        payload]).
 
         Mirrors ``NewProposeHash`` (reference: process/message.go:53-78) —
         the sender is deliberately excluded; the signature authenticates it.
         The leading byte is a per-type domain-separation tag (the
         MessageType) so digests of different message types can never
-        collide, regardless of field layout.
+        collide, regardless of field layout. A non-empty payload appends
+        its SHA-256 (injective vs the empty case: the preimage lengths
+        differ), so the signature also binds the share bundle.
 
         Memoized: in the harness one broadcast object fans out to every
         replica, so the digest is computed once per broadcast instead of
@@ -82,12 +93,14 @@ class Propose:
         w.i64(self.round)
         w.i64(self.valid_round)
         w.bytes32(self.value)
+        if self.payload:
+            w.bytes32(hashlib.sha256(self.payload).digest())
         d = hashlib.sha256(b"\x01" + w.data()).digest()
         object.__setattr__(self, "_digest", d)
         return d
 
     def size_hint(self) -> int:
-        return 8 + 8 + 8 + 32 + 32
+        return 8 + 8 + 8 + 32 + 32 + 4 + len(self.payload)
 
     def marshal(self, w: Writer) -> None:
         _check_i64(self.height, "height")
@@ -98,6 +111,7 @@ class Propose:
         w.i64(self.valid_round)
         w.bytes32(self.value)
         w.bytes32(self.sender)
+        w.raw(self.payload)
 
     @classmethod
     def unmarshal(cls, r: Reader) -> "Propose":
@@ -107,6 +121,7 @@ class Propose:
             valid_round=r.i64(),
             value=r.bytes32(),
             sender=r.bytes32(),
+            payload=r.raw(),
         )
 
     def with_signature(self, signature: bytes) -> "Propose":

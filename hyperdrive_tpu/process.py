@@ -262,6 +262,16 @@ class Process:
                 propose_value = self.proposer.propose(
                     self.state.current_height, self.state.current_round
                 )
+            # MPC extension: a proposer that derives payloads from values
+            # (duck-typed `payload_for_value`) attaches the share bundle.
+            # Keying on the VALUE — not (height, round) — means a
+            # re-proposed ValidValue from an earlier round carries its
+            # original payload.
+            payload = b""
+            if propose_value != NIL_VALUE and self.proposer is not None:
+                payload_fn = getattr(self.proposer, "payload_for_value", None)
+                if payload_fn is not None:
+                    payload = payload_fn(propose_value)
             if self.broadcaster is not None:
                 self.broadcaster.broadcast_propose(
                     Propose(
@@ -270,6 +280,7 @@ class Process:
                         valid_round=self.state.valid_round,
                         value=propose_value,
                         sender=self.whoami,
+                        payload=payload,
                     )
                 )
         finally:
@@ -572,10 +583,24 @@ class Process:
                 self.catcher.catch_double_propose(propose, existing)
             return False
 
-        if propose.value == NIL_VALUE or (
-            self.validator is not None
-            and not self.validator.valid(propose.height, propose.round, propose.value)
-        ):
+        # NIL proposals short-circuit before the validator runs (validators
+        # never see NIL values — the pre-existing contract). Otherwise a
+        # validator that checks whole proposals (duck-typed `valid_propose`,
+        # e.g. "does the payload bundle match the value commitment?") takes
+        # precedence over the value-only check — the MPC extension hook.
+        if propose.value == NIL_VALUE:
+            is_valid = False
+        elif self.validator is None:
+            is_valid = True
+        else:
+            valid_propose = getattr(self.validator, "valid_propose", None)
+            if valid_propose is not None:
+                is_valid = valid_propose(propose)
+            else:
+                is_valid = self.validator.valid(
+                    propose.height, propose.round, propose.value
+                )
+        if not is_valid:
             self.state.propose_logs[propose.round] = propose
             self.state.propose_is_valid[propose.round] = False
             return True

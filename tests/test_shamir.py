@@ -65,3 +65,28 @@ def test_device_block_batch(rng):
     y_blocks = [[sh[x - 1][1] for sh in all_shares] for x in xs]
     got = recon.reconstruct_blocks(xs, y_blocks)
     assert got == secrets
+
+
+def test_share_bundle_round_trip(rng):
+    payload = rng.randbytes(100)
+    blocks = shamir.split_payload(payload, k=3, n=5, tag=b"bundle")
+    data = shamir.encode_share_bundle(blocks)
+    back = shamir.decode_share_bundle(data)
+    assert back == blocks
+    assert shamir.reconstruct_payload([b[:3] for b in back]) == payload
+
+
+def test_share_bundle_malformed_inputs_raise(rng):
+    import pytest
+
+    blocks = shamir.split_payload(b"x" * 40, k=2, n=3, tag=b"m")
+    data = shamir.encode_share_bundle(blocks)
+    for bad in (
+        b"",                       # too short
+        data[:-1],                 # truncated
+        data + b"\x00",            # trailing junk
+        b"\xff\xff\xff\xff" + data[4:],  # absurd block count
+        data[:8] + b"\xff" * 32 + data[40:],  # share >= p
+    ):
+        with pytest.raises(ValueError):
+            shamir.decode_share_bundle(bad)
