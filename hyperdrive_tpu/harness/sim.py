@@ -381,6 +381,12 @@ class Simulation:
                 self._payload_for_value(value), self.k, self.n, tag=value
             )
             bundle = host_shamir.encode_share_bundle(blocks)
+            # Bounded FIFO: entries are dead once every replica passes the
+            # value's height; 64 in-flight values covers any realistic
+            # pipeline depth while keeping long soak runs memory-flat
+            # (bundles are ~n*blocks*32 bytes each).
+            while len(self._bundle_cache) >= 64:
+                self._bundle_cache.pop(next(iter(self._bundle_cache)))
             self._bundle_cache[value] = bundle
         return bundle
 
@@ -433,6 +439,8 @@ class Simulation:
                     f"reconstructed payload mismatch at height {height}"
                 )
             if self.dedup_reconstruct:
+                while len(self._recon_cache) >= 64:
+                    self._recon_cache.pop(next(iter(self._recon_cache)))
                 self._recon_cache[value] = payload
         self.reconstructed[i][height] = payload
 

@@ -254,12 +254,12 @@ def make_verify_fn(jit: bool = True):
 # reduction) the balance may flip.
 #
 # A batch mismatch falls back to `verify_kernel` to identify culprits.
-# Acceptance semantics: the weights are cofactor multiples (8·z, z random
-# 125-bit), so the batch equation checks the COFACTORED relation
-# [8·Σz·s]B == Σ[8z]R + [8z·k]A — torsion components are annihilated
-# deterministically rather than surviving under grindable weights. A
-# batch-accept therefore certifies every lane under cofactored
-# verification (false accept of a main-subgroup forgery ~2^-125); a
+# Acceptance semantics: the kernel cofactor-clears the combined sum with
+# three final doublings, so the batch equation is the COFACTORED relation
+# [8]([Σz·s]B − Σ[z]R − Σ[z·k]A) == O — torsion components from R *and* A
+# are annihilated deterministically rather than surviving under grindable
+# weights. A batch-accept certifies every lane under cofactored
+# verification (false accept of a main-subgroup forgery ~2^-128); a
 # crafted signature that is valid cofactored but invalid under the strict
 # cofactorless check (honest signers never produce one — it requires
 # adding a small-order torsion point) IS accepted by the fast path where
@@ -415,7 +415,15 @@ def rlc_kernel(ax, ay, at, rx, ry, m_nib, z_nib, c_nib):
     zero1 = jnp.zeros_like(one1)
     cb = lax.fori_loop(0, 64, cb_body, (zero1, one1, one1, zero1))
 
-    sx, sy, sz, _ = _add_ext(t_point, cb, need_t=True)
+    total = _add_ext(t_point, cb, need_t=True)
+    # Cofactor-clear the COMBINED sum: three doublings annihilate every
+    # 8-torsion component — from R *and* A alike — so acceptance is the
+    # deterministic cofactored relation [8]([c]B + Σ[z]R' + Σ[m]A') == O
+    # regardless of how the weights reduced mod L.
+    p3 = total[:3]
+    for _ in range(3):
+        p3 = _dbl(p3, need_t=False)
+    sx, sy, sz = p3
     # Projective identity: X == 0 and Y == Z.
     return (fe.is_zero(sx) & fe.eq(sy, sz))[0]
 
@@ -558,7 +566,7 @@ def rlc_scalars(s_nib, k_nib, prevalid, binder: bytes):
     import hashlib as _hl
 
     bsz = prevalid.shape[0]
-    seed = _hl.sha256(b"hd-rlc-v2" + binder).digest()
+    seed = _hl.sha256(b"hd-rlc-v3" + binder).digest()
     s_ints = _ints_from_nibbles(s_nib)
     k_ints = _ints_from_nibbles(k_nib)
     L = host_ed.L
@@ -568,18 +576,13 @@ def rlc_scalars(s_nib, k_nib, prevalid, binder: bytes):
     for i in range(bsz):
         if not prevalid[i]:
             continue
-        # 125 random bits scaled by the cofactor: every weight is a
-        # multiple of 8, so small-order torsion components are annihilated
-        # in the batch sum and an attacker cannot grind R choices for a
-        # torsion contribution that cancels only under lucky weights. This
-        # makes batch acceptance equal COFACTORED verification semantics —
-        # see the module comment. (8*z still fits 128 bits / 32 nibbles.)
-        zi = 8 * (
-            int.from_bytes(
-                _hl.sha512(seed + i.to_bytes(4, "little")).digest()[:16],
-                "little",
-            )
-            >> 3
+        # Plain 128-bit weights: torsion is cleared deterministically by
+        # the kernel's final cofactor doublings (see rlc_kernel), not by
+        # weight structure — (z*k) mod L wouldn't stay a multiple of 8
+        # anyway, so weight-side clearing could only ever cover R.
+        zi = int.from_bytes(
+            _hl.sha512(seed + i.to_bytes(4, "little")).digest()[:16],
+            "little",
         )
         m_rows[i] = np.frombuffer(
             ((zi * k_ints[i]) % L).to_bytes(32, "little"), dtype=np.uint8

@@ -10,6 +10,8 @@ GF(2^255-19) limb kernels as signature verification (SURVEY.md 7.1(3)).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -19,6 +21,13 @@ from hyperdrive_tpu.crypto import shamir as host_shamir
 from hyperdrive_tpu.ops import fe25519 as fe
 
 __all__ = ["reconstruct_kernel", "BatchReconstructor"]
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_reconstruct():
+    """One shared jit across all BatchReconstructor instances — one XLA
+    compile per (k, B) shape process-wide, not per instance."""
+    return jax.jit(reconstruct_kernel)
 
 
 def reconstruct_kernel(y_shares: jnp.ndarray, lams: jnp.ndarray) -> jnp.ndarray:
@@ -41,7 +50,12 @@ class BatchReconstructor:
     """Host wrapper: packs shares, runs the jitted kernel, unpacks bytes."""
 
     def __init__(self):
-        self._fn = jax.jit(reconstruct_kernel)
+        self._fn = _jitted_reconstruct()
+
+    def warmup(self, k: int, blocks: int) -> None:
+        """Compile the kernel for a (k, blocks) shape up front so timed
+        runs never bill XLA compilation."""
+        self.reconstruct_blocks(list(range(1, k + 1)), [[0] * blocks for _ in range(k)])
 
     def reconstruct_blocks(self, xs: list[int], y_blocks: list[list[int]]) -> list[int]:
         """xs: the k share x-coordinates; y_blocks: [k][B] share values.

@@ -299,11 +299,12 @@ def test_payload_commit_reconstructs_on_all_replicas():
     res = sim.run()
     assert res.completed, f"stalled at {res.heights}"
     res.assert_safety()
-    expect = {h: sim._payload_for_value(v) for h, v in sim.commits[0].items()}
     for i in range(4):
         assert set(sim.reconstructed[i]) >= set(range(1, 6))
         for h, payload in sim.reconstructed[i].items():
-            assert payload == expect[h]
+            # The reconstructed bytes must be exactly the payload the
+            # replica's own committed value commits to.
+            assert payload == sim._payload_for_value(sim.commits[i][h])
             assert len(payload) == 62
 
 

@@ -1,0 +1,589 @@
+"""Property-based consensus rule specs (hypothesis).
+
+The reference wraps nearly every rule spec in ``testing/quick`` randomization
+(process/process_test.go, e.g. 95-105) and dedicates a long negative-case
+matrix to the future-round skip rule (process_test.go:3279-3803). This module
+is that layer: every L-rule gets randomized positive AND negative specs, the
+message interleavings are randomized at the Process level, and the serde
+properties run over the edge-case-biased generators from
+``hyperdrive_tpu.testutil``.
+
+Conventions: ``f`` ranges over small quorum sizes, sender identities are
+distinct 32-byte tags, and assertions are on observable side effects
+(broadcasts, timeouts, commits, catches) — the same surface the reference
+asserts on.
+"""
+
+import random
+from types import SimpleNamespace
+
+from hypothesis import given, settings, strategies as st
+
+from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.messages import (
+    Precommit,
+    Prevote,
+    Propose,
+    marshal_message,
+    unmarshal_message,
+)
+from hyperdrive_tpu.process import Process
+from hyperdrive_tpu.testutil import (
+    BroadcasterCallbacks,
+    CatcherCallbacks,
+    CommitterCallback,
+    MockProposer,
+    MockScheduler,
+    MockValidator,
+    TimerCallbacks,
+    random_precommit,
+    random_prevote,
+    random_propose,
+    random_state,
+)
+from hyperdrive_tpu.types import INT64_MAX, INVALID_ROUND, NIL_VALUE, Step
+
+# Shared hypothesis profile: rule properties drive a full automaton per
+# example, so keep example counts moderate and disable the wall-clock
+# deadline (CI machines vary).
+RULES = settings(max_examples=60, deadline=None)
+SERDE = settings(max_examples=120, deadline=None)
+
+
+def sig(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+def val(i: int) -> bytes:
+    return bytes([0xA0 + (i % 0x5F)]) * 32
+
+
+WHOAMI = sig(1)
+PROPOSER = sig(2)
+
+
+def make_process(whoami=WHOAMI, f=1, proposer_sig=PROPOSER, validator_ok=True,
+                 proposer_value=None, height=1):
+    rec = SimpleNamespace(
+        proposes=[], prevotes=[], precommits=[], commits=[],
+        timeout_proposes=[], timeout_prevotes=[], timeout_precommits=[],
+        double_proposes=[], double_prevotes=[], double_precommits=[],
+        out_of_turns=[],
+    )
+    proc = Process(
+        whoami=whoami,
+        f=f,
+        timer=TimerCallbacks(
+            on_propose=lambda h, r: rec.timeout_proposes.append((h, r)),
+            on_prevote=lambda h, r: rec.timeout_prevotes.append((h, r)),
+            on_precommit=lambda h, r: rec.timeout_precommits.append((h, r)),
+        ),
+        scheduler=MockScheduler(proposer_sig),
+        proposer=MockProposer(value=proposer_value or val(0)),
+        validator=MockValidator(ok=validator_ok),
+        broadcaster=BroadcasterCallbacks(
+            on_propose=rec.proposes.append,
+            on_prevote=rec.prevotes.append,
+            on_precommit=rec.precommits.append,
+        ),
+        committer=CommitterCallback(
+            on_commit=lambda h, v: (rec.commits.append((h, v)), (0, None))[1]
+        ),
+        catcher=CatcherCallbacks(
+            on_double_propose=lambda a, b: rec.double_proposes.append((a, b)),
+            on_double_prevote=lambda a, b: rec.double_prevotes.append((a, b)),
+            on_double_precommit=lambda a, b: rec.double_precommits.append((a, b)),
+            on_out_of_turn_propose=rec.out_of_turns.append,
+        ),
+        height=height,
+    )
+    return proc, rec
+
+
+# Strategy helpers -----------------------------------------------------------
+
+fs = st.integers(min_value=1, max_value=3)
+seeds = st.integers(min_value=0, max_value=2**32 - 1)
+rounds = st.integers(min_value=0, max_value=1 << 20)
+good_values = st.integers(min_value=1, max_value=0x5E).map(val)
+
+
+def senders(n: int, offset: int = 10) -> list[bytes]:
+    return [sig(offset + i) for i in range(n)]
+
+
+def deliver(proc, msgs, order_seed: int) -> list:
+    """Deliver msgs in a seed-determined random order; returns the order."""
+    order = list(msgs)
+    random.Random(order_seed).shuffle(order)
+    for m in order:
+        if isinstance(m, Propose):
+            proc.propose(m)
+        elif isinstance(m, Prevote):
+            proc.prevote(m)
+        else:
+            proc.precommit(m)
+    return order
+
+
+# ------------------------------------------------------------ L11 StartRound
+
+
+@RULES
+@given(f=fs, am_proposer=st.booleans())
+def test_l11_start_round(f, am_proposer):
+    proc, rec = make_process(
+        whoami=PROPOSER if am_proposer else WHOAMI, f=f
+    )
+    proc.start()
+    assert proc.current_round == 0
+    assert proc.current_step == Step.PROPOSING
+    if am_proposer:
+        assert [p.value for p in rec.proposes] == [val(0)]
+        assert rec.timeout_proposes == []
+    else:
+        assert rec.proposes == []
+        assert rec.timeout_proposes == [(1, 0)]
+
+
+# ------------------------------------------- L22 prevote upon (valid) propose
+
+
+@RULES
+@given(f=fs, value=good_values, ok=st.booleans())
+def test_l22_prevote_tracks_validity(f, value, ok):
+    proc, rec = make_process(f=f, validator_ok=ok)
+    proc.start()
+    proc.propose(Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                         value=value, sender=PROPOSER))
+    assert [pv.value for pv in rec.prevotes] == [value if ok else NIL_VALUE]
+    assert proc.current_step == Step.PREVOTING
+
+
+@RULES
+@given(f=fs, value=good_values)
+def test_l22_negative_out_of_turn_proposer_never_prevoted(f, value):
+    proc, rec = make_process(f=f)
+    proc.start()
+    imposter = sig(9)  # not the scheduled proposer
+    proc.propose(Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                         value=value, sender=imposter))
+    assert rec.prevotes == []
+    assert [p.sender for p in rec.out_of_turns] == [imposter]
+
+
+# ---------------------------- L28 prevote upon propose + 2f+1 past prevotes
+
+
+@RULES
+@given(f=fs, value=good_values, order_seed=seeds)
+def test_l28_repropose_with_quorum_from_valid_round(f, value, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    vr = 0
+    # Jump to round 2 via f+1 future-round messages (L55), then deliver the
+    # re-propose with valid_round=vr plus a 2f+1 prevote quorum at vr.
+    for s in senders(f + 1, offset=40):
+        proc.prevote(Prevote(height=1, round=2, value=value, sender=s))
+    assert proc.current_round == 2
+    msgs = [Propose(height=1, round=2, valid_round=vr, value=value,
+                    sender=PROPOSER)]
+    msgs += [Prevote(height=1, round=vr, value=value, sender=s)
+             for s in senders(2 * f + 1)]
+    deliver(proc, msgs, order_seed)
+    assert [pv.value for pv in rec.prevotes if pv.round == 2] == [value]
+
+
+@RULES
+@given(f=fs, value=good_values, order_seed=seeds)
+def test_l28_negative_sub_quorum_never_fires(f, value, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    for s in senders(f + 1, offset=40):
+        proc.prevote(Prevote(height=1, round=2, value=value, sender=s))
+    msgs = [Propose(height=1, round=2, valid_round=0, value=value,
+                    sender=PROPOSER)]
+    msgs += [Prevote(height=1, round=0, value=value, sender=s)
+             for s in senders(2 * f)]  # one short of quorum
+    deliver(proc, msgs, order_seed)
+    assert [pv for pv in rec.prevotes if pv.round == 2] == []
+
+
+# --------------------------- L34 prevote timeout upon 2f+1 current prevotes
+
+
+@RULES
+@given(f=fs, order_seed=seeds)
+def test_l34_any_quorum_of_prevotes_schedules_timeout(f, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    proc.propose(Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                         value=val(3), sender=PROPOSER))  # step -> PREVOTING
+    who = senders(2 * f + 1)
+    # Split the votes so no value reaches 2f+1 (a value quorum would fire
+    # L36 first and legitimately leave PREVOTING before L34 checks).
+    msgs = [Prevote(height=1, round=0, value=val(3 + (i % 2)), sender=s)
+            for i, s in enumerate(who)]
+    deliver(proc, msgs, order_seed)
+    assert (1, 0) in rec.timeout_prevotes
+
+
+@RULES
+@given(f=fs, order_seed=seeds)
+def test_l34_negative_duplicates_do_not_count(f, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    proc.propose(Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                         value=val(3), sender=PROPOSER))
+    # 2f+1 messages but only 2f unique senders (one equivocates).
+    who = senders(2 * f)
+    msgs = [Prevote(height=1, round=0, value=val(3), sender=s) for s in who]
+    msgs.append(Prevote(height=1, round=0, value=val(4), sender=who[0]))
+    deliver(proc, msgs, order_seed)
+    assert rec.timeout_prevotes == []
+    assert len(rec.double_prevotes) == 1
+
+
+# ------------------------------------- L36 lock + precommit upon 2f+1 match
+
+
+@RULES
+@given(f=fs, value=good_values, order_seed=seeds)
+def test_l36_quorum_locks_and_precommits(f, value, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    msgs = [Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                    value=value, sender=PROPOSER)]
+    msgs += [Prevote(height=1, round=0, value=value, sender=s)
+             for s in senders(2 * f + 1)]
+    deliver(proc, msgs, order_seed)
+    assert [pc.value for pc in rec.precommits] == [value]
+    assert proc.state.locked_value == value
+    assert proc.state.locked_round == 0
+    assert proc.state.valid_value == value
+    assert proc.current_step == Step.PRECOMMITTING
+
+
+@RULES
+@given(f=fs, value=good_values, order_seed=seeds)
+def test_l36_negative_split_vote_never_locks(f, value, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    msgs = [Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                    value=value, sender=PROPOSER)]
+    # 2f+1 prevotes but no single value reaches quorum.
+    who = senders(2 * f + 1)
+    msgs += [Prevote(height=1, round=0,
+                     value=value if i < f else val(0x30 + i), sender=s)
+             for i, s in enumerate(who)]
+    deliver(proc, msgs, order_seed)
+    assert [pc for pc in rec.precommits if pc.value != NIL_VALUE] == []
+    assert proc.state.locked_round == INVALID_ROUND
+
+
+# --------------------------------------- L44 precommit nil upon nil quorum
+
+
+@RULES
+@given(f=fs, order_seed=seeds)
+def test_l44_nil_quorum_precommits_nil(f, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    proc.propose(Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                         value=val(3), sender=PROPOSER))  # step -> PREVOTING
+    msgs = [Prevote(height=1, round=0, value=NIL_VALUE, sender=s)
+            for s in senders(2 * f + 1)]
+    deliver(proc, msgs, order_seed)
+    assert [pc.value for pc in rec.precommits] == [NIL_VALUE]
+    assert proc.state.locked_round == INVALID_ROUND
+
+
+@RULES
+@given(f=fs, order_seed=seeds)
+def test_l44_negative_mixed_nils_below_quorum(f, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    proc.propose(Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                         value=val(3), sender=PROPOSER))
+    msgs = [Prevote(height=1, round=0, value=NIL_VALUE, sender=s)
+            for s in senders(2 * f)]  # one short
+    deliver(proc, msgs, order_seed)
+    assert [pc for pc in rec.precommits if pc.value == NIL_VALUE] == []
+
+
+# ------------------------------- L47 precommit timeout upon any 2f+1 votes
+
+
+@RULES
+@given(f=fs, order_seed=seeds, mixed=st.booleans())
+def test_l47_any_precommit_quorum_schedules_timeout(f, order_seed, mixed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    who = senders(2 * f + 1)
+    msgs = [Precommit(height=1, round=0,
+                      value=val(5 + (i % 3 if mixed else 0)), sender=s)
+            for i, s in enumerate(who)]
+    deliver(proc, msgs, order_seed)
+    assert (1, 0) in rec.timeout_precommits
+
+
+@RULES
+@given(f=fs, order_seed=seeds)
+def test_l47_negative_sub_quorum(f, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    msgs = [Precommit(height=1, round=0, value=val(5), sender=s)
+            for s in senders(2 * f)]
+    deliver(proc, msgs, order_seed)
+    assert rec.timeout_precommits == []
+
+
+# --------------------------------------------- L49 commit upon 2f+1 match
+
+
+@RULES
+@given(f=fs, value=good_values, order_seed=seeds)
+def test_l49_commit_fires_once_and_advances_height(f, value, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    msgs = [Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                    value=value, sender=PROPOSER)]
+    msgs += [Precommit(height=1, round=0, value=value, sender=s)
+             for s in senders(2 * f + 1)]
+    deliver(proc, msgs, order_seed)
+    assert rec.commits == [(1, value)]
+    assert proc.current_height == 2
+    assert proc.current_round == 0
+    assert proc.state.locked_round == INVALID_ROUND
+    assert proc.state.prevote_logs == {} and proc.state.precommit_logs == {}
+
+
+@RULES
+@given(f=fs, value=good_values, order_seed=seeds, nil_votes=st.booleans())
+def test_l49_negative_no_commit_without_value_quorum(
+    f, value, order_seed, nil_votes
+):
+    proc, rec = make_process(f=f)
+    proc.start()
+    msgs = [Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                    value=value, sender=PROPOSER)]
+    who = senders(2 * f + 1)
+    if nil_votes:
+        # quorum of NIL precommits: no commit ever.
+        msgs += [Precommit(height=1, round=0, value=NIL_VALUE, sender=s)
+                 for s in who]
+    else:
+        # 2f+1 precommits, no value at quorum.
+        msgs += [Precommit(height=1, round=0,
+                           value=value if i < f else val(0x40 + i), sender=s)
+                 for i, s in enumerate(who)]
+    deliver(proc, msgs, order_seed)
+    assert rec.commits == []
+    assert proc.current_height == 1
+
+
+# ------------------------------------------------- L55 future-round skip
+#
+# The reference's negative-case matrix (process_test.go:3279-3803): the
+# skip needs f+1 UNIQUE signatories, all with messages in the SAME round,
+# and that round strictly ahead of the current one.
+
+
+@RULES
+@given(f=fs, r=st.integers(min_value=1, max_value=64), order_seed=seeds,
+       kinds=st.lists(st.integers(0, 1), min_size=4, max_size=4))
+def test_l55_f_plus_one_unique_senders_skip(f, r, order_seed, kinds):
+    proc, rec = make_process(f=f)
+    proc.start()
+    who = senders(f + 1)
+    msgs = []
+    for i, s in enumerate(who):
+        if kinds[i % len(kinds)]:
+            msgs.append(Prevote(height=1, round=r, value=val(6), sender=s))
+        else:
+            msgs.append(Precommit(height=1, round=r, value=val(6), sender=s))
+    deliver(proc, msgs, order_seed)
+    assert proc.current_round == r
+    assert proc.current_step == Step.PROPOSING
+
+
+@RULES
+@given(f=fs, r=st.integers(min_value=1, max_value=64), order_seed=seeds)
+def test_l55_negative_duplicate_senders_do_not_skip(f, r, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    who = senders(f)  # f unique; one sends twice (a prevote and a precommit)
+    msgs = [Prevote(height=1, round=r, value=val(6), sender=s) for s in who]
+    msgs.append(Precommit(height=1, round=r, value=val(7), sender=who[0]))
+    deliver(proc, msgs, order_seed)
+    assert proc.current_round == 0
+
+
+@RULES
+@given(f=fs, order_seed=seeds)
+def test_l55_negative_votes_spread_across_rounds_do_not_skip(f, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    # f+1 unique senders but each in a DIFFERENT future round.
+    msgs = [Prevote(height=1, round=1 + i, value=val(6), sender=s)
+            for i, s in enumerate(senders(f + 1))]
+    deliver(proc, msgs, order_seed)
+    assert proc.current_round == 0
+
+
+@RULES
+@given(f=fs, order_seed=seeds)
+def test_l55_negative_current_round_votes_do_not_skip(f, order_seed):
+    proc, rec = make_process(f=f)
+    proc.start()
+    msgs = [Prevote(height=1, round=0, value=val(6), sender=s)
+            for s in senders(f + 1)]
+    deliver(proc, msgs, order_seed)
+    assert proc.current_round == 0
+
+
+# ------------------------------------------------------------ equivocation
+
+
+@RULES
+@given(f=fs, value=good_values, same=st.booleans())
+def test_double_prevote_catching(f, value, same):
+    proc, rec = make_process(f=f)
+    proc.start()
+    first = Prevote(height=1, round=0, value=value, sender=sig(20))
+    # A guaranteed-different value: flip one byte of the drawn one.
+    other = value[:-1] + bytes([value[-1] ^ 1])
+    second = first if same else Prevote(height=1, round=0, value=other,
+                                        sender=sig(20))
+    proc.prevote(first)
+    proc.prevote(second)
+    if same:
+        assert rec.double_prevotes == []
+    else:
+        assert rec.double_prevotes == [(second, first)]
+    # The log always keeps the FIRST message.
+    assert proc.state.prevote_logs[0][sig(20)] == first
+
+
+@RULES
+@given(f=fs, value=good_values, same=st.booleans())
+def test_double_precommit_catching(f, value, same):
+    proc, rec = make_process(f=f)
+    proc.start()
+    first = Precommit(height=1, round=0, value=value, sender=sig(21))
+    other = value[:-1] + bytes([value[-1] ^ 1])
+    second = first if same else Precommit(height=1, round=0, value=other,
+                                          sender=sig(21))
+    proc.precommit(first)
+    proc.precommit(second)
+    assert rec.double_precommits == ([] if same else [(second, first)])
+    assert proc.state.precommit_logs[0][sig(21)] == first
+
+
+# --------------------------------------- whole-round interleaving invariance
+
+
+@RULES
+@given(f=fs, value=good_values, order_seed=seeds)
+def test_full_round_commits_under_any_interleaving(f, value, order_seed):
+    """A complete honest round's traffic — propose, 2f+1 prevotes, 2f+1
+    precommits — must commit the proposed value no matter the delivery
+    order (the retry cascade + once-flags make rule firing order-
+    insensitive)."""
+    proc, rec = make_process(f=f)
+    proc.start()
+    who = senders(2 * f + 1)
+    msgs = [Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                    value=value, sender=PROPOSER)]
+    msgs += [Prevote(height=1, round=0, value=value, sender=s) for s in who]
+    msgs += [Precommit(height=1, round=0, value=value, sender=s) for s in who]
+    deliver(proc, msgs, order_seed)
+    assert rec.commits == [(1, value)]
+    assert proc.current_height == 2
+
+
+@RULES
+@given(f=fs, value=good_values, order_seed=seeds,
+       drop=st.integers(min_value=0, max_value=6))
+def test_partial_round_never_commits_wrong_value(f, value, order_seed, drop):
+    """Dropping an arbitrary message from the full round can stall the
+    commit but can never commit a different value or fork the height."""
+    proc, rec = make_process(f=f)
+    proc.start()
+    who = senders(2 * f + 1)
+    msgs = [Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                    value=value, sender=PROPOSER)]
+    msgs += [Prevote(height=1, round=0, value=value, sender=s) for s in who]
+    msgs += [Precommit(height=1, round=0, value=value, sender=s) for s in who]
+    del msgs[drop % len(msgs)]
+    deliver(proc, msgs, order_seed)
+    assert rec.commits in ([], [(1, value)])
+
+
+# --------------------------------------------------------- serde properties
+
+
+@SERDE
+@given(seed=seeds)
+def test_process_checkpoint_round_trip_random_states(seed):
+    rng = random.Random(seed)
+    proc, _ = make_process()
+    proc.state = random_state(rng)
+    w = Writer()
+    proc.marshal(w)
+    restored, _ = make_process()
+    restored.unmarshal_into(Reader(w.data()))
+    assert restored.state == proc.state
+    assert restored.whoami == proc.whoami
+    assert restored.f == proc.f
+
+
+@SERDE
+@given(seed=seeds)
+def test_message_envelope_round_trip_random_messages(seed):
+    rng = random.Random(seed)
+    for gen in (random_propose, random_prevote, random_precommit):
+        msg = gen(rng)
+        try:
+            w = Writer()
+            marshal_message(msg, w)
+        except SerdeError:
+            continue  # out-of-range draws may legitimately refuse to marshal
+        back = unmarshal_message(Reader(w.data()))
+        assert back == msg
+
+
+@SERDE
+@given(blob=st.binary(min_size=0, max_size=256))
+def test_unmarshal_fuzz_never_crashes(blob):
+    """Garbage bytes must raise SerdeError (or parse), never anything else
+    (reference contract: process_test.go:22-31)."""
+    try:
+        unmarshal_message(Reader(blob))
+    except SerdeError:
+        pass
+    proc, _ = make_process()
+    try:
+        proc.unmarshal_into(Reader(blob))
+    except SerdeError:
+        pass
+
+
+@SERDE
+@given(seed=seeds, budget=st.integers(min_value=0, max_value=40))
+def test_undersized_budget_errors_cleanly(seed, budget):
+    rng = random.Random(seed)
+    proc, _ = make_process()
+    proc.state = random_state(rng)
+    w = Writer()
+    proc.marshal(w)
+    data = w.data()
+    if budget >= len(data):
+        return
+    restored, _ = make_process()
+    try:
+        restored.unmarshal_into(Reader(data, rem=budget))
+    except SerdeError:
+        pass
+    else:
+        raise AssertionError("undersized budget must error")

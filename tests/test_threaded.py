@@ -1,0 +1,143 @@
+"""Threaded production-mode integration: real threads, real timers.
+
+The reference's harness runs every replica on its own goroutine with
+sleeping timers (replica_test.go:395-398); this is the analogue — n
+replicas on real threads driven by Replica.run, LinearTimer at millisecond
+timeouts, broadcasts fanned out through the thread-safe inboxes — asserting
+the same safety obligation: byte-identical commit maps.
+"""
+
+import hashlib
+import threading
+import time
+
+from hyperdrive_tpu.messages import Timeout
+from hyperdrive_tpu.replica import Replica, ReplicaOptions
+from hyperdrive_tpu.testutil import (
+    BroadcasterCallbacks,
+    CatcherCallbacks,
+    CommitterCallback,
+    MockProposer,
+    MockValidator,
+)
+from hyperdrive_tpu.timer import LinearTimer
+
+
+def sig(i: int) -> bytes:
+    return bytes([i + 1]) * 32
+
+
+def value_for(height: int, round_: int) -> bytes:
+    return hashlib.sha256(b"thr-%d-%d" % (height, round_)).digest()
+
+
+class ThreadedNetwork:
+    """n replicas on real threads; broadcasts go straight into every
+    replica's inbox (including the sender's own)."""
+
+    def __init__(self, n: int, target_height: int, timeout: float = 0.2,
+                 offline: set | None = None):
+        self.n = n
+        self.target = target_height
+        self.offline = offline or set()
+        self.signatories = [sig(i) for i in range(n)]
+        self.commits = [dict() for _ in range(n)]
+        self.done = [threading.Event() for _ in range(n)]
+        self.stop = threading.Event()
+        self.replicas: list[Replica] = []
+        for i in range(n):
+            self.replicas.append(self._build(i, timeout))
+
+    def _build(self, i: int, timeout: float) -> Replica:
+        def bcast(msg):
+            # Broadcast to all, including self, via the thread-safe inboxes
+            # (reference: replica_test.go:174-208).
+            for j, r in enumerate(self.replicas_snapshot()):
+                if j not in self.offline:
+                    r._enqueue(msg, self.stop)
+
+        def on_commit(h, v, i=i):
+            self.commits[i][h] = v
+            if h >= self.target:
+                self.done[i].set()
+            return (0, None)
+
+        def on_timeout(t: Timeout, i=i):
+            self.replicas_snapshot()[i]._enqueue(t, self.stop)
+
+        timer = LinearTimer(
+            handle_timeout_propose=on_timeout,
+            handle_timeout_prevote=on_timeout,
+            handle_timeout_precommit=on_timeout,
+            timeout=timeout,
+            timeout_scaling=0.5,
+        )
+        return Replica(
+            ReplicaOptions(),
+            self.signatories[i],
+            list(self.signatories),
+            timer,
+            MockProposer(fn=value_for),
+            MockValidator(ok=True),
+            CommitterCallback(on_commit=on_commit),
+            CatcherCallbacks(),
+            BroadcasterCallbacks(
+                on_propose=bcast, on_prevote=bcast, on_precommit=bcast
+            ),
+        )
+
+    def replicas_snapshot(self):
+        return self.replicas
+
+    def run(self, budget_s: float = 30.0) -> bool:
+        threads = []
+        for i, r in enumerate(self.replicas):
+            if i in self.offline:
+                continue
+            t = threading.Thread(target=r.run, args=(self.stop,), daemon=True)
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + budget_s
+        ok = True
+        for i, ev in enumerate(self.done):
+            if i in self.offline:
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not ev.wait(remaining):
+                ok = False
+                break
+        self.stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+        return ok
+
+    def assert_safety(self):
+        for h in set().union(*[set(c) for c in self.commits]):
+            vals = {c[h] for c in self.commits if h in c}
+            assert len(vals) <= 1, f"fork at height {h}: {vals}"
+
+
+def test_threaded_honest_network_commits_identically():
+    net = ThreadedNetwork(n=4, target_height=5, timeout=0.5)
+    assert net.run(budget_s=60.0), (
+        f"threaded network stalled: heights="
+    ) + str([r.current_height() for r in net.replicas])
+    net.assert_safety()
+    base = {h: v for h, v in net.commits[0].items() if h <= 5}
+    assert set(base) >= set(range(1, 6))
+    for c in net.commits[1:]:
+        for h in range(1, 6):
+            assert c.get(h) == base[h]
+
+
+def test_threaded_offline_proposer_advances_via_real_timeouts():
+    # Replica 3 never runs; heights whose round-0 proposer is 3 must
+    # progress through a real LinearTimer propose-timeout into round 1.
+    net = ThreadedNetwork(n=4, target_height=4, timeout=0.15, offline={3})
+    assert net.run(budget_s=60.0), (
+        "offline-proposer network stalled: heights="
+    ) + str([r.current_height() for r in net.replicas])
+    net.assert_safety()
+    for i in range(3):
+        assert set(net.commits[i]) >= set(range(1, 5))
+    assert not net.commits[3]
