@@ -1,0 +1,261 @@
+"""Messages as NumPy struct-array rows — the dense message layer.
+
+SURVEY.md §7.2 step 1: "messages double as NumPy struct-array rows". A
+:class:`MessageBlock` is a window of consensus messages in columnar form —
+exactly the layout the device data path consumes:
+
+- :meth:`MessageBlock.verify_items` / :meth:`MessageBlock.pack_arrays`
+  feed the Ed25519 batch verifier (one contiguous array per field, no
+  per-message marshalling);
+- :meth:`MessageBlock.tally_inputs` builds the ``[rounds, validators, 8]``
+  vote tensor + presence mask that :mod:`hyperdrive_tpu.ops.tally` fuses
+  behind the verification mask;
+- :meth:`MessageBlock.digests` computes signing digests with vectorized
+  preimage assembly (one hashlib call per row over a prebuilt byte
+  matrix — the serialization work is columnar).
+
+Row layout mirrors the wire envelope (`messages.marshal_message`); Propose
+payloads are variable-length and rare, so they ride in a sparse side table
+rather than widening every row.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from hyperdrive_tpu.messages import Precommit, Prevote, Propose, Timeout
+from hyperdrive_tpu.types import INVALID_ROUND, MessageType
+
+__all__ = ["MESSAGE_DTYPE", "MessageBlock"]
+
+#: One consensus message as a fixed-width structured row.
+MESSAGE_DTYPE = np.dtype(
+    [
+        ("type", "<i1"),
+        ("height", "<i8"),
+        ("round", "<i8"),
+        ("valid_round", "<i8"),
+        ("value", "u1", 32),
+        ("sender", "u1", 32),
+        ("signature", "u1", 64),
+        ("has_sig", "?"),
+    ]
+)
+
+_TYPE_TAG = {
+    Propose: int(MessageType.PROPOSE),
+    Prevote: int(MessageType.PREVOTE),
+    Precommit: int(MessageType.PRECOMMIT),
+}
+
+def _bytes_col(parts: list[bytes], width: int) -> np.ndarray:
+    return np.frombuffer(b"".join(parts), dtype=np.uint8).reshape(
+        len(parts), width
+    )
+
+
+class MessageBlock:
+    """A window of Propose/Prevote/Precommit messages in columnar form."""
+
+    __slots__ = ("rows", "payloads")
+
+    def __init__(self, rows: np.ndarray, payloads: dict[int, bytes]):
+        self.rows = rows
+        #: Sparse row index -> Propose payload bytes (empty payloads and
+        #: non-propose rows are absent).
+        self.payloads = payloads
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    # ------------------------------------------------------------ construct
+
+    @classmethod
+    def from_messages(cls, msgs) -> "MessageBlock":
+        """Columnarize a window. Timeouts are control events, not votes —
+        they have no row representation and are rejected."""
+        n = len(msgs)
+        rows = np.zeros(n, dtype=MESSAGE_DTYPE)
+        if n == 0:
+            return cls(rows, {})
+        values, senders, sigs = [], [], []
+        payloads: dict[int, bytes] = {}
+        heights = np.empty(n, dtype=np.int64)
+        rounds = np.empty(n, dtype=np.int64)
+        vrounds = np.full(n, INVALID_ROUND, dtype=np.int64)
+        types = np.empty(n, dtype=np.int8)
+        has_sig = np.zeros(n, dtype=bool)
+        for i, m in enumerate(msgs):
+            tag = _TYPE_TAG.get(type(m))
+            if tag is None:
+                raise TypeError(f"not a batchable message: {type(m)!r}")
+            types[i] = tag
+            heights[i] = m.height
+            rounds[i] = m.round
+            values.append(m.value)
+            senders.append(m.sender)
+            if isinstance(m, Propose):
+                vrounds[i] = m.valid_round
+                if m.payload:
+                    payloads[i] = m.payload
+            sig = m.signature
+            if sig and len(sig) == 64:
+                sigs.append(sig)
+                has_sig[i] = True
+            else:
+                # Missing/wrong-length signatures cannot ride in a fixed
+                # 64-byte row; the row is zero-filled ONLY as padding and
+                # has_sig=False gates it — every consumer must route such
+                # rows to deterministic rejection (verify_items emits b"",
+                # which the packers length-check to invalid), never hand
+                # the zero bytes to the verifier as if they were the
+                # signature (a zero sig can verify under an adversarial
+                # small-order pubkey).
+                sigs.append(b"\x00" * 64)
+        rows["type"] = types
+        rows["height"] = heights
+        rows["round"] = rounds
+        rows["valid_round"] = vrounds
+        rows["value"] = _bytes_col(values, 32)
+        rows["sender"] = _bytes_col(senders, 32)
+        rows["signature"] = _bytes_col(sigs, 64)
+        rows["has_sig"] = has_sig
+        return cls(rows, payloads)
+
+    def to_messages(self) -> list:
+        """Materialize the rows back into message objects (exact inverse of
+        :meth:`from_messages` for well-formed inputs)."""
+        out = []
+        for i, row in enumerate(self.rows):
+            ty = int(row["type"])
+            common = dict(
+                height=int(row["height"]),
+                round=int(row["round"]),
+                value=row["value"].tobytes(),
+                sender=row["sender"].tobytes(),
+            )
+            if ty == int(MessageType.PROPOSE):
+                msg = Propose(
+                    valid_round=int(row["valid_round"]),
+                    payload=self.payloads.get(i, b""),
+                    **common,
+                )
+            elif ty == int(MessageType.PREVOTE):
+                msg = Prevote(**common)
+            else:
+                msg = Precommit(**common)
+            if row["has_sig"]:
+                msg = msg.with_signature(row["signature"].tobytes())
+            out.append(msg)
+        return out
+
+    # -------------------------------------------------------------- digests
+
+    def digests(self) -> list[bytes]:
+        """Per-row signing digests, preimages assembled columnar.
+
+        Vote digests are sha256(tag || i64 h || i64 r || value); proposes
+        additionally splice valid_round (and the payload hash when one
+        rides along), handled per-row since proposes are ~1/(2n) of
+        traffic.
+        """
+        n = len(self.rows)
+        pre = np.zeros((n, 1 + 8 + 8 + 32), dtype=np.uint8)
+        pre[:, 0:1] = self.rows["type"].astype(np.uint8).reshape(n, 1)
+        pre[:, 1:9] = self.rows["height"].astype("<i8").view(np.uint8).reshape(n, 8)
+        pre[:, 9:17] = self.rows["round"].astype("<i8").view(np.uint8).reshape(n, 8)
+        pre[:, 17:49] = self.rows["value"]
+        flat = pre.tobytes()
+        w = pre.shape[1]
+        out: list[bytes] = []
+        is_propose = self.rows["type"] == int(MessageType.PROPOSE)
+        for i in range(n):
+            if is_propose[i]:
+                row = self.rows[i]
+                buf = (
+                    b"\x01"
+                    + row["height"].astype("<i8").tobytes()
+                    + row["round"].astype("<i8").tobytes()
+                    + row["valid_round"].astype("<i8").tobytes()
+                    + row["value"].tobytes()
+                )
+                payload = self.payloads.get(i, b"")
+                if payload:
+                    buf += hashlib.sha256(payload).digest()
+                out.append(hashlib.sha256(buf).digest())
+            else:
+                out.append(hashlib.sha256(flat[i * w : (i + 1) * w]).digest())
+        return out
+
+    # -------------------------------------------------------- verifier feed
+
+    def verify_items(self) -> list[tuple[bytes, bytes, bytes]]:
+        """(pub, digest, sig) triples for the Verifier protocol. Rows with
+        ``has_sig=False`` (absent or wrong-length signature) emit ``b""``
+        so the packer's length check rejects them deterministically — the
+        same verdict the object path gives them — instead of forwarding
+        the zero padding as a signature."""
+        digests = self.digests()
+        senders = self.rows["sender"]
+        sigs = self.rows["signature"]
+        has_sig = self.rows["has_sig"]
+        return [
+            (
+                senders[i].tobytes(),
+                digests[i],
+                sigs[i].tobytes() if has_sig[i] else b"",
+            )
+            for i in range(len(self.rows))
+        ]
+
+    def pack_arrays(self):
+        """Contiguous (pubs[n,32], digests[n,32], sigs[n,64], has_sig[n])
+        uint8/bool arrays — the zero-copy feed for the native packer ABI.
+        Callers MUST mask verdicts with ``has_sig``: a False lane's
+        signature bytes are padding, not a signature."""
+        digests = _bytes_col(self.digests(), 32)
+        return (
+            np.ascontiguousarray(self.rows["sender"]),
+            digests,
+            np.ascontiguousarray(self.rows["signature"]),
+            np.ascontiguousarray(self.rows["has_sig"]),
+        )
+
+    # ----------------------------------------------------------- tally feed
+
+    def tally_inputs(self, signatories: list[bytes], vote_type: MessageType,
+                     height: int):
+        """Build the device tally tensors for one vote type at one height.
+
+        Returns (rounds, vote_vals [R, V, 8] int32, present [R, V] bool)
+        where R spans the distinct rounds this block holds for that
+        (type, height) and V indexes ``signatories``. Unknown senders and
+        duplicate votes (first wins, the log rule) are excluded. Feed
+        ``present & verify_mask`` to :func:`hyperdrive_tpu.ops.tally.
+        tally_counts` to fuse quorum counting behind signature
+        verification.
+        """
+        sel = (self.rows["type"] == int(vote_type)) & (
+            self.rows["height"] == height
+        )
+        idx = np.nonzero(sel)[0]
+        rounds = sorted({int(self.rows["round"][i]) for i in idx})
+        round_pos = {r: j for j, r in enumerate(rounds)}
+        sender_pos = {s: v for v, s in enumerate(signatories)}
+        R, V = max(len(rounds), 1), len(signatories)
+        vote_vals = np.zeros((R, V, 8), dtype=np.int32)
+        present = np.zeros((R, V), dtype=bool)
+        for i in idx:
+            v = sender_pos.get(self.rows["sender"][i].tobytes())
+            if v is None:
+                continue
+            rj = round_pos[int(self.rows["round"][i])]
+            if present[rj, v]:
+                continue  # duplicate: first vote wins (the log rule)
+            present[rj, v] = True
+            vote_vals[rj, v] = (
+                self.rows["value"][i].view("<i4").astype(np.int32)
+            )
+        return rounds, vote_vals, present

@@ -94,12 +94,19 @@ class ScenarioRecord:
     #: Burst-mode runs record each superstep's delivered-message count so
     #: replay reproduces the same window boundaries (empty = lock-step run).
     bursts: list[int] = field(default_factory=list)
+    #: Whether burst windows were fed through Process.ingest (batched rule
+    #: cascade) or per-message dispatch — replay must match, or timeout
+    #: schedules and evidence can diverge from the recorded run.
+    batch_ingest: bool = True
 
     #: Format magic+version; bump on any envelope/layout change so stale
     #: dumps are rejected with a clear error instead of desynchronizing.
-    #: v3 appends the burst-size trailer; v2 dumps (no trailer) still load.
+    #: v3 appends the burst-size trailer (v2 dumps still load); v4 appends
+    #: the batch_ingest flag. Pre-v4 dumps load as batch_ingest=False:
+    #: batched ingestion did not exist then, so every old record was
+    #: captured under per-message dispatch.
     MAGIC = 0x48594456  # "HYDV"
-    VERSION = 3
+    VERSION = 4
 
     def marshal(self, w: Writer) -> None:
         w.u32(self.MAGIC)
@@ -118,6 +125,7 @@ class ScenarioRecord:
         w.u32(len(self.bursts))
         for b in self.bursts:
             w.u32(b)
+        w.bool(self.batch_ingest)
 
     @classmethod
     def unmarshal(cls, r: Reader) -> "ScenarioRecord":
@@ -125,7 +133,7 @@ class ScenarioRecord:
         if magic != cls.MAGIC:
             raise SerdeError(f"not a scenario dump (magic {magic:#x})")
         version = r.u32()
-        if version not in (2, cls.VERSION):
+        if version not in (2, 3, cls.VERSION):
             raise SerdeError(
                 f"scenario dump version {version} unsupported "
                 f"(expected {cls.VERSION})"
@@ -138,12 +146,30 @@ class ScenarioRecord:
         nmsgs = r.u32()
         if nmsgs > 1 << 24:
             raise SerdeError("message count too large")
-        rec.messages = [(r.u32(), unmarshal_message(r)) for _ in range(nmsgs)]
+        # Intern equal messages: live runs deliver ONE broadcast object to
+        # all receivers, and downstream fast paths (identity-keyed dedup
+        # verification, digest memoization) lean on that. Restore the
+        # shared-object invariant for replayed dumps, where each delivery
+        # would otherwise deserialize to a distinct object. Message
+        # equality excludes the signature (compare=False), so it is keyed
+        # explicitly — same-content deliveries with different signatures
+        # must stay distinct objects or replayed verdicts could flip.
+        interned: dict = {}
+        rec.messages = []
+        for _ in range(nmsgs):
+            to = r.u32()
+            msg = unmarshal_message(r)
+            key = (msg, msg.signature)
+            rec.messages.append((to, interned.setdefault(key, msg)))
         if version >= 3:
             nb = r.u32()
             if nb > 1 << 24:
                 raise SerdeError("burst count too large")
             rec.bursts = [r.u32() for _ in range(nb)]
+        if version >= 4:
+            rec.batch_ingest = r.bool()
+        else:
+            rec.batch_ingest = False
         return rec
 
     def dump(self, path: str) -> None:
@@ -204,6 +230,7 @@ class Simulation:
         burst: bool = False,
         batch_verifier=None,
         dedup_verify: bool = False,
+        batch_ingest: Optional[bool] = None,
         payload_bytes: int = 0,
         dedup_reconstruct: bool = True,
     ):
@@ -277,6 +304,13 @@ class Simulation:
         self.burst = burst
         self.batch_verifier = batch_verifier
         self.dedup_verify = dedup_verify
+        #: Burst mode defaults to batched window ingestion (one rule
+        #: cascade per window — see Process.ingest); pass False to force
+        #: per-message dispatch for differential comparison.
+        self.batch_ingest = burst if batch_ingest is None else batch_ingest
+        if self.batch_ingest and not burst:
+            raise ValueError("batch_ingest requires burst=True")
+        self.record.batch_ingest = self.batch_ingest
         if batch_verifier is not None and not burst:
             raise ValueError("batch_verifier requires burst=True")
         if burst and verifier_for is not None:
@@ -483,6 +517,7 @@ class Simulation:
                 max_capacity=capacity,
                 tracer=self.tracer,
                 external_flush=self.burst,
+                batch_ingest=self.batch_ingest,
             ),
             self.signatories[i],
             list(self.signatories),
@@ -658,20 +693,23 @@ class Simulation:
                     self.replicas[i].dispatch_window(w)
                 continue
             if self.dedup_verify:
-                # One lane per distinct broadcast: the same message object
-                # fans out to all receivers, so key on the triple and give
-                # every receiver its broadcast's single verdict.
-                index: dict[tuple, int] = {}
+                # One lane per distinct broadcast. The same message OBJECT
+                # fans out to all receivers, so identity keying suffices —
+                # no 128-byte tuple keys, no per-delivery digest calls.
+                # (Two equal-content distinct objects would just occupy two
+                # lanes; verification is deterministic so verdicts agree.
+                # The window lists keep every object alive, so ids are
+                # stable for the duration of the pass.)
+                index: dict[int, int] = {}
                 items = []
                 slots: list[list[int]] = []
                 for _, w in windows:
                     row = []
                     for m in w:
-                        key = (m.sender, m.digest(), m.signature)
-                        j = index.get(key)
+                        j = index.get(id(m))
                         if j is None:
-                            j = index[key] = len(items)
-                            items.append(key)
+                            j = index[id(m)] = len(items)
+                            items.append((m.sender, m.digest(), m.signature))
                         row.append(j)
                     slots.append(row)
                 self.tracer.observe("sim.verify.launch", len(items))
@@ -713,6 +751,7 @@ class Simulation:
             seed=record.seed,
             signatories=list(record.signatories),
             burst=bool(record.bursts),
+            batch_ingest=record.batch_ingest if record.bursts else None,
             **kwargs,
         )
         for i, r in enumerate(sim.replicas):

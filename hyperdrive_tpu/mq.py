@@ -113,10 +113,27 @@ class MessageQueue:
         if q is None:
             q = self._queues[msg.sender] = []
             self._order[msg.sender] = len(self._order)
-        # Insert after all entries with the same (height, round) so equal-key
-        # messages stay FIFO (reference: sort.Search semantics, mq/mq.go:117-127).
-        idx = bisect_right(q, (msg.height, msg.round), key=lambda m: (m.height, m.round))
-        q.insert(idx, msg)
+        # Fast path: consensus traffic arrives overwhelmingly in ascending
+        # (height, round) order, so most inserts are appends — skip the
+        # binary search (and its per-probe key lambda) entirely.
+        if not q:
+            q.append(msg)
+            idx = 0
+        else:
+            last = q[-1]
+            if (last.height, last.round) <= (msg.height, msg.round):
+                q.append(msg)
+                idx = len(q) - 1
+            else:
+                # Insert after all entries with the same (height, round) so
+                # equal-key messages stay FIFO (reference: sort.Search
+                # semantics, mq/mq.go:117-127).
+                idx = bisect_right(
+                    q,
+                    (msg.height, msg.round),
+                    key=lambda m: (m.height, m.round),
+                )
+                q.insert(idx, msg)
         # Drop the far-future tail when over capacity (reference: mq/mq.go:139-142).
         if len(q) > self.max_capacity:
             del q[self.max_capacity :]

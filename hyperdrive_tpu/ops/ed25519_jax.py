@@ -696,7 +696,13 @@ class TpuBatchVerifier:
         return out[0] if len(out) == 1 else np.concatenate(out)
 
     def verify_batch(self, window):
-        """Verifier-protocol entry: messages with detached signatures."""
+        """Verifier-protocol entry: messages with detached signatures.
+
+        Stays on the object path deliberately: one broadcast object fans
+        out to every replica's window, so ``m.digest()`` memoization makes
+        the digest a once-per-broadcast cost — columnarizing here
+        (``MessageBlock``) would recompute it per delivery.
+        """
         # Signatures pass through unchanged: the packer (native or Python)
         # length-checks and leaves wrong-length lanes prevalid=False, so
         # rejection is deterministic — never substitute zeros, which could

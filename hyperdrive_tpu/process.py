@@ -217,6 +217,67 @@ class Process:
         self._try_commit_upon_sufficient_precommits(precommit.round)
         self._try_timeout_precommit_upon_sufficient_precommits()
 
+    def ingest(self, msgs) -> None:
+        """Receive a whole verified window: insert every message, then run
+        the rule cascade ONCE (per touched round for the round-
+        parameterized rules) instead of once per message.
+
+        This is the batched driving mode (SURVEY.md §7.1(4)): the try*
+        rules are monotone threshold checks over the logs with once-flag
+        idempotence, so evaluating them after the window sees exactly the
+        final log state every per-message schedule would eventually reach —
+        the outcome corresponds to a legal delivery order of the same
+        messages (order-insensitivity is property-tested). Observable
+        differences vs strict per-message delivery are confined to (a)
+        equivocation evidence for messages a mid-window commit would have
+        dropped — strictly more evidence — and (b) timeout schedulings
+        whose guards (step checks at fire time) make them no-ops anyway.
+
+        All messages must be for the current height (the mq drain
+        guarantees this); inserts therefore happen before any rule can
+        advance the height, and a commit fired from the cascade wipes the
+        very logs later-round rule evaluations would have read — those
+        evaluations then no-op on empty logs, exactly as if the messages
+        had arrived after the commit and been height-filtered.
+        """
+        commit_rounds = set()
+        vote_rounds = set()
+        for msg in msgs:
+            t = type(msg)
+            if t is Prevote:
+                if self._insert_prevote(msg):
+                    vote_rounds.add(msg.round)
+            elif t is Precommit:
+                if self._insert_precommit(msg):
+                    vote_rounds.add(msg.round)
+                    commit_rounds.add(msg.round)
+            else:
+                if self._insert_propose(msg):
+                    vote_rounds.add(msg.round)
+                    commit_rounds.add(msg.round)
+        if not vote_rounds and not commit_rounds:
+            return
+        # Commits first (progress beats round-skipping when both are
+        # enabled — each is a legal next transition); then the future-round
+        # skip; then the current-round cascade. The skip walks rounds
+        # highest-first and stops at the first that fires: the final round
+        # is the maximal qualifying one either way, and stopping there
+        # avoids scheduling timeouts for intermediate rounds the automaton
+        # would immediately leave.
+        for r in sorted(commit_rounds):
+            self._try_commit_upon_sufficient_precommits(r)
+        for r in sorted(vote_rounds, reverse=True):
+            before = self.state.current_round
+            self._try_skip_to_future_round(r)
+            if self.state.current_round != before:
+                break
+        self._try_precommit_upon_sufficient_prevotes()
+        self._try_precommit_nil_upon_sufficient_prevotes()
+        self._try_prevote_upon_propose()
+        self._try_prevote_upon_sufficient_prevotes()
+        self._try_timeout_precommit_upon_sufficient_precommits()
+        self._try_timeout_prevote_upon_sufficient_prevotes()
+
     # --------------------------------------------------------------- control
 
     def start(self) -> None:
@@ -499,10 +560,15 @@ class Process:
             self._step_to_precommitting()
 
     def _try_timeout_precommit_upon_sufficient_precommits(self) -> None:
-        """L47: first time exactly 2f+1 precommits (any value) arrive at the
+        """L47: first time 2f+1 precommits (any value) arrive at the
         current round -> schedule the precommit timeout
-        (reference: process/process.go:654-664; note the reference checks
-        ``== 2f+1``, not ``>=`` — preserved here)."""
+        (reference: process/process.go:654-664). The reference checks
+        ``== 2f+1`` — safe there because per-message inserts grow the log
+        by exactly one, so the first sufficient state is always exactly
+        2f+1. Under batched ingestion (:meth:`ingest`) a window can jump
+        the count past 2f+1 in one pass, so the check must be ``>=``; the
+        once-flag keeps it single-fire, making ``>=`` and ``==``
+        observationally identical on the per-message path."""
         if self._check_once_flag(
             self.state.current_round,
             OnceFlag.TIMEOUT_PRECOMMIT_UPON_SUFFICIENT_PRECOMMITS,
@@ -510,7 +576,7 @@ class Process:
             return
         if (
             len(self.state.precommit_logs.get(self.state.current_round, {}))
-            == 2 * self.f + 1
+            >= 2 * self.f + 1
         ):
             if self.timer is not None:
                 self.timer.timeout_precommit(

@@ -86,6 +86,11 @@ class ReplicaOptions:
     #: protocol so many replicas' windows can be signature-verified in one
     #: aggregated device launch (the harness burst mode).
     external_flush: bool = False
+    #: When True, :meth:`Replica.dispatch_window` feeds survivors through
+    #: :meth:`Process.ingest` — one rule-cascade pass per window instead of
+    #: per message (the batched driving mode; see Process.ingest for the
+    #: equivalence argument).
+    batch_ingest: bool = False
     tracer: object = None
     logger: object = None
 
@@ -359,19 +364,29 @@ class Replica:
         per-message consume loop would have dropped.
         """
         verified = keep is not None
-        n_ok = 0
-        for j, msg in enumerate(window):
-            if verified and not keep[j]:
-                continue
-            if msg.sender not in self.procs_allowed:
-                continue
-            n_ok += 1
-            if isinstance(msg, Propose):
-                self.proc.propose(msg)
-            elif isinstance(msg, Prevote):
-                self.proc.prevote(msg)
-            else:
-                self.proc.precommit(msg)
+        allowed = self.procs_allowed
+        if self.opts.batch_ingest:
+            batch = [
+                msg
+                for j, msg in enumerate(window)
+                if (not verified or keep[j]) and msg.sender in allowed
+            ]
+            n_ok = len(batch)
+            self.proc.ingest(batch)
+        else:
+            n_ok = 0
+            for j, msg in enumerate(window):
+                if verified and not keep[j]:
+                    continue
+                if msg.sender not in allowed:
+                    continue
+                n_ok += 1
+                if isinstance(msg, Propose):
+                    self.proc.propose(msg)
+                elif isinstance(msg, Prevote):
+                    self.proc.prevote(msg)
+                else:
+                    self.proc.precommit(msg)
         if verified and self.tracer is not NULL_TRACER:
             self.tracer.count("replica.verify.accepted", n_ok)
             self.tracer.count("replica.verify.rejected", len(window) - n_ok)

@@ -266,6 +266,28 @@ def test_burst_record_replays_exactly(tmp_path):
     assert replayed.heights == res.heights
 
 
+def test_burst_differential_modes_agree_and_replay_preserves_mode(tmp_path):
+    # batch_ingest=False is the differential mode: same superstep windows,
+    # per-message dispatch. Both modes must commit safely, and a dumped
+    # record must replay under ITS OWN ingestion mode (a per-message record
+    # silently replayed batched could diverge in schedules/evidence).
+    batched = Simulation(n=7, target_height=5, seed=79, burst=True).run()
+    serial = Simulation(
+        n=7, target_height=5, seed=79, burst=True, batch_ingest=False
+    ).run()
+    assert batched.completed and serial.completed
+    batched.assert_safety()
+    serial.assert_safety()
+
+    path = os.path.join(tmp_path, "serial.dump")
+    serial.record.dump(path)
+    loaded = ScenarioRecord.load(path)
+    assert loaded.batch_ingest is False
+    replayed = Simulation.replay(loaded)
+    assert replayed.commits == serial.commits
+    assert replayed.heights == serial.heights
+
+
 def test_burst_signed_with_tpu_batch_verifier():
     # The full BASELINE config-4 pipeline at miniature scale: a signed
     # burst-mode network whose aggregated windows are verified by the

@@ -427,6 +427,54 @@ class TestTimeoutPrecommitUponSufficientPrecommits:
         proc.precommit(precommit(PROPOSER, val(1)))
         assert rec.timeout_precommits == [(1, 0)]
 
+    def test_batched_ingest_jumping_past_quorum_still_schedules(self):
+        """Regression: a window can push the distinct-precommit count from
+        0 straight past 2f+1; the (once-flagged) check must be >= or the
+        timeout is never scheduled and round 0 stalls forever."""
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.ingest([
+            precommit(OTHER_A, val(1)),
+            precommit(OTHER_B, NIL_VALUE),
+            precommit(OTHER_C, val(2)),
+            precommit(PROPOSER, NIL_VALUE),  # count 0 -> 4, skips ==3
+        ])
+        assert rec.timeout_precommits == [(1, 0)]
+
+
+class TestBatchedIngest:
+    def test_full_round_window_commits(self):
+        """One ingest of an entire round's traffic (propose + prevote and
+        precommit quorums) commits and advances the height, exactly like
+        serial delivery."""
+        proc, rec, _ = make_process()
+        proc.start()
+        msgs = [propose(val(1))]
+        msgs += [prevote(s, val(1)) for s in (OTHER_A, OTHER_B, OTHER_C)]
+        msgs += [precommit(s, val(1)) for s in (OTHER_A, OTHER_B, OTHER_C)]
+        proc.ingest(msgs)
+        assert rec.commits == [(1, val(1))]
+        assert proc.current_height == 2
+
+    def test_future_round_skip_from_window(self):
+        """f+1 distinct senders at a future round inside one window fire
+        the L55 skip."""
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.ingest([
+            prevote(OTHER_A, val(1), round=3),
+            prevote(OTHER_B, NIL_VALUE, round=3),
+        ])
+        assert proc.state.current_round == 3
+
+    def test_empty_and_rejected_windows_are_noops(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        proc.ingest([])
+        proc.ingest([prevote(OTHER_A, val(1), height=9)])  # wrong height
+        assert proc.current_height == 1
+        assert rec.commits == []
+
 
 # ------------------------------------------------------------------------ L49
 
