@@ -191,3 +191,18 @@ assert res.completed, f"device-verified run stalled at {res.heights}"
 res.assert_safety()
 print(f"PASS: consensus with batched device verifier to height 2 "
       f"({res.steps} deliveries)")
+
+# --- probe 8: device vote-grid tallies feeding the rule cascade --------
+# Quorum counts come from masked reductions over device-resident vote
+# tensors; CheckedTallyView raises on any device/host count divergence.
+from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+
+host_run = Simulation(n=7, target_height=4, seed=303, burst=True).run()
+grid_run = Simulation(n=7, target_height=4, seed=303, burst=True,
+                      device_tally=True,
+                      tally_check=CheckedTallyView).run()
+assert grid_run.completed, f"device-tally run stalled at {grid_run.heights}"
+grid_run.assert_safety()
+assert grid_run.commits == host_run.commits
+print(f"PASS: device vote-grid tallies drove consensus to height 4, "
+      f"count-identical to host tallies ({grid_run.steps} steps)")

@@ -112,7 +112,8 @@ def _wall_tracer():
     return Tracer(time_fn=time.perf_counter)
 
 
-def _run_signed_burst(ver, heights: int, dedup: bool, seed: int) -> dict:
+def _run_signed_burst(ver, heights: int, dedup: bool, seed: int,
+                      device_tally: bool = False) -> dict:
     from hyperdrive_tpu.harness import Simulation
 
     sim = Simulation(
@@ -124,6 +125,7 @@ def _run_signed_burst(ver, heights: int, dedup: bool, seed: int) -> dict:
         burst=True,
         batch_verifier=ver,
         dedup_verify=dedup,
+        device_tally=device_tally,
     )
     wall_tr = _wall_tracer()
     for r in sim.replicas:
@@ -180,6 +182,12 @@ def config_4() -> dict:
 
     dedup = _run_signed_burst(ver, heights=100, dedup=True, seed=1004)
     redundant = _run_signed_burst(ver, heights=20, dedup=False, seed=1044)
+    # (a') the dedup run again with the device vote grid: quorum counts
+    # come from masked reductions over device-resident vote tensors
+    # (ops/votegrid) instead of host counters — the full fused pipeline.
+    grid_run = _run_signed_burst(
+        ver, heights=100, dedup=True, seed=1004, device_tally=True
+    )
 
     # (c) one round window (2 phases x 256 votes = 512 signatures):
     # native host batch vs device launch, medians over 16 reps.
@@ -223,6 +231,7 @@ def config_4() -> dict:
         "rlc": RLC_DEFAULT,
         "dedup_run": dedup,
         "redundant_run": redundant,
+        "device_tally_run": grid_run,
         "round512_p50_latency_host_native_s": round(p50_host, 5),
         "round512_p50_latency_device_s": round(p50_dev, 5),
         "round512_p50_latency_routed_s": round(p50_routed, 5),

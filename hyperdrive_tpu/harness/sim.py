@@ -26,6 +26,8 @@ from __future__ import annotations
 import hashlib
 import heapq
 import random
+
+import numpy as np
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -231,6 +233,8 @@ class Simulation:
         batch_verifier=None,
         dedup_verify: bool = False,
         batch_ingest: Optional[bool] = None,
+        device_tally: bool = False,
+        tally_check=None,
         payload_bytes: int = 0,
         dedup_reconstruct: bool = True,
     ):
@@ -311,6 +315,19 @@ class Simulation:
         if self.batch_ingest and not burst:
             raise ValueError("batch_ingest requires burst=True")
         self.record.batch_ingest = self.batch_ingest
+        #: Device-resident quorum tallies (ops.votegrid): scatter accepted
+        #: votes into per-replica vote tensors and feed the rule cascade
+        #: the device counts. Behavior-neutral by construction (counts
+        #: equal the host counters wherever the TallyView answers), so no
+        #: record flag is needed — replays without a grid are identical.
+        self.device_tally = device_tally
+        #: Optional callable (view, proc) -> view, used by tests to wrap
+        #: every TallyView in a host-vs-device equality checker.
+        self._tally_check = tally_check
+        if device_tally and not (burst and self.batch_ingest):
+            raise ValueError(
+                "device_tally requires burst=True with batched ingestion"
+            )
         if batch_verifier is not None and not burst:
             raise ValueError("batch_verifier requires burst=True")
         if burst and verifier_for is not None:
@@ -343,6 +360,15 @@ class Simulation:
                 for i in range(n)
             ]
         self.record.signatories = list(self.signatories)
+        if device_tally:
+            from hyperdrive_tpu.ops.votegrid import VoteGrid
+
+            self.vote_grid = VoteGrid(n, len(self.signatories))
+            self._grid_height = [-1] * n
+            self._grid_dirty: list[set] = [set() for _ in range(n)]
+            self._sender_pos = {
+                s: v for v, s in enumerate(self.signatories)
+            }
         self.payload_bytes = payload_bytes
         self.dedup_reconstruct = dedup_reconstruct
         self._bundle_cache: dict[Value, bytes] = {}
@@ -688,11 +714,8 @@ class Simulation:
                     windows.append((i, w))
             if not windows:
                 return
-            if self.batch_verifier is None:
-                for i, w in windows:
-                    self.replicas[i].dispatch_window(w)
-                continue
-            if self.dedup_verify:
+            keeps: list = [None] * len(windows)
+            if self.batch_verifier is not None and self.dedup_verify:
                 # One lane per distinct broadcast. The same message OBJECT
                 # fans out to all receivers, so identity keying suffices —
                 # no 128-byte tuple keys, no per-delivery digest calls.
@@ -714,9 +737,8 @@ class Simulation:
                     slots.append(row)
                 self.tracer.observe("sim.verify.launch", len(items))
                 mask = self.batch_verifier.verify_signatures(items)
-                for (i, w), row in zip(windows, slots):
-                    self.replicas[i].dispatch_window(w, [mask[j] for j in row])
-            else:
+                keeps = [[mask[j] for j in row] for row in slots]
+            elif self.batch_verifier is not None:
                 items = [
                     (m.sender, m.digest(), m.signature)
                     for _, w in windows
@@ -725,11 +747,139 @@ class Simulation:
                 self.tracer.observe("sim.verify.launch", len(items))
                 mask = self.batch_verifier.verify_signatures(items)
                 off = 0
-                for i, w in windows:
-                    self.replicas[i].dispatch_window(
-                        w, mask[off : off + len(w)]
-                    )
+                for wi, (i, w) in enumerate(windows):
+                    keeps[wi] = mask[off : off + len(w)]
                     off += len(w)
+            if self.device_tally:
+                self._dispatch_tallied(windows, keeps)
+            else:
+                for (i, w), keep in zip(windows, keeps):
+                    self.replicas[i].dispatch_window(w, keep)
+
+    def _dispatch_tallied(self, windows, keeps) -> None:
+        """Device-tally dispatch: insert every window, scatter the accepted
+        votes into the persistent device vote grid, run ONE fused tally
+        launch for the whole network, then run each replica's rule cascade
+        against its :class:`TallyView` slice.
+
+        This is the north-star data path: quorum counts come from masked
+        reductions over device-resident vote tensors (fused behind the
+        verification mask — only verified survivors are scattered), and the
+        Process consumes the resulting counts instead of rescanning its
+        logs. The counts are *exactly equal* to the host counters whenever
+        the view answers (enforced by CheckedTallyView in tests), so runs,
+        records, and replays are bit-identical to host-tally mode.
+        """
+        from hyperdrive_tpu.batch import MessageBlock
+        from hyperdrive_tpu.ops.tally import pack_value
+        from hyperdrive_tpu.ops.votegrid import TallyView
+
+        grid = self.vote_grid
+        R = grid.R
+        n = self.n
+
+        # Reset planes for replicas whose height moved since their grid
+        # rows were last valid. Inserts never change heights, so computing
+        # resets before the insert phase is safe — and necessary, so the
+        # insert hooks' dirty marks for the NEW height survive.
+        reset = np.zeros(n, dtype=bool)
+        for i, _ in windows:
+            h = self.replicas[i].current_height()
+            if self._grid_height[i] != h:
+                reset[i] = True
+                self._grid_height[i] = h
+                self._grid_dirty[i] = set()
+
+        accepted: list = []  # (replica, plane, msg) in scatter order
+
+        def make_hook(i, dirty):
+            def on_accepted(msg, is_precommit):
+                rnd = msg.round
+                plane = 1 if is_precommit else 0
+                if rnd < 0 or rnd >= R:
+                    # Outside the slot window: TallyView declines these
+                    # rounds, so not scattering them is safe. The lower
+                    # bound matters — vote inserts (unlike propose) accept
+                    # negative rounds, and a slot of -1 would alias into a
+                    # neighboring lane's slot R-1 as a phantom vote.
+                    return
+                v = self._sender_pos.get(msg.sender)
+                if v is None:
+                    # Whitelisted sender outside the grid's validator axis
+                    # (post-rotation): this round's device count would
+                    # undercount, so poison it for the height.
+                    dirty.add((plane, rnd))
+                    return
+                accepted.append((i, plane, msg))
+            return on_accepted
+
+        plans = []
+        for (i, w), keep in zip(windows, keeps):
+            hook = make_hook(i, self._grid_dirty[i])
+            plans.append(
+                (i, self.replicas[i].ingest_insert_window(w, keep, hook))
+            )
+
+        # Launch inputs. Matching targets are each replica's proposal value
+        # per round slot (post-insert, so this window's proposals count);
+        # the L28 lane carries the cross-round (valid_round, current
+        # proposal value) query.
+        targets = np.zeros((n, R, 8), dtype=np.int32)
+        tvalid = np.zeros((n, R), dtype=bool)
+        l28_slot = np.full(n, -1, dtype=np.int32)
+        l28_target = np.zeros((n, 8), dtype=np.int32)
+        fs = np.zeros(n, dtype=np.int32)
+        tmaps: dict[int, dict] = {}
+        l28_vals: dict[int, bytes] = {}
+        for i, _ in windows:
+            proc = self.replicas[i].proc
+            st = proc.state
+            fs[i] = proc.f
+            tmap: dict = {}
+            for rnd, p in st.propose_logs.items():
+                if 0 <= rnd < R:
+                    targets[i, rnd] = pack_value(p.value)
+                    tvalid[i, rnd] = True
+                    tmap[rnd] = p.value
+            tmaps[i] = tmap
+            cur = st.propose_logs.get(st.current_round)
+            if cur is not None and 0 <= cur.valid_round < R:
+                l28_slot[i] = cur.valid_round
+                l28_target[i] = pack_value(cur.value)
+                l28_vals[i] = cur.value
+
+        if accepted:
+            block = MessageBlock.from_messages([m for _, _, m in accepted])
+            words = np.ascontiguousarray(block.rows["value"]).view("<i4")
+            idx = np.array(
+                [
+                    (i, plane, m.round, self._sender_pos[m.sender])
+                    for i, plane, m in accepted
+                ],
+                dtype=np.int32,
+            )
+        else:
+            words = np.zeros((0, 8), dtype=np.int32)
+            idx = np.zeros((0, 4), dtype=np.int32)
+        counts = grid.update_and_tally(
+            idx, words, reset, targets, tvalid, l28_slot, l28_target, fs
+        )
+        self.tracer.observe("sim.tally.launch", len(idx))
+
+        for i, plan in plans:
+            view = TallyView(
+                i,
+                self._grid_height[i],
+                counts,
+                R,
+                tmaps[i],
+                int(l28_slot[i]),
+                l28_vals.get(i, b""),
+                dirty=self._grid_dirty[i],
+            )
+            if self._tally_check is not None:
+                view = self._tally_check(view, self.replicas[i].proc)
+            self.replicas[i].ingest_cascade_window(plan, view)
 
     # -------------------------------------------------------------- replay
 

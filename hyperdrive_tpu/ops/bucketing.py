@@ -1,0 +1,25 @@
+"""Static-shape bucket selection, shared by every padded device launch.
+
+XLA compiles one executable per input shape, so variable-size batches are
+padded up to a small ladder of precompiled bucket sizes; batches beyond
+the largest bucket round up to its next multiple (large launches amortize
+the padding, and chunked callers split on the largest bucket anyway).
+One policy, one place — the Ed25519 packer, the vote grid, and any future
+padded launch must agree or they recompile/pad inconsistently.
+"""
+
+from __future__ import annotations
+
+import math
+
+__all__ = ["bucket_for"]
+
+
+def bucket_for(n: int, buckets) -> int:
+    """Smallest bucket holding ``n``, else the next multiple of the
+    largest. ``buckets`` must be sorted ascending and non-empty."""
+    for b in buckets:
+        if n <= b:
+            return b
+    top = buckets[-1]
+    return math.ceil(n / top) * top

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from hyperdrive_tpu.crypto import ed25519 as host_ed
+from hyperdrive_tpu.ops import bucketing
 from hyperdrive_tpu.ops import fe25519 as fe
 
 __all__ = [
@@ -477,10 +478,7 @@ class Ed25519BatchHost:
                 )
 
     def bucket_for(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        return int(np.ceil(n / self.buckets[-1])) * self.buckets[-1]
+        return bucketing.bucket_for(n, self.buckets)
 
     def pack(self, items):
         """items: iterable of (pub32, digest, sig64).

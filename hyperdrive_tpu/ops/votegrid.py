@@ -1,0 +1,303 @@
+"""Device-resident vote grids: the consensus tally state lives on device.
+
+This is the integration the north star describes — "quorum tallies become
+masked reductions fused behind the verification mask". The reference scans
+Go maps per received vote (reference: process/process.go:487-491, 574-579,
+626-631, 696-701); :mod:`hyperdrive_tpu.ops.tally` already expresses one
+window's counts as masked reductions; this module makes the *accumulated*
+per-replica vote state a persistent device tensor so every settle pass is
+one scatter + one fused reduction for the whole network:
+
+- ``values [n, 2, R, V, 8]`` int32 — per replica, per vote plane
+  (0=prevote, 1=precommit), per round slot, per validator, the 32-byte
+  vote value as eight little-endian words;
+- ``present [n, 2, R, V]`` bool — vote exists, passed signature
+  verification, and survived the host automaton's duplicate/equivocation
+  filters (only *accepted* inserts are scattered, so the grid is exactly
+  the device image of ``State.prevote_logs``/``precommit_logs``).
+
+Each :meth:`VoteGrid.update_and_tally` call scatters one superstep's
+accepted votes for ALL replicas and returns every per-round count the
+rule cascade needs (L28/L34/L36/L44/L47/L49) — the Process then consumes
+these counts instead of rescanning its logs (see ``Process.ingest``'s
+tally source). Buffers are donated, so the grids update in place on
+device; the host only ever sees the small ``[n, 2, R]`` count tensors.
+
+Capacity: round slots cover rounds ``0..R-1`` of each replica's current
+height. Rounds beyond the window (rare — they require R consecutive
+failed rounds) simply aren't covered; the cascade falls back to the host
+counters for those rounds, which remain authoritative and are what the
+differential tests compare against.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hyperdrive_tpu.ops import bucketing
+from hyperdrive_tpu.types import NIL_VALUE
+
+__all__ = [
+    "PREVOTE_PLANE",
+    "PRECOMMIT_PLANE",
+    "VoteGrid",
+    "TallyView",
+    "CheckedTallyView",
+]
+
+PREVOTE_PLANE = 0
+PRECOMMIT_PLANE = 1
+
+
+class TallyView:
+    """One replica's slice of a :class:`VoteGrid` launch result — the
+    object ``Process.ingest_cascade`` consults for quorum thresholds.
+
+    The view answers a count query ONLY when the launch provably tallied
+    that exact query; otherwise it returns None and the Process falls back
+    to its host counters. Declines happen for: rounds outside the slot
+    window, rounds marked dirty (a vote couldn't be scattered — unknown
+    sender), target values the launch didn't compare against, and any
+    query after the replica's height moved past :attr:`height`.
+    """
+
+    __slots__ = ("rep", "height", "counts", "R", "targets",
+                 "l28_round", "l28_value", "dirty")
+
+    def __init__(self, rep: int, height: int, counts: dict, r_slots: int,
+                 targets: dict, l28_round: int, l28_value: bytes,
+                 dirty=frozenset()):
+        self.rep = rep
+        self.height = height
+        self.counts = counts
+        self.R = r_slots
+        #: round -> the 32-byte proposal value the launch used as that
+        #: round's matching target.
+        self.targets = targets
+        self.l28_round = l28_round
+        self.l28_value = l28_value
+        self.dirty = dirty
+
+    def _covered(self, plane: int, rnd: int) -> bool:
+        return 0 <= rnd < self.R and (plane, rnd) not in self.dirty
+
+    def _matching(self, plane: int, rnd: int, value: bytes):
+        if not self._covered(plane, rnd):
+            return None
+        if self.targets.get(rnd) == value:
+            return int(self.counts["matching"][self.rep, plane, rnd])
+        if value == NIL_VALUE:
+            return int(self.counts["nil"][self.rep, plane, rnd])
+        return None
+
+    def prevotes_for(self, rnd: int, value: bytes):
+        c = self._matching(PREVOTE_PLANE, rnd, value)
+        if c is not None:
+            return c
+        # The L28 cross-round lane: prevotes at the current proposal's
+        # valid_round compared against the current proposal's value.
+        if (
+            rnd == self.l28_round
+            and value == self.l28_value
+            and self._covered(PREVOTE_PLANE, rnd)
+        ):
+            return int(self.counts["l28"][self.rep])
+        return None
+
+    def precommits_for(self, rnd: int, value: bytes):
+        return self._matching(PRECOMMIT_PLANE, rnd, value)
+
+    def prevote_total(self, rnd: int):
+        if not self._covered(PREVOTE_PLANE, rnd):
+            return None
+        return int(self.counts["total"][self.rep, PREVOTE_PLANE, rnd])
+
+    def precommit_total(self, rnd: int):
+        if not self._covered(PRECOMMIT_PLANE, rnd):
+            return None
+        return int(self.counts["total"][self.rep, PRECOMMIT_PLANE, rnd])
+
+
+def _kernel(values, present, reset, idx, words, valid,
+            targets, target_valid, l28_slot, l28_target, f):
+    """One fused scatter + tally step.
+
+    values [n,2,R,V,8] i32 (donated), present [n,2,R,V] bool (donated),
+    reset [n] bool — zero a replica's planes before scattering (height
+    advanced), idx [k,4] i32 rows (replica, plane, slot, validator),
+    words [k,8] i32 vote values, valid [k] bool (padding mask),
+    targets [n,R,8] i32 per-round proposal values, target_valid [n,R],
+    l28_slot [n] i32 (valid-round slot for the L28 cross-round count, or
+    -1), l28_target [n,8] i32 (the *current* round's proposal value),
+    f [n] i32.
+    """
+    n, _, R, V, _ = values.shape
+
+    keep = ~reset[:, None, None, None]
+    present = present & keep
+
+    flat_vals = values.reshape(-1, 8)
+    flat_pres = present.reshape(-1)
+    lane = ((idx[:, 0] * 2 + idx[:, 1]) * R + idx[:, 2]) * V + idx[:, 3]
+    lane = jnp.where(valid, lane, flat_pres.shape[0])  # OOB lanes drop
+    flat_vals = flat_vals.at[lane].set(words, mode="drop")
+    flat_pres = flat_pres.at[lane].set(True, mode="drop")
+    values = flat_vals.reshape(n, 2, R, V, 8)
+    present = flat_pres.reshape(n, 2, R, V)
+
+    pres_i = present.astype(jnp.int32)
+    eq_target = (
+        jnp.all(values == targets[:, None, :, None, :], axis=-1)
+        & target_valid[:, None, :, None]
+    )
+    eq_nil = jnp.all(values == 0, axis=-1)  # NIL_VALUE is 32 zero bytes
+    matching = jnp.sum(eq_target & present, axis=-1, dtype=jnp.int32)
+    nil = jnp.sum(eq_nil & present, axis=-1, dtype=jnp.int32)
+    total = jnp.sum(pres_i, axis=-1, dtype=jnp.int32)
+
+    # L28 cross-round count: prevotes at the CURRENT proposal's valid_round
+    # matching the CURRENT proposal's value (the per-round targets above
+    # compare round r's votes against round r's own proposal).
+    slot_ok = jnp.arange(R)[None, :] == l28_slot[:, None]  # [n, R]
+    eq28 = (
+        jnp.all(values[:, PREVOTE_PLANE] == l28_target[:, None, None, :],
+                axis=-1)
+        & present[:, PREVOTE_PLANE]
+        & slot_ok[:, :, None]
+    )
+    l28 = jnp.sum(eq28, axis=(1, 2), dtype=jnp.int32)  # [n]
+
+    q = (2 * f + 1)[:, None, None]
+    counts = {
+        "matching": matching,
+        "nil": nil,
+        "total": total,
+        "l28": l28,
+        "quorum_matching": matching >= q,
+        "quorum_nil": nil >= q,
+        "quorum_any": total >= q,
+        "l28_quorum": l28 >= 2 * f + 1,
+    }
+    return values, present, counts
+
+
+class CheckedTallyView:
+    """Differential instrumentation: wraps a :class:`TallyView` and
+    cross-checks every device-sourced count against the host counters
+    before returning it — a mismatch raises. Tests and the verify drive
+    install it (``Simulation(tally_check=CheckedTallyView)``) to certify
+    that device-tally runs are count-for-count identical to host runs.
+    ``hits`` counts answered queries so a test can assert the device path
+    was actually exercised rather than silently falling back."""
+
+    __slots__ = ("view", "proc", "height", "hits")
+
+    def __init__(self, view: TallyView, proc):
+        self.view = view
+        self.proc = proc
+        self.height = view.height
+        self.hits = 0
+
+    def _check(self, device, host, what):
+        if device is None:
+            return None
+        self.hits += 1
+        if device != host:
+            raise AssertionError(
+                f"device {what} count {device} != host {host} "
+                f"(replica {self.view.rep}, height {self.height})"
+            )
+        return device
+
+    def prevotes_for(self, rnd, value):
+        return self._check(
+            self.view.prevotes_for(rnd, value),
+            self.proc.state.count_prevotes_for(rnd, value),
+            f"prevote[r={rnd}]",
+        )
+
+    def precommits_for(self, rnd, value):
+        return self._check(
+            self.view.precommits_for(rnd, value),
+            self.proc.state.count_precommits_for(rnd, value),
+            f"precommit[r={rnd}]",
+        )
+
+    def prevote_total(self, rnd):
+        return self._check(
+            self.view.prevote_total(rnd),
+            len(self.proc.state.prevote_logs.get(rnd, {})),
+            f"prevote_total[r={rnd}]",
+        )
+
+    def precommit_total(self, rnd):
+        return self._check(
+            self.view.precommit_total(rnd),
+            len(self.proc.state.precommit_logs.get(rnd, {})),
+            f"precommit_total[r={rnd}]",
+        )
+
+
+class VoteGrid:
+    """Persistent device grids for ``n`` replicas × ``validators`` senders.
+
+    One instance serves a whole simulated network (or, in a deployment,
+    one chip's replica set). Call :meth:`update_and_tally` once per settle
+    pass; it returns host numpy counts for every (replica, plane, slot).
+    """
+
+    def __init__(self, n_replicas: int, n_validators: int, r_slots: int = 8,
+                 buckets: tuple = (256, 1024, 4096, 16384)):
+        self.n = n_replicas
+        self.V = n_validators
+        self.R = r_slots
+        self.buckets = tuple(sorted(buckets))
+        self._values = jnp.zeros(
+            (n_replicas, 2, r_slots, n_validators, 8), dtype=jnp.int32
+        )
+        self._present = jnp.zeros(
+            (n_replicas, 2, r_slots, n_validators), dtype=bool
+        )
+        # Donating the grid buffers keeps the accumulated state device-
+        # resident: each call consumes the previous arrays in place.
+        self._fn = jax.jit(_kernel, donate_argnums=(0, 1))
+
+    def bucket_for(self, k: int) -> int:
+        return bucketing.bucket_for(k, self.buckets)
+
+    def update_and_tally(self, idx, words, reset, targets, target_valid,
+                         l28_slot, l28_target, f):
+        """Scatter accepted votes, reduce, return counts as numpy.
+
+        idx [k,4] int32 (replica, plane, slot, validator) — the host
+        automaton guarantees at most one row per lane per call (duplicate
+        and equivocating votes are rejected before scatter); words [k,8]
+        int32; remaining args as in :func:`_kernel` (numpy, host-built
+        per settle). Returns a dict of numpy arrays.
+        """
+        k = len(idx)
+        b = self.bucket_for(max(k, 1))
+        pad_idx = np.zeros((b, 4), dtype=np.int32)
+        pad_words = np.zeros((b, 8), dtype=np.int32)
+        valid = np.zeros(b, dtype=bool)
+        if k:
+            pad_idx[:k] = idx
+            pad_words[:k] = words
+            valid[:k] = True
+        self._values, self._present, counts = self._fn(
+            self._values,
+            self._present,
+            jnp.asarray(reset),
+            jnp.asarray(pad_idx),
+            jnp.asarray(pad_words),
+            jnp.asarray(valid),
+            jnp.asarray(targets),
+            jnp.asarray(target_valid),
+            jnp.asarray(l28_slot),
+            jnp.asarray(l28_target),
+            jnp.asarray(f),
+        )
+        return {key: np.asarray(v) for key, v in counts.items()}

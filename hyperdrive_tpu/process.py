@@ -154,6 +154,7 @@ class Process:
         "committer",
         "catcher",
         "state",
+        "_tally_source",
     )
 
     def __init__(
@@ -185,6 +186,10 @@ class Process:
             self.state = State.default_with_height(height)
         else:
             self.state = State()
+        #: Device tally counts installed for the duration of one
+        #: ingest_cascade call (see the _prevotes_for family); None means
+        #: every threshold check reads the host counters.
+        self._tally_source = None
 
     # ---------------------------------------------------------------- inputs
 
@@ -240,6 +245,18 @@ class Process:
         evaluations then no-op on empty logs, exactly as if the messages
         had arrived after the commit and been height-filtered.
         """
+        self.ingest_cascade(self.ingest_insert(msgs))
+
+    def ingest_insert(self, msgs, on_accepted=None):
+        """Insert phase of the batched driving mode: log every message,
+        fire no rules. Returns the opaque plan for :meth:`ingest_cascade`.
+
+        ``on_accepted(msg, is_precommit)`` is invoked for each *accepted*
+        prevote/precommit — the hook the device vote grid uses to scatter
+        exactly the votes the host logs accepted (duplicates, equivocation,
+        and wrong-height messages never reach it), keeping grid and logs
+        byte-equivalent.
+        """
         commit_rounds = set()
         vote_rounds = set()
         for msg in msgs:
@@ -247,36 +264,93 @@ class Process:
             if t is Prevote:
                 if self._insert_prevote(msg):
                     vote_rounds.add(msg.round)
+                    if on_accepted is not None:
+                        on_accepted(msg, False)
             elif t is Precommit:
                 if self._insert_precommit(msg):
                     vote_rounds.add(msg.round)
                     commit_rounds.add(msg.round)
+                    if on_accepted is not None:
+                        on_accepted(msg, True)
             else:
                 if self._insert_propose(msg):
                     vote_rounds.add(msg.round)
                     commit_rounds.add(msg.round)
+        return (commit_rounds, vote_rounds)
+
+    def ingest_cascade(self, plan, tallies=None) -> None:
+        """Rule phase of the batched driving mode. With ``tallies`` (a
+        TallyView over the device vote grids), the quorum threshold checks
+        read the device counts; the host counters remain the fallback for
+        anything the grid doesn't cover (rounds beyond its slot window,
+        post-commit heights, value mismatches)."""
+        commit_rounds, vote_rounds = plan
         if not vote_rounds and not commit_rounds:
             return
-        # Commits first (progress beats round-skipping when both are
-        # enabled — each is a legal next transition); then the future-round
-        # skip; then the current-round cascade. The skip walks rounds
-        # highest-first and stops at the first that fires: the final round
-        # is the maximal qualifying one either way, and stopping there
-        # avoids scheduling timeouts for intermediate rounds the automaton
-        # would immediately leave.
-        for r in sorted(commit_rounds):
-            self._try_commit_upon_sufficient_precommits(r)
-        for r in sorted(vote_rounds, reverse=True):
-            before = self.state.current_round
-            self._try_skip_to_future_round(r)
-            if self.state.current_round != before:
-                break
-        self._try_precommit_upon_sufficient_prevotes()
-        self._try_precommit_nil_upon_sufficient_prevotes()
-        self._try_prevote_upon_propose()
-        self._try_prevote_upon_sufficient_prevotes()
-        self._try_timeout_precommit_upon_sufficient_precommits()
-        self._try_timeout_prevote_upon_sufficient_prevotes()
+        self._tally_source = tallies
+        try:
+            # Commits first (progress beats round-skipping when both are
+            # enabled — each is a legal next transition); then the
+            # future-round skip; then the current-round cascade. The skip
+            # walks rounds highest-first and stops at the first that fires:
+            # the final round is the maximal qualifying one either way, and
+            # stopping there avoids scheduling timeouts for intermediate
+            # rounds the automaton would immediately leave.
+            for r in sorted(commit_rounds):
+                self._try_commit_upon_sufficient_precommits(r)
+            for r in sorted(vote_rounds, reverse=True):
+                before = self.state.current_round
+                self._try_skip_to_future_round(r)
+                if self.state.current_round != before:
+                    break
+            self._try_precommit_upon_sufficient_prevotes()
+            self._try_precommit_nil_upon_sufficient_prevotes()
+            self._try_prevote_upon_propose()
+            self._try_prevote_upon_sufficient_prevotes()
+            self._try_timeout_precommit_upon_sufficient_precommits()
+            self._try_timeout_prevote_upon_sufficient_prevotes()
+        finally:
+            self._tally_source = None
+
+    # ------------------------------------------------------- tally sources
+
+    def _prevotes_for(self, round: Round, value: Value) -> int:
+        """Prevotes at ``round`` for ``value`` — from the device tally
+        source when one is installed and covers the query, else the O(1)
+        host counter. The source declines (returns None) whenever its
+        snapshot might not match the logs: different height (a commit
+        advanced us mid-cascade), uncovered round slot, or a target value
+        other than the one it tallied against."""
+        src = self._tally_source
+        if src is not None and src.height == self.state.current_height:
+            c = src.prevotes_for(round, value)
+            if c is not None:
+                return c
+        return self.state.count_prevotes_for(round, value)
+
+    def _precommits_for(self, round: Round, value: Value) -> int:
+        src = self._tally_source
+        if src is not None and src.height == self.state.current_height:
+            c = src.precommits_for(round, value)
+            if c is not None:
+                return c
+        return self.state.count_precommits_for(round, value)
+
+    def _prevote_total(self, round: Round) -> int:
+        src = self._tally_source
+        if src is not None and src.height == self.state.current_height:
+            c = src.prevote_total(round)
+            if c is not None:
+                return c
+        return len(self.state.prevote_logs.get(round, {}))
+
+    def _precommit_total(self, round: Round) -> int:
+        src = self._tally_source
+        if src is not None and src.height == self.state.current_height:
+            c = src.precommit_total(round)
+            if c is not None:
+                return c
+        return len(self.state.precommit_logs.get(round, {}))
 
     # --------------------------------------------------------------- control
 
@@ -443,9 +517,11 @@ class Process:
             self.state.current_round, False
         )
 
-        # O(1) tally lookup (the reference scans the round's votes here,
-        # process/process.go:486-491).
-        if self.state.count_prevotes_for(vr, propose.value) < 2 * self.f + 1:
+        # Device-or-host tally (the reference scans the round's votes here,
+        # process/process.go:486-491). Cross-round query: the vote grid
+        # answers it via its L28 lane (prevotes at vr vs the CURRENT
+        # round's proposal value).
+        if self._prevotes_for(vr, propose.value) < 2 * self.f + 1:
             return
 
         if self.broadcaster is not None:
@@ -472,10 +548,7 @@ class Process:
             return
         if self.state.current_step != Step.PREVOTING:
             return
-        if (
-            len(self.state.prevote_logs.get(self.state.current_round, {}))
-            >= 2 * self.f + 1
-        ):
+        if self._prevote_total(self.state.current_round) >= 2 * self.f + 1:
             if self.timer is not None:
                 self.timer.timeout_prevote(
                     self.state.current_height, self.state.current_round
@@ -506,9 +579,9 @@ class Process:
             return
         if not self.state.propose_is_valid.get(self.state.current_round, False):
             return
-        # O(1) tally lookup (reference scan: process/process.go:574-579).
+        # Device-or-host tally (reference scan: process/process.go:574-579).
         if (
-            self.state.count_prevotes_for(self.state.current_round, propose.value)
+            self._prevotes_for(self.state.current_round, propose.value)
             < 2 * self.f + 1
         ):
             return
@@ -543,9 +616,9 @@ class Process:
         (reference: process/process.go:622-643)."""
         if self.state.current_step != Step.PREVOTING:
             return
-        # O(1) tally lookup (reference scan: process/process.go:626-631).
+        # Device-or-host tally (reference scan: process/process.go:626-631).
         if (
-            self.state.count_prevotes_for(self.state.current_round, NIL_VALUE)
+            self._prevotes_for(self.state.current_round, NIL_VALUE)
             >= 2 * self.f + 1
         ):
             if self.broadcaster is not None:
@@ -574,10 +647,7 @@ class Process:
             OnceFlag.TIMEOUT_PRECOMMIT_UPON_SUFFICIENT_PRECOMMITS,
         ):
             return
-        if (
-            len(self.state.precommit_logs.get(self.state.current_round, {}))
-            >= 2 * self.f + 1
-        ):
+        if self._precommit_total(self.state.current_round) >= 2 * self.f + 1:
             if self.timer is not None:
                 self.timer.timeout_precommit(
                     self.state.current_height, self.state.current_round
@@ -597,8 +667,8 @@ class Process:
             return
         if not self.state.propose_is_valid.get(round, False):
             return
-        # O(1) tally lookup (reference scan: process/process.go:696-701).
-        if self.state.count_precommits_for(round, propose.value) < 2 * self.f + 1:
+        # Device-or-host tally (reference scan: process/process.go:696-701).
+        if self._precommits_for(round, propose.value) < 2 * self.f + 1:
             return
 
         new_f, new_scheduler = self.committer.commit(

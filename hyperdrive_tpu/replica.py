@@ -363,33 +363,55 @@ class Replica:
         are rejected by the Process's own height check, matching what the
         per-message consume loop would have dropped.
         """
+        if self.opts.batch_ingest:
+            # Single copy of the filter/accounting contract: the batched
+            # path is exactly insert + cascade with no tallies installed.
+            self.proc.ingest_cascade(self.ingest_insert_window(window, keep))
+            return
         verified = keep is not None
         allowed = self.procs_allowed
-        if self.opts.batch_ingest:
-            batch = [
-                msg
-                for j, msg in enumerate(window)
-                if (not verified or keep[j]) and msg.sender in allowed
-            ]
-            n_ok = len(batch)
-            self.proc.ingest(batch)
-        else:
-            n_ok = 0
-            for j, msg in enumerate(window):
-                if verified and not keep[j]:
-                    continue
-                if msg.sender not in allowed:
-                    continue
-                n_ok += 1
-                if isinstance(msg, Propose):
-                    self.proc.propose(msg)
-                elif isinstance(msg, Prevote):
-                    self.proc.prevote(msg)
-                else:
-                    self.proc.precommit(msg)
+        n_ok = 0
+        for j, msg in enumerate(window):
+            if verified and not keep[j]:
+                continue
+            if msg.sender not in allowed:
+                continue
+            n_ok += 1
+            if isinstance(msg, Propose):
+                self.proc.propose(msg)
+            elif isinstance(msg, Prevote):
+                self.proc.prevote(msg)
+            else:
+                self.proc.precommit(msg)
         if verified and self.tracer is not NULL_TRACER:
             self.tracer.count("replica.verify.accepted", n_ok)
             self.tracer.count("replica.verify.rejected", len(window) - n_ok)
+
+    def ingest_insert_window(self, window, keep=None, on_accepted=None):
+        """Phase 2a (device-tally mode): filter + insert only, no rules.
+
+        Same filtering as :meth:`dispatch_window`; accepted votes flow to
+        ``on_accepted`` so the driver can scatter them into the device vote
+        grid before the rule phase. Returns the plan for
+        :meth:`ingest_cascade_window`.
+        """
+        verified = keep is not None
+        allowed = self.procs_allowed
+        batch = [
+            msg
+            for j, msg in enumerate(window)
+            if (not verified or keep[j]) and msg.sender in allowed
+        ]
+        if verified and self.tracer is not NULL_TRACER:
+            self.tracer.count("replica.verify.accepted", len(batch))
+            self.tracer.count("replica.verify.rejected",
+                              len(window) - len(batch))
+        return self.proc.ingest_insert(batch, on_accepted)
+
+    def ingest_cascade_window(self, plan, tallies=None) -> None:
+        """Phase 2b (device-tally mode): run the rule cascade with the
+        device tally counts installed."""
+        self.proc.ingest_cascade(plan, tallies)
 
     def _filter_height(self, height: Height) -> bool:
         """Only current-or-future heights are kept

@@ -288,6 +288,94 @@ def test_burst_differential_modes_agree_and_replay_preserves_mode(tmp_path):
     assert replayed.heights == serial.heights
 
 
+def test_device_tally_matches_host_and_is_exercised():
+    # The north-star integration: quorum counts come from the device vote
+    # grid. CheckedTallyView raises on any device/host count mismatch, and
+    # the hit counter proves the cascade actually consumed device counts.
+    from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+
+    views = []
+
+    def check(view, proc):
+        v = CheckedTallyView(view, proc)
+        views.append(v)
+        return v
+
+    host = Simulation(n=7, target_height=5, seed=91, burst=True).run()
+    dev = Simulation(
+        n=7, target_height=5, seed=91, burst=True,
+        device_tally=True, tally_check=check,
+    ).run()
+    assert host.completed and dev.completed
+    dev.assert_safety()
+    assert dev.commits == host.commits
+    assert dev.heights == host.heights
+    assert dev.steps == host.steps
+    assert sum(v.hits for v in views) > 0, "device counts never consulted"
+
+
+def test_device_tally_adversarial_differential():
+    # Timeout rounds (offline proposers), reorder, and a mid-run kill push
+    # the grid through resets, nil quorums, and round slots > 0 — every
+    # count still checked equal to the host counters.
+    from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+
+    kw = dict(n=10, target_height=8, seed=67, burst=True, reorder=True,
+              offline={8, 9}, kill_at_step={7: 400})
+    host = Simulation(**kw).run()
+    dev = Simulation(
+        **kw, device_tally=True, tally_check=CheckedTallyView
+    ).run()
+    assert host.completed and dev.completed
+    dev.assert_safety()
+    assert dev.commits == host.commits
+
+
+def test_device_tally_negative_round_vote_is_not_scattered():
+    # Regression: vote inserts (unlike propose inserts) accept negative
+    # rounds, and a slot of -1 flattens into the PREVIOUS plane's last
+    # slot (e.g. replica 1's round -1 prevote lands in replica 0's
+    # precommit slot R-1) — a phantom vote that could tip a quorum.
+    import numpy as np
+
+    from hyperdrive_tpu.messages import Prevote
+    from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+
+    sim = Simulation(n=4, target_height=3, seed=111, burst=True,
+                     device_tally=True, tally_check=CheckedTallyView)
+    sim.replicas[1].handle(
+        Prevote(height=1, round=-1, value=b"\x77" * 32,
+                sender=sim.signatories[3])
+    )
+    sim._settle()
+    # The host log accepted the vote (parity with the reference's inserts,
+    # which height-check but not round-check votes)...
+    assert -1 in sim.replicas[1].proc.state.prevote_logs
+    # ...but nothing was scattered: the device grid holds no vote at all,
+    # phantom or otherwise.
+    assert np.asarray(sim.vote_grid._present).sum() == 0
+
+
+def test_device_tally_signed_full_pipeline(tmp_path):
+    # Signatures + aggregated verification + device tallies: the grid only
+    # sees verified survivors (fused behind the verification mask). The
+    # record replays bit-identically WITHOUT a grid, because device counts
+    # equal host counts wherever they are used.
+    from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+
+    dev = Simulation(
+        n=4, target_height=4, seed=71, sign=True, burst=True,
+        device_tally=True, tally_check=CheckedTallyView,
+    ).run()
+    assert dev.completed
+    dev.assert_safety()
+    path = os.path.join(tmp_path, "devtally.dump")
+    dev.record.dump(path)
+    replayed = Simulation.replay(ScenarioRecord.load(path), sign=True)
+    assert replayed.commits == dev.commits
+    assert replayed.heights == dev.heights
+
+
 def test_burst_signed_with_tpu_batch_verifier():
     # The full BASELINE config-4 pipeline at miniature scale: a signed
     # burst-mode network whose aggregated windows are verified by the

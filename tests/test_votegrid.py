@@ -1,0 +1,192 @@
+"""Device-resident vote grids: scatter/tally kernel + TallyView semantics.
+
+The grid must be an exact device image of the host vote logs: counts equal
+hand-counted quorums, resets wipe exactly one replica, state accumulates
+across launches, and the TallyView declines every query the launch didn't
+provably answer.
+"""
+
+import numpy as np
+import pytest
+
+from hyperdrive_tpu.ops.votegrid import (
+    PRECOMMIT_PLANE,
+    PREVOTE_PLANE,
+    TallyView,
+    VoteGrid,
+)
+from hyperdrive_tpu.types import NIL_VALUE
+
+
+def words(value: bytes) -> np.ndarray:
+    return np.frombuffer(value, dtype="<i4").astype(np.int32)
+
+
+V_A = b"\xaa" * 32
+V_B = b"\xbb" * 32
+
+
+def launch(grid, rows, n, *, reset=None, targets=None, l28=None, f=1):
+    """rows: list of (rep, plane, slot, val, value_bytes)."""
+    idx = np.array([r[:4] for r in rows], dtype=np.int32).reshape(-1, 4)
+    w = (
+        np.stack([words(r[4]) for r in rows])
+        if rows
+        else np.zeros((0, 8), dtype=np.int32)
+    )
+    R = grid.R
+    tv = np.zeros((n, R), dtype=bool)
+    tg = np.zeros((n, R, 8), dtype=np.int32)
+    for rep, rnd, val in targets or ():
+        tg[rep, rnd] = words(val)
+        tv[rep, rnd] = True
+    l28_slot = np.full(n, -1, dtype=np.int32)
+    l28_target = np.zeros((n, 8), dtype=np.int32)
+    for rep, rnd, val in l28 or ():
+        l28_slot[rep] = rnd
+        l28_target[rep] = words(val)
+    return grid.update_and_tally(
+        idx,
+        w,
+        np.asarray(reset if reset is not None else np.zeros(n, dtype=bool)),
+        tg,
+        tv,
+        l28_slot,
+        l28_target,
+        np.full(n, f, dtype=np.int32),
+    )
+
+
+def test_counts_match_hand_tally():
+    n, V = 3, 7
+    grid = VoteGrid(n, V, r_slots=4, buckets=(16,))
+    rows = [
+        # Replica 0, prevotes round 0: 5 for A, 1 nil, 1 for B.
+        *[(0, PREVOTE_PLANE, 0, v, V_A) for v in range(5)],
+        (0, PREVOTE_PLANE, 0, 5, NIL_VALUE),
+        (0, PREVOTE_PLANE, 0, 6, V_B),
+        # Replica 0, precommits round 0: 3 for A.
+        *[(0, PRECOMMIT_PLANE, 0, v, V_A) for v in range(3)],
+        # Replica 2, prevotes round 1: 2 nil.
+        (2, PREVOTE_PLANE, 1, 0, NIL_VALUE),
+        (2, PREVOTE_PLANE, 1, 1, NIL_VALUE),
+    ]
+    counts = launch(
+        grid, rows, n, targets=[(0, 0, V_A), (2, 1, V_A)], f=2
+    )
+    assert counts["matching"][0, PREVOTE_PLANE, 0] == 5
+    assert counts["nil"][0, PREVOTE_PLANE, 0] == 1
+    assert counts["total"][0, PREVOTE_PLANE, 0] == 7
+    assert counts["matching"][0, PRECOMMIT_PLANE, 0] == 3
+    assert counts["total"][0, PRECOMMIT_PLANE, 0] == 3
+    assert counts["nil"][2, PREVOTE_PLANE, 1] == 2
+    assert counts["matching"][2, PREVOTE_PLANE, 1] == 0
+    # Quorum at f=2 needs 5.
+    assert bool(counts["quorum_matching"][0, PREVOTE_PLANE, 0])
+    assert bool(counts["quorum_any"][0, PREVOTE_PLANE, 0])
+    assert not bool(counts["quorum_matching"][0, PRECOMMIT_PLANE, 0])
+    # Untouched replica 1 is all zeros.
+    assert counts["total"][1].sum() == 0
+
+
+def test_accumulation_and_reset():
+    n, V = 2, 5
+    grid = VoteGrid(n, V, r_slots=2, buckets=(8,))
+    launch(grid, [(0, PREVOTE_PLANE, 0, 0, V_A)], n, targets=[(0, 0, V_A)])
+    launch(grid, [(0, PREVOTE_PLANE, 0, 1, V_A)], n, targets=[(0, 0, V_A)])
+    counts = launch(
+        grid,
+        [(1, PREVOTE_PLANE, 0, 2, V_A)],
+        n,
+        targets=[(0, 0, V_A), (1, 0, V_A)],
+    )
+    # Replica 0 accumulated both earlier launches; replica 1 only its own.
+    assert counts["matching"][0, PREVOTE_PLANE, 0] == 2
+    assert counts["matching"][1, PREVOTE_PLANE, 0] == 1
+    # Reset replica 0 (height advanced): its planes wipe, replica 1 keeps.
+    reset = np.array([True, False])
+    counts = launch(
+        grid, [], n, reset=reset, targets=[(0, 0, V_A), (1, 0, V_A)]
+    )
+    assert counts["total"][0].sum() == 0
+    assert counts["matching"][1, PREVOTE_PLANE, 0] == 1
+    # Re-scatter after reset starts fresh.
+    counts = launch(
+        grid, [(0, PREVOTE_PLANE, 0, 4, V_B)], n, targets=[(0, 0, V_B)]
+    )
+    assert counts["matching"][0, PREVOTE_PLANE, 0] == 1
+    assert counts["total"][0, PREVOTE_PLANE, 0] == 1
+
+
+def test_l28_cross_round_lane():
+    n, V = 1, 5
+    grid = VoteGrid(n, V, r_slots=4, buckets=(8,))
+    # Prevotes for A at round 0; round 2's proposal re-proposes A with
+    # valid_round 0 — the L28 query is "prevotes at round 0 matching A".
+    rows = [(0, PREVOTE_PLANE, 0, v, V_A) for v in range(3)]
+    counts = launch(
+        grid, rows, n, targets=[(0, 0, V_B)], l28=[(0, 0, V_A)], f=1
+    )
+    # Per-round target (B) doesn't match the A prevotes...
+    assert counts["matching"][0, PREVOTE_PLANE, 0] == 0
+    # ...but the L28 lane counts them against A.
+    assert counts["l28"][0] == 3
+    assert bool(counts["l28_quorum"][0])
+
+
+def test_empty_launch_and_bucket_padding():
+    grid = VoteGrid(2, 3, r_slots=2, buckets=(4,))
+    counts = launch(grid, [], 2)
+    assert counts["total"].sum() == 0
+    # 5 rows > bucket 4: next multiple is used, all rows land.
+    rows = [(0, PREVOTE_PLANE, 0, v % 3, V_A) for v in range(3)]
+    rows += [(1, PREVOTE_PLANE, 1, v, V_A) for v in range(2)]
+    counts = launch(grid, rows, 2, targets=[(0, 0, V_A), (1, 1, V_A)])
+    assert counts["total"][0, PREVOTE_PLANE, 0] == 3
+    assert counts["total"][1, PREVOTE_PLANE, 1] == 2
+
+
+def make_view(counts, rep=0, height=1, R=4, targets=None, l28_round=-1,
+              l28_value=b"", dirty=frozenset()):
+    return TallyView(rep, height, counts, R, targets or {}, l28_round,
+                     l28_value, dirty)
+
+
+def test_view_answers_and_declines():
+    n, V = 1, 5
+    grid = VoteGrid(n, V, r_slots=4, buckets=(8,))
+    rows = [(0, PREVOTE_PLANE, 0, v, V_A) for v in range(3)]
+    rows += [(0, PRECOMMIT_PLANE, 0, v, V_A) for v in range(2)]
+    rows += [(0, PREVOTE_PLANE, 1, 0, NIL_VALUE)]
+    counts = launch(grid, rows, n, targets=[(0, 0, V_A)])
+    view = make_view(counts, targets={0: V_A})
+
+    assert view.prevotes_for(0, V_A) == 3
+    assert view.precommits_for(0, V_A) == 2
+    assert view.prevote_total(0) == 3
+    assert view.precommit_total(0) == 2
+    assert view.prevotes_for(1, NIL_VALUE) == 1
+    # Declines: target value the launch never compared against.
+    assert view.prevotes_for(0, V_B) is None
+    # Declines: round outside the slot window.
+    assert view.prevotes_for(99, V_A) is None
+    assert view.precommit_total(99) is None
+    # Declines: dirty (plane, round).
+    dirty_view = make_view(
+        counts, targets={0: V_A}, dirty={(PREVOTE_PLANE, 0)}
+    )
+    assert dirty_view.prevotes_for(0, V_A) is None
+    assert dirty_view.prevote_total(0) is None
+    # The other plane of the same round is unaffected.
+    assert dirty_view.precommits_for(0, V_A) == 2
+
+
+def test_view_l28_lane_requires_exact_pair():
+    n = 1
+    grid = VoteGrid(n, 4, r_slots=4, buckets=(8,))
+    rows = [(0, PREVOTE_PLANE, 1, v, V_A) for v in range(2)]
+    counts = launch(grid, rows, n, l28=[(0, 1, V_A)])
+    view = make_view(counts, l28_round=1, l28_value=V_A)
+    assert view.prevotes_for(1, V_A) == 2  # via the L28 lane
+    assert view.prevotes_for(2, V_A) is None  # wrong round
+    assert view.prevotes_for(1, V_B) is None  # wrong value
