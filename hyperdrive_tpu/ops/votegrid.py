@@ -122,7 +122,8 @@ class TallyView:
 
 
 def _kernel(values, present, reset, idx, words, valid,
-            targets, target_valid, l28_slot, l28_target, f):
+            targets, target_valid, l28_slot, l28_target, f,
+            axis_name=None):
     """One fused scatter + tally step.
 
     values [n,2,R,V,8] i32 (donated), present [n,2,R,V] bool (donated),
@@ -133,8 +134,21 @@ def _kernel(values, present, reset, idx, words, valid,
     l28_slot [n] i32 (valid-round slot for the L28 cross-round count, or
     -1), l28_target [n,8] i32 (the *current* round's proposal value),
     f [n] i32.
+
+    Sharded mode (``axis_name`` set, running under ``shard_map``): the
+    validator axis V is the local shard; scatter rows carry GLOBAL
+    validator indices, each shard claims only its own range, and the
+    partial counts combine with one ``psum`` over the axis — the
+    vote-exchange collective rides the ICI ring, the host never sees
+    per-validator state.
     """
     n, _, R, V, _ = values.shape
+
+    if axis_name is not None:
+        offset = jax.lax.axis_index(axis_name).astype(jnp.int32) * V
+        vloc = idx[:, 3] - offset
+        valid = valid & (vloc >= 0) & (vloc < V)
+        idx = jnp.concatenate([idx[:, :3], vloc[:, None]], axis=1)
 
     keep = ~reset[:, None, None, None]
     present = present & keep
@@ -169,6 +183,12 @@ def _kernel(values, present, reset, idx, words, valid,
         & slot_ok[:, :, None]
     )
     l28 = jnp.sum(eq28, axis=(1, 2), dtype=jnp.int32)  # [n]
+
+    if axis_name is not None:
+        matching = jax.lax.psum(matching, axis_name)
+        nil = jax.lax.psum(nil, axis_name)
+        total = jax.lax.psum(total, axis_name)
+        l28 = jax.lax.psum(l28, axis_name)
 
     q = (2 * f + 1)[:, None, None]
     counts = {
@@ -250,20 +270,60 @@ class VoteGrid:
     """
 
     def __init__(self, n_replicas: int, n_validators: int, r_slots: int = 8,
-                 buckets: tuple = (256, 1024, 4096, 16384)):
+                 buckets: tuple = (256, 1024, 4096, 16384),
+                 mesh=None, val_axis: str = "val"):
         self.n = n_replicas
         self.V = n_validators
         self.R = r_slots
         self.buckets = tuple(sorted(buckets))
-        self._values = jnp.zeros(
-            (n_replicas, 2, r_slots, n_validators, 8), dtype=jnp.int32
-        )
-        self._present = jnp.zeros(
-            (n_replicas, 2, r_slots, n_validators), dtype=bool
-        )
-        # Donating the grid buffers keeps the accumulated state device-
-        # resident: each call consumes the previous arrays in place.
-        self._fn = jax.jit(_kernel, donate_argnums=(0, 1))
+        shape_v = (n_replicas, 2, r_slots, n_validators, 8)
+        shape_p = (n_replicas, 2, r_slots, n_validators)
+        if mesh is None:
+            self._values = jnp.zeros(shape_v, dtype=jnp.int32)
+            self._present = jnp.zeros(shape_p, dtype=bool)
+            self._fn = jax.jit(_kernel, donate_argnums=(0, 1))
+        else:
+            # Multi-chip: the validator axis shards over `val_axis`; each
+            # chip owns its validators' grid lanes, scatter rows route by
+            # global index, counts psum over the ICI ring. Everything else
+            # (reset masks, targets, counts) is replicated — it is tiny.
+            from functools import partial
+
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            d = mesh.shape[val_axis]
+            if n_validators % d:
+                raise ValueError(
+                    f"validators ({n_validators}) must divide evenly over "
+                    f"the '{val_axis}' axis ({d} devices)"
+                )
+            spec_v = P(None, None, None, val_axis, None)
+            spec_p = P(None, None, None, val_axis)
+            self._values = jax.device_put(
+                jnp.zeros(shape_v, dtype=jnp.int32),
+                NamedSharding(mesh, spec_v),
+            )
+            self._present = jax.device_put(
+                jnp.zeros(shape_p, dtype=bool), NamedSharding(mesh, spec_p)
+            )
+            rep = P()
+            sharded = jax.shard_map(
+                partial(_kernel, axis_name=val_axis),
+                mesh=mesh,
+                in_specs=(spec_v, spec_p, rep, rep, rep, rep, rep, rep,
+                          rep, rep, rep),
+                out_specs=(
+                    spec_v,
+                    spec_p,
+                    {k: rep for k in (
+                        "matching", "nil", "total", "l28",
+                        "quorum_matching", "quorum_nil", "quorum_any",
+                        "l28_quorum",
+                    )},
+                ),
+                check_vma=False,
+            )
+            self._fn = jax.jit(sharded, donate_argnums=(0, 1))
 
     def bucket_for(self, k: int) -> int:
         return bucketing.bucket_for(k, self.buckets)
