@@ -296,7 +296,8 @@ class Simulation:
         # deterministic and replay-identical.
         from hyperdrive_tpu.utils import Tracer
 
-        self.tracer = Tracer(time_fn=lambda: self.clock.now)
+        # The sim is single-threaded; skip the tracer's per-call locking.
+        self.tracer = Tracer(time_fn=lambda: self.clock.now, threadsafe=False)
         # The delivery queue is consumed via a head index (O(1) per step;
         # list.pop(0) would make 256-replica x 10k-height runs quadratic).
         self.queue: list[tuple[int, object]] = []
@@ -673,7 +674,18 @@ class Simulation:
                             self._pending_replicas.discard(victim)
                         del self.kill_at_step[victim]
 
+            # Group VOTE deliveries per replica (global record order
+            # unchanged; within-replica order preserved — vote buffering
+            # is state-invisible until settle) so each replica buffers its
+            # slice in one handle_burst pass instead of per-message calls.
+            # Timeouts and resets are NOT state-invisible — a timeout
+            # handler reads the virtual clock (follow-up timers schedule at
+            # clock.now) and can broadcast — so they process inline at
+            # their delivery clock point, after flushing that replica's
+            # accumulated votes to keep its per-message order.
             delivered = 0
+            per_replica: dict[int, list] = {}
+            record_messages = self.record.messages
             for to, msg in batch:
                 steps += 1
                 if self.drop_rate and not isinstance(msg, Timeout):
@@ -683,9 +695,21 @@ class Simulation:
                     continue
                 if self.delivery_cost:
                     self.clock.now += self.delivery_cost
-                self.record.messages.append((to, msg))
-                self.replicas[to].handle(msg)  # buffers only: external_flush
+                record_messages.append((to, msg))
+                t = type(msg)
+                if t is Propose or t is Prevote or t is Precommit:
+                    lst = per_replica.get(to)
+                    if lst is None:
+                        lst = per_replica[to] = []
+                    lst.append(msg)
+                else:
+                    lst = per_replica.pop(to, None)
+                    if lst:
+                        self.replicas[to].handle_burst(lst)
+                    self.replicas[to].handle(msg)
                 delivered += 1
+            for to, msgs in per_replica.items():
+                self.replicas[to].handle_burst(msgs)
             self.record.bursts.append(delivered)
             self._settle()
 

@@ -108,11 +108,20 @@ class MessageQueue:
     def insert_precommit(self, precommit: Precommit) -> None:
         self._insert(precommit)
 
+    def order_of(self, sender: Signatory) -> int:
+        """Stable per-sender tie-break index, registered on first use.
+        Shared with the replica's burst fast lane so lane and queue
+        messages from one sender sort under one identity."""
+        o = self._order.get(sender)
+        if o is None:
+            o = self._order[sender] = len(self._order)
+        return o
+
     def _insert(self, msg: Message) -> None:
         q = self._queues.get(msg.sender)
         if q is None:
             q = self._queues[msg.sender] = []
-            self._order[msg.sender] = len(self._order)
+            self.order_of(msg.sender)
         # Fast path: consensus traffic arrives overwhelmingly in ascending
         # (height, round) order, so most inserts are appends — skip the
         # binary search (and its per-probe key lambda) entirely.
@@ -231,6 +240,42 @@ class MessageQueue:
             del q[:i]
             self._register_head(sender)
         return out
+
+    def drain_all(self, height: Height) -> list[Message]:
+        """Pop EVERY eligible message (height <= ``height``) in the same
+        global ascending (height, round) order as :meth:`drain_window`.
+
+        The burst drain: one settle pass takes a replica's whole backlog,
+        so per-message heap maintenance is pure overhead — this does one
+        scan over the sender queues plus one C-level sort of the eligible
+        runs (timsort exploits the per-sender sortedness), which profiles
+        several times faster than the k-way merge at superstep batch sizes.
+        """
+        runs: list[tuple[int, list[Message]]] = []
+        for sender, q in self._queues.items():
+            if not q or q[0].height > height:
+                continue
+            i = 0
+            while i < len(q) and q[i].height <= height:
+                i += 1
+            runs.append((self._order[sender], q[:i]))
+            del q[:i]
+            self._register_head(sender)
+        if not runs:
+            return []
+        if len(runs) == 1:
+            return runs[0][1]
+        # (h, r, sender-order, run-seq) is unique per message, so the bare
+        # tuple sort never falls through to comparing messages, and it
+        # reproduces drain_window's contract exactly: global (h, r) order,
+        # FIFO within a sender, senders tie-broken by creation order.
+        keyed = [
+            (m.height, m.round, order, j, m)
+            for order, run in runs
+            for j, m in enumerate(run)
+        ]
+        keyed.sort()
+        return [t[4] for t in keyed]
 
     # -------------------------------------------------------------------- drop
 

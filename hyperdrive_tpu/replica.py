@@ -163,6 +163,15 @@ class Replica:
         self._handling = False
         self._pending: deque = deque()
         self._last_commit_time: Optional[float] = None
+        # Burst fast lane (external_flush only): votes for the CURRENT
+        # height skip the sorted queue entirely — the next settle drains
+        # everything anyway, so sorted insertion + head-heap maintenance
+        # is pure overhead for them. drain_pending merges lane and queue
+        # under the same (height, round, sender, arrival) ordering the
+        # queue drain guarantees. Per-sender capacity mirrors the queue's
+        # bound so a current-height flood cannot bypass DoS limits.
+        self._lane: list = []
+        self._lane_counts: dict = {}
 
     # --------------------------------------------------------- observability
 
@@ -254,6 +263,67 @@ class Replica:
         finally:
             self._handling = False
 
+    def handle_burst(self, msgs) -> None:
+        """Buffer one superstep's deliveries in a single pass.
+
+        Semantically identical to calling :meth:`handle` per message in
+        ``external_flush`` mode (votes buffer to the fast lane or queue;
+        timeouts and resets take the full path), with the per-message
+        wrapper costs — reentrancy deque, per-message tracer calls —
+        amortized over the batch. Only valid with ``external_flush=True``:
+        without an external settle driver nothing drains the fast lane,
+        so misuse would silently strand messages.
+        """
+        if not self.opts.external_flush:
+            raise RuntimeError(
+                "handle_burst requires external_flush=True (burst driving); "
+                "use handle() in self-flushing modes"
+            )
+        lane = self._lane
+        counts = self._lane_counts
+        cap = self.opts.max_capacity
+        cur = self.proc.current_height
+        dh = self.did_handle_message
+        n_pv = n_pc = n_pp = 0
+        for msg in msgs:
+            t = type(msg)
+            if t is Prevote or t is Precommit or t is Propose:
+                if t is Prevote:
+                    n_pv += 1
+                elif t is Precommit:
+                    n_pc += 1
+                else:
+                    n_pp += 1
+                h = msg.height
+                if h >= cur:
+                    if h == cur:
+                        c = counts.get(msg.sender, 0)
+                        if c < cap:
+                            counts[msg.sender] = c + 1
+                            lane.append(msg)
+                    elif t is Prevote:
+                        self.mq.insert_prevote(msg)
+                    elif t is Precommit:
+                        self.mq.insert_precommit(msg)
+                    else:
+                        self.mq.insert_propose(msg)
+                if dh is not None:
+                    dh()
+            else:
+                # Timeouts / ResetHeight: the full path (may move the
+                # height); counted there, did_handle_message called there.
+                self.handle(msg)
+                cur = self.proc.current_height
+                counts = self._lane_counts
+                lane = self._lane
+        if self.tracer is not NULL_TRACER:
+            if n_pv:
+                self.tracer.count("replica.msg.prevote", n_pv)
+            if n_pc:
+                self.tracer.count("replica.msg.precommit", n_pc)
+            if n_pp:
+                self.tracer.count("replica.msg.propose", n_pp)
+
     def _handle_one(self, msg) -> None:
         if self.tracer is not NULL_TRACER:
             self.tracer.count(
@@ -269,18 +339,23 @@ class Replica:
                     self.proc.on_timeout_precommit(msg.height, msg.round)
                 else:
                     return
-            elif isinstance(msg, Propose):
-                if not self._filter_height(msg.height):
+            elif isinstance(msg, (Propose, Prevote, Precommit)):
+                h = msg.height
+                cur = self.proc.current_height
+                if h < cur:
                     return
-                self.mq.insert_propose(msg)
-            elif isinstance(msg, Prevote):
-                if not self._filter_height(msg.height):
+                if h == cur and self.opts.external_flush:
+                    c = self._lane_counts.get(msg.sender, 0)
+                    if c < self.opts.max_capacity:
+                        self._lane_counts[msg.sender] = c + 1
+                        self._lane.append(msg)
                     return
-                self.mq.insert_prevote(msg)
-            elif isinstance(msg, Precommit):
-                if not self._filter_height(msg.height):
-                    return
-                self.mq.insert_precommit(msg)
+                if isinstance(msg, Propose):
+                    self.mq.insert_propose(msg)
+                elif isinstance(msg, Prevote):
+                    self.mq.insert_prevote(msg)
+                else:
+                    self.mq.insert_precommit(msg)
             elif isinstance(msg, ResetHeight):
                 self.logger.info(
                     "reset height %s",
@@ -292,6 +367,10 @@ class Replica:
                 )
                 self.proc.state = State.default_with_height(msg.height)
                 self.mq.drop_messages_below_height(msg.height)
+                # Lane messages were for the pre-reset current height,
+                # which is below the resync target by contract.
+                self._lane.clear()
+                self._lane_counts.clear()
                 if msg.signatories:
                     sigs = list(msg.signatories)
                     self.proc.start_with_new_signatories(
@@ -348,10 +427,46 @@ class Replica:
     # (reference: replica/replica.go:251-264) at the network level.
 
     def drain_pending(self) -> list:
-        """Phase 1: pop this replica's eligible window without dispatching."""
-        return self.mq.drain_window(
-            self.proc.current_height, self.opts.verify_window
-        )
+        """Phase 1: pop this replica's eligible window without dispatching.
+
+        Uncapped: a settle pass wants the whole backlog in one aggregated
+        launch (the verifier and vote grid chunk/bucket internally), and
+        the uncapped drain skips the k-way merge's per-message heap work.
+        ``verify_window`` still caps the incremental per-message flush path
+        (:meth:`_flush`), where windows must stay small for latency.
+
+        The window merges the queue backlog (messages buffered while their
+        height was in the future) with the current-height fast lane, under
+        the queue drain's exact ordering contract: global ascending
+        (height, round), FIFO within a sender (backlog entries predate lane
+        entries by construction), senders tie-broken by registration order.
+        """
+        cur = self.proc.current_height
+        backlog = self.mq.drain_all(cur)
+        lane = self._lane
+        if not lane:
+            return backlog
+        self._lane = []
+        self._lane_counts = {}
+        order_of = self.mq.order_of
+        if not backlog:
+            # Lane-only: every message is at the current height.
+            keyed = [
+                (m.round, order_of(m.sender), j, m)
+                for j, m in enumerate(lane)
+            ]
+            keyed.sort()
+            return [t[3] for t in keyed]
+        keyed = [
+            (m.height, m.round, order_of(m.sender), 0, j, m)
+            for j, m in enumerate(backlog)
+        ]
+        keyed += [
+            (m.height, m.round, order_of(m.sender), 1, j, m)
+            for j, m in enumerate(lane)
+        ]
+        keyed.sort()
+        return [t[5] for t in keyed]
 
     def dispatch_window(self, window, keep=None) -> None:
         """Phase 2: feed the verified survivors of ``window`` to the Process.

@@ -141,26 +141,42 @@ class State:
         mutating anything) if one exists — the caller decides whether that
         is a duplicate or equivocation — else None after inserting.
         """
-        votes = self.prevote_logs.setdefault(prevote.round, {})
+        rnd = prevote.round
+        votes = self.prevote_logs.get(rnd)
+        if votes is None:
+            votes = self.prevote_logs[rnd] = {}
         existing = votes.get(prevote.sender)
         if existing is not None:
             return existing
         votes[prevote.sender] = prevote
-        counts = self.prevote_counts.setdefault(prevote.round, {})
+        counts = self.prevote_counts.get(rnd)
+        if counts is None:
+            counts = self.prevote_counts[rnd] = {}
         counts[prevote.value] = counts.get(prevote.value, 0) + 1
-        self.trace_logs.setdefault(prevote.round, set()).add(prevote.sender)
+        trace = self.trace_logs.get(rnd)
+        if trace is None:
+            trace = self.trace_logs[rnd] = set()
+        trace.add(prevote.sender)
         return None
 
     def add_precommit(self, precommit: Precommit):
         """Log a precommit; same contract as :meth:`add_prevote`."""
-        votes = self.precommit_logs.setdefault(precommit.round, {})
+        rnd = precommit.round
+        votes = self.precommit_logs.get(rnd)
+        if votes is None:
+            votes = self.precommit_logs[rnd] = {}
         existing = votes.get(precommit.sender)
         if existing is not None:
             return existing
         votes[precommit.sender] = precommit
-        counts = self.precommit_counts.setdefault(precommit.round, {})
+        counts = self.precommit_counts.get(rnd)
+        if counts is None:
+            counts = self.precommit_counts[rnd] = {}
         counts[precommit.value] = counts.get(precommit.value, 0) + 1
-        self.trace_logs.setdefault(precommit.round, set()).add(precommit.sender)
+        trace = self.trace_logs.get(rnd)
+        if trace is None:
+            trace = self.trace_logs[rnd] = set()
+        trace.add(precommit.sender)
         return None
 
     def count_prevotes_for(self, round: int, value: bytes) -> int:

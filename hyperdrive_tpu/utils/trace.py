@@ -94,25 +94,43 @@ class Tracer:
     virtual clock so traces are deterministic.
     """
 
-    def __init__(self, time_fn: Optional[Callable[[], float]] = None):
+    def __init__(self, time_fn: Optional[Callable[[], float]] = None,
+                 threadsafe: bool = True):
         self.counters: dict[str, Counter] = {}
         self.histograms: dict[str, Histogram] = {}
         self._time = time_fn or time.perf_counter
         # One tracer is typically shared by many replicas, and replicas may
-        # run on their own threads (Replica.run): all updates lock.
-        self._lock = threading.Lock()
+        # run on their own threads (Replica.run): updates lock by default.
+        # A single-threaded driver (the simulator) passes threadsafe=False:
+        # the per-call lock acquisition is the dominant cost of counting on
+        # the hot path, and the GIL already serializes one-thread use.
+        self._lock = threading.Lock() if threadsafe else None
 
     # ------------------------------------------------------------- recording
 
     def count(self, name: str, n: int = 1) -> None:
-        with self._lock:
+        lock = self._lock
+        if lock is None:
+            c = self.counters.get(name)
+            if c is None:
+                c = self.counters[name] = Counter()
+            c.inc(n)
+            return
+        with lock:
             c = self.counters.get(name)
             if c is None:
                 c = self.counters[name] = Counter()
             c.inc(n)
 
     def observe(self, name: str, v: float) -> None:
-        with self._lock:
+        lock = self._lock
+        if lock is None:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = Histogram()
+            h.observe(v)
+            return
+        with lock:
             h = self.histograms.get(name)
             if h is None:
                 h = self.histograms[name] = Histogram()
