@@ -179,10 +179,12 @@ def config_4() -> dict:
           work of a deployment where every validator owns a chip;
       (b) redundant run, 20 heights: the single chip re-verifies every
           broadcast for all 256 receivers (256x the per-chip load);
-      (c) the 512-signature round window through the native host path and
-          the device path, plus the adaptive router's measured crossover —
-          the latency half of the north star. Medians over 48 reps per
-          backend, call order rotated per rep.
+      (c) the 512-signature round window: 48 PAIRED host/routed reps
+          (leg order alternating, no device launches inside the loop) for
+          the router-overhead comparison, a separate 16-rep device-only
+          loop for the device latency, and an 8-rep paired loop at a
+          4096-signature storm where the router must beat the host by
+          taking the device — the latency half of the north star.
     """
     import numpy as np
     import jax
@@ -208,7 +210,8 @@ def config_4() -> dict:
     )
 
     # (c) one round window (2 phases x 256 votes = 512 signatures):
-    # native host batch vs device launch, medians over 16 reps.
+    # methodology per the docstring — paired host/routed reps, separate
+    # device-only loop, then the 4096 storm.
     ring = KeyRing.deterministic(256, namespace=b"bench4")
     value = b"\x2a" * 32
     round_items = []
@@ -224,39 +227,67 @@ def config_4() -> dict:
     adaptive = AdaptiveVerifier(device=ver, host=hv)
     adaptive.verify_signatures(round_items)  # triggers calibration
 
-    # Routed latency is MEASURED through the adaptive router, interleaved
-    # with the host and device baselines in the same loop so clock drift
-    # and cache state affect all three alike. The call ORDER rotates per
-    # rep: a fixed order systematically biases whichever backend runs
-    # after the device launch (cache/allocator state), which is enough to
-    # flip a sub-1% comparison.
-    host_times, dev_times, routed_times = [], [], []
+    # The routed-vs-host comparison is PAIRED per rep (median of per-rep
+    # differences cancels common-mode drift) and runs with NO device
+    # launches inside the loop: below the crossover the router never
+    # touches the device, and interleaving unrelated device RPCs was
+    # measured to tax whichever leg follows them by ~1ms on this
+    # single-core host — contaminating exactly the sub-1% comparison the
+    # paired loop exists to make. The device's own 512-window latency is
+    # characterized in a separate loop below.
+    #
+    # Both comparisons presuppose the calibrated crossover lies in
+    # (512, 4096]: then the 512 window routes to the host (device-free
+    # paired loop) and the 4096 storm routes to the device. Calibration is
+    # machine-dependent, so the premise is checked and RECORDED — if it
+    # fails, routed_beats_pure_host reports False rather than publishing a
+    # comparison whose legs did not measure what the names claim.
+    def paired_reps(items, n_reps):
+        host_t: list = []
+        routed_t: list = []
+        for rep in range(n_reps):
+            legs = (
+                [(hv, host_t), (adaptive, routed_t)]
+                if rep % 2
+                else [(adaptive, routed_t), (hv, host_t)]
+            )
+            for backend, sink in legs:
+                t0 = time.perf_counter()
+                backend.verify_signatures(items)
+                sink.append(time.perf_counter() - t0)
+        return np.array(host_t), np.array(routed_t)
 
-    def run_host():
-        t0 = time.perf_counter()
-        hv.verify_signatures(round_items)
-        host_times.append(time.perf_counter() - t0)
+    crossover_premise_ok = 512 < adaptive.crossover <= 4096
 
-    def run_dev():
+    host_times, routed_times = paired_reps(round_items, 48)
+    p50_host = float(np.median(host_times))
+    p50_routed = float(np.median(routed_times))
+    paired_diff_512 = float(np.median(routed_times - host_times))
+
+    dev_times = []
+    for _ in range(16):
         t0 = time.perf_counter()
         ver.verify_signatures(round_items)
         dev_times.append(time.perf_counter() - t0)
-
-    def run_routed():
-        t0 = time.perf_counter()
-        adaptive.verify_signatures(round_items)
-        routed_times.append(time.perf_counter() - t0)
-
-    legs = [run_host, run_dev, run_routed]
-    for rep in range(48):
-        for k in range(3):
-            legs[(rep + k) % 3]()
-    p50_host = float(np.median(host_times))
     p50_dev = float(np.median(dev_times))
-    p50_routed = float(np.median(routed_times))
+
+    # Second latency point, above the crossover: a 4096-signature storm
+    # (eight round windows arriving at once). Here the router must take
+    # the device and beat the host outright — the two points together are
+    # the adaptive claim: routed ~= min(host, device) at every scale.
+    storm = round_items * 8
+    ver.verify_signatures(storm)  # warm the 4096 bucket
+    storm_host, storm_routed = paired_reps(storm, 8)
+    p50_storm_host = float(np.median(storm_host))
+    p50_storm_routed = float(np.median(storm_routed))
 
     return {
         "config": "4: 256 validators, Ed25519 TPU batch-verify offload",
+        "cap": (
+            "e2e runs are 100 heights (dedup/device-tally) and 20 heights "
+            "(redundant), not BASELINE's 10k — rates are sustained and "
+            "height-invariant once warm; nothing here is projected"
+        ),
         "device": str(jax.devices()[0]),
         "warmup_s": round(warm_s, 1),
         "rlc": RLC_DEFAULT,
@@ -266,7 +297,19 @@ def config_4() -> dict:
         "round512_p50_latency_host_native_s": round(p50_host, 5),
         "round512_p50_latency_device_s": round(p50_dev, 5),
         "round512_p50_latency_routed_s": round(p50_routed, 5),
-        "routed_beats_pure_host": p50_routed <= p50_host,
+        "round512_paired_p50_routed_minus_host_s": round(paired_diff_512, 6),
+        "storm4096_p50_latency_host_native_s": round(p50_storm_host, 5),
+        "storm4096_p50_latency_routed_s": round(p50_storm_routed, 5),
+        # The north-star latency claim, measured at both scales: below the
+        # crossover the router matches the pure-host baseline (paired
+        # difference within measurement noise), above it the router beats
+        # the host outright by taking the device.
+        "crossover_premise_ok": crossover_premise_ok,
+        "routed_beats_pure_host": bool(
+            crossover_premise_ok
+            and paired_diff_512 <= 0.01 * p50_host
+            and p50_storm_routed < p50_storm_host
+        ),
         "adaptive_crossover_sigs": adaptive.crossover,
         "adaptive_rates": [round(float(x), 1) for x in (adaptive.rates or ())],
     }

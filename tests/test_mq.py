@@ -204,3 +204,90 @@ def test_drain_window_matches_consume_key_order(rng):
     assert sorted((m.height, m.round) for m in got) == [
         (m.height, m.round) for m in window
     ]
+
+
+# Reference: mq_test.go:118-333 — whitelist accept/reject incl. dynamic
+# add/remove between consume calls.
+
+
+def test_whitelist_is_per_consume_call():
+    mq = MessageQueue()
+    mq.insert_prevote(pv(sig(1), 1, 0))
+    mq.insert_prevote(pv(sig(2), 1, 0))
+    got, n = collect(mq, 1, {sig(1)})
+    # Both messages consumed (the count includes whitelist drops,
+    # reference mq.go:36-66), only sig(1)'s dispatched.
+    assert n == 2
+    assert [m.sender for m in got] == [sig(1)]
+
+    # A sender added to the whitelist later gets its NEW messages through;
+    # the earlier one is gone (consumed-and-dropped, not quarantined).
+    mq.insert_prevote(pv(sig(2), 2, 0))
+    got, n = collect(mq, 2, {sig(1), sig(2)})
+    assert [m.sender for m in got] == [sig(2)]
+
+    # And a sender removed from the whitelist is dropped again.
+    mq.insert_prevote(pv(sig(1), 3, 0))
+    got, n = collect(mq, 3, set())
+    assert got == [] and n == 1
+
+
+def test_capacity_one_keeps_earliest_key():
+    # Reference: mq_test.go:641-795 capacity-1 eviction: the far-future
+    # tail is dropped, the smallest (height, round) survives.
+    mq = MessageQueue(max_capacity=1)
+    mq.insert_prevote(pv(sig(1), 5, 0))
+    mq.insert_prevote(pv(sig(1), 2, 0))  # smaller key evicts the tail
+    mq.insert_prevote(pv(sig(1), 9, 0))  # over capacity: dropped
+    got, n = collect(mq, 10, {sig(1)})
+    assert [(m.height, m.round) for m in got] == [(2, 0)]
+
+
+def test_capacity_is_per_sender_not_global():
+    mq = MessageQueue(max_capacity=2)
+    for h in range(1, 6):
+        mq.insert_prevote(pv(sig(1), h, 0))
+        mq.insert_prevote(pv(sig(2), h, 0))
+    got, _ = collect(mq, 10, {sig(1), sig(2)})
+    assert len(got) == 4  # 2 per sender
+    assert {m.sender for m in got} == {sig(1), sig(2)}
+
+
+def test_drop_below_height_keeps_exact_boundary():
+    mq = MessageQueue()
+    for h in (1, 2, 3, 4):
+        mq.insert_prevote(pv(sig(1), h, 0))
+    mq.drop_messages_below_height(3)
+    got, _ = collect(mq, 10, {sig(1)})
+    assert [m.height for m in got] == [3, 4]  # height 3 itself survives
+
+
+def test_drain_all_leaves_future_heights_buffered():
+    mq = MessageQueue()
+    mq.insert_prevote(pv(sig(1), 1, 2))
+    mq.insert_prevote(pv(sig(1), 3, 0))
+    mq.insert_prevote(pv(sig(2), 1, 0))
+    window = mq.drain_all(1)
+    assert [(m.height, m.round) for m in window] == [(1, 0), (1, 2)]
+    assert len(mq) == 1  # the height-3 message stays
+    window = mq.drain_all(3)
+    assert [(m.height, m.round) for m in window] == [(3, 0)]
+
+
+def test_drain_all_matches_drain_window_order(rng):
+    # The uncapped scan+sort drain and the k-way heap merge must produce
+    # the IDENTICAL sequence for any backlog.
+    mq1, mq2 = MessageQueue(), MessageQueue()
+    msgs = []
+    for i in range(200):
+        m = pv(sig(rng.randint(1, 9)), rng.randint(1, 4), rng.randint(0, 3))
+        msgs.append(m)
+    for m in msgs:
+        mq1.insert_prevote(m)
+        mq2.insert_prevote(m)
+    a = mq1.drain_all(3)
+    b = mq2.drain_window(3, 10_000)
+    assert a == b
+    assert [(m.height, m.round) for m in a] == sorted(
+        (m.height, m.round) for m in a
+    )

@@ -310,6 +310,98 @@ class TestPrevoteUponSufficientPrevotes:
         assert [pv.value for pv in rec.prevotes] == [NIL_VALUE]
 
 
+class TestLockLifecycleAcrossRounds:
+    """The paper's locking discipline driven through real message flow
+    (no state poking): lock, carry the lock across rounds, release it via
+    a quorum at a later valid_round, re-lock, and clear on commit.
+    Reference scenarios: process_test.go lock-and-precommit and
+    re-propose contexts (1879-2221, 1170-1589)."""
+
+    def _lock_at_round_0(self, proc, rec, value):
+        proc.propose(propose(value))
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, value))
+        assert proc.state.locked_value == value
+        assert proc.state.locked_round == 0
+        assert [pc.value for pc in rec.precommits] == [value]
+        assert proc.current_step == Step.PRECOMMITTING
+
+    def test_lock_carries_to_next_round_fresh_proposal_prevotes_nil(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        self._lock_at_round_0(proc, rec, val(1))
+        # Round 0 fails to commit; round 1 proposer offers a DIFFERENT
+        # fresh value — the lock forces a nil prevote (L22 lockable check).
+        proc.on_timeout_precommit(1, 0)
+        proc.propose(propose(val(2), round=1))
+        assert rec.prevotes[-1].value == NIL_VALUE
+        assert rec.prevotes[-1].round == 1
+        assert proc.state.locked_value == val(1)  # lock intact
+
+    def test_lock_releases_for_repropose_at_lockeds_own_round(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        self._lock_at_round_0(proc, rec, val(1))
+        proc.on_timeout_precommit(1, 0)
+        # Round 1 re-proposes the SAME value with valid_round=0; the round-0
+        # prevote quorum already sits in the logs, so L28 fires and the
+        # lock (locked_round 0 <= vr 0) allows prevoting it again.
+        proc.propose(propose(val(1), round=1, valid_round=0))
+        assert rec.prevotes[-1].value == val(1)
+        assert rec.prevotes[-1].round == 1
+
+    def test_relock_on_newer_quorum(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        self._lock_at_round_0(proc, rec, val(1))
+        # No commit at rounds 0-1; at round 1 a fresh proposal val(2)
+        # gains its own prevote quorum while we are prevoting: L36 must
+        # RE-lock onto the newer (round, value) pair.
+        proc.on_timeout_precommit(1, 0)
+        proc.propose(propose(val(2), round=1))  # lock forces nil prevote
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, val(2), round=1))
+        assert proc.state.locked_value == val(2)
+        assert proc.state.locked_round == 1
+        assert rec.precommits[-1].value == val(2)
+        assert rec.precommits[-1].round == 1
+
+    def test_valid_value_updates_without_lock_when_past_prevoting(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        # Reach PRECOMMITTING via the nil path (no lock taken): propose is
+        # missing, 2f+1 nil prevotes fire L44.
+        proc.on_timeout_propose(1, 0)  # broadcast nil prevote -> Prevoting
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.prevote(prevote(s, NIL_VALUE))
+        assert proc.current_step == Step.PRECOMMITTING
+        assert proc.state.locked_round == INVALID_ROUND
+        # Now the proposal arrives late with a value quorum from OTHER
+        # senders (the first three already prevoted nil; duplicates would
+        # be equivocation): L36 runs with step past Prevoting —
+        # valid_value/round update, but no lock and no second precommit.
+        n_precommits = len(rec.precommits)
+        proc.propose(propose(val(3)))
+        for s in (sig(9), sig(10), sig(11)):
+            proc.prevote(prevote(s, val(3)))
+        assert proc.state.valid_value == val(3)
+        assert proc.state.valid_round == 0
+        assert proc.state.locked_round == INVALID_ROUND
+        assert len(rec.precommits) == n_precommits
+
+    def test_commit_clears_lock_for_next_height(self):
+        proc, rec, _ = make_process()
+        proc.start()
+        self._lock_at_round_0(proc, rec, val(1))
+        for s in (OTHER_A, OTHER_B, OTHER_C):
+            proc.precommit(precommit(s, val(1)))
+        assert rec.commits == [(1, val(1))]
+        assert proc.current_height == 2
+        assert proc.state.locked_value == NIL_VALUE
+        assert proc.state.locked_round == INVALID_ROUND
+        assert proc.state.valid_round == INVALID_ROUND
+
+
 # ------------------------------------------------------------------------ L34
 
 

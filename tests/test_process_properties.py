@@ -652,3 +652,45 @@ def test_batched_ingest_matches_serial_delivery(data):
         assert (final in rec_b.timeout_precommits) == (
             final in rec_s.timeout_precommits
         )
+
+
+# ----------------------------------------------------------- lock discipline
+
+
+@RULES
+@given(
+    plan=st.lists(st.booleans(), min_size=1, max_size=4),
+)
+def test_lock_discipline_across_rounds(plan):
+    """Once locked, the automaton NEVER prevotes a conflicting fresh
+    value in any later round (safety half of the locking rules); it
+    prevotes the locked value again exactly when the proposal re-carries
+    it with a valid_round the lock permits. ``plan[r]`` chooses what the
+    round-(r+1) proposer offers: True = re-propose the locked value with
+    valid_round=0, False = a fresh conflicting value."""
+    locked = val(1)
+    proc, rec = make_process()
+    proc.start()
+    # Lock at round 0: valid proposal + 2f+1 prevotes while prevoting.
+    proc.propose(Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                         value=locked, sender=PROPOSER))
+    for i in (3, 4, 5):
+        proc.prevote(Prevote(height=1, round=0, value=locked, sender=sig(i)))
+    assert proc.state.locked_round == 0
+
+    for r, repropose in enumerate(plan, start=1):
+        proc.on_timeout_precommit(1, r - 1)
+        assert proc.state.current_round == r
+        if repropose:
+            proc.propose(Propose(height=1, round=r, valid_round=0,
+                                 value=locked, sender=PROPOSER))
+            assert rec.prevotes[-1].value == locked
+            assert rec.prevotes[-1].round == r
+        else:
+            proc.propose(Propose(height=1, round=r, valid_round=INVALID_ROUND,
+                                 value=val(2 + r), sender=PROPOSER))
+            assert rec.prevotes[-1].value == NIL_VALUE
+            assert rec.prevotes[-1].round == r
+        # The lock itself never moves (no newer quorum in this history).
+        assert proc.state.locked_value == locked
+        assert proc.state.locked_round == 0

@@ -68,3 +68,26 @@ def test_mutating_input_list_does_not_affect_schedule():
     before = rr.schedule(1, 0)
     sigs[:] = [sig(9)] * 3
     assert rr.schedule(1, 0) == before
+
+
+def test_round_robin_fairness_over_heights_and_rounds():
+    # Reference: scheduler_test.go modular fairness — over any n*k
+    # consecutive (height+round) coordinates each signatory is elected
+    # exactly k times.
+    sigs = [bytes([i]) * 32 for i in range(7)]
+    rr = RoundRobin(sigs)
+    from collections import Counter
+
+    counts = Counter(rr.schedule(h, 0) for h in range(1, 7 * 11 + 1))
+    assert set(counts.values()) == {11}
+    # Fixing the height and walking rounds cycles the same way.
+    counts = Counter(rr.schedule(5, r) for r in range(7 * 3))
+    assert set(counts.values()) == {3}
+
+
+def test_round_robin_height_round_interchangeable():
+    sigs = [bytes([i]) * 32 for i in range(5)]
+    rr = RoundRobin(sigs)
+    for h in range(1, 20):
+        for r in range(6):
+            assert rr.schedule(h, r) == rr.schedule(h + r, 0)

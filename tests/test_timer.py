@@ -7,7 +7,7 @@ import threading
 import time
 
 from hyperdrive_tpu.messages import Timeout
-from hyperdrive_tpu.timer import LinearTimer
+from hyperdrive_tpu.timer import LinearTimer, VirtualTimer
 from hyperdrive_tpu.types import MessageType
 
 
@@ -63,3 +63,77 @@ def test_nil_handler_is_safe():
     timer.timeout_prevote(1, 0)
     timer.timeout_precommit(1, 0)
     time.sleep(0.01)  # nothing to assert — must simply not raise
+
+
+class TestDurationScaling:
+    # Reference: timer_test.go:289+ — the linear scaling law over ranges.
+    def test_scaling_math_over_rounds(self):
+        t = LinearTimer(timeout=2.0, timeout_scaling=0.5)
+        assert t.duration_at(1, 0) == 2.0
+        assert t.duration_at(1, 1) == 3.0
+        assert t.duration_at(99, 4) == 6.0  # height never matters
+        for r in range(32):
+            assert t.duration_at(7, r) == 2.0 * (1 + 0.5 * r)
+
+    def test_zero_scaling_is_constant(self):
+        t = LinearTimer(timeout=5.0, timeout_scaling=0.0)
+        assert all(t.duration_at(1, r) == 5.0 for r in range(10))
+
+    def test_virtual_matches_linear_law(self):
+        class FakeClock:
+            def __init__(self):
+                self.scheduled = []
+
+            def schedule(self, delay, event, handler):
+                self.scheduled.append((delay, event))
+
+        clock = FakeClock()
+        vt = VirtualTimer(clock, timeout=1.0, timeout_scaling=0.25)
+        vt.timeout_propose(3, 4)
+        vt.timeout_precommit(3, 0)
+        (d1, e1), (d2, e2) = clock.scheduled
+        assert d1 == 2.0 and e1.round == 4
+        assert d2 == 1.0 and e2.message_type == MessageType.PRECOMMIT
+
+
+class TestRealClockFiring:
+    # Reference: timer_test.go:95-288 — real-sleep firing windows, typed
+    # channels, nil-handler safety. Tolerances are generous (CI machines).
+    def test_fires_only_the_scheduled_type(self):
+        fired = {"propose": [], "prevote": [], "precommit": []}
+        t = LinearTimer(
+            handle_timeout_propose=lambda ev: fired["propose"].append(ev),
+            handle_timeout_prevote=lambda ev: fired["prevote"].append(ev),
+            handle_timeout_precommit=lambda ev: fired["precommit"].append(ev),
+            timeout=0.02,
+            timeout_scaling=0.5,
+        )
+        t.timeout_prevote(5, 2)
+        time.sleep(0.15)
+        assert fired["propose"] == [] and fired["precommit"] == []
+        assert [ (e.height, e.round, e.message_type) for e in fired["prevote"] ] == [
+            (5, 2, MessageType.PREVOTE)
+        ]
+
+    def test_does_not_fire_early(self):
+        fired = []
+        t = LinearTimer(
+            handle_timeout_propose=fired.append, timeout=0.8, timeout_scaling=0.0
+        )
+        t.timeout_propose(1, 0)
+        time.sleep(0.05)
+        # 0.75s of slack before the deadline: a descheduling hiccup on a
+        # loaded CI machine must not flake this.
+        assert fired == []
+        time.sleep(1.0)
+        assert len(fired) == 1
+
+    def test_concurrent_timeouts_all_fire(self):
+        fired = []
+        t = LinearTimer(
+            handle_timeout_precommit=fired.append, timeout=0.02, timeout_scaling=0.0
+        )
+        for r in range(8):
+            t.timeout_precommit(1, r)
+        time.sleep(0.3)
+        assert sorted(e.round for e in fired) == list(range(8))
