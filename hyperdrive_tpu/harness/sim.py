@@ -26,6 +26,7 @@ from __future__ import annotations
 import hashlib
 import heapq
 import random
+from itertools import repeat
 
 import numpy as np
 from dataclasses import dataclass, field
@@ -510,14 +511,16 @@ class Simulation:
     ) -> Replica:
         keypair = self.ring[i] if self.ring is not None else None
 
+        recipients = range(self.n)
+
         def bcast(msg):
             # Broadcast to all, including self (reference: 174-208). In
             # signed mode the sender attaches its detached signature here —
             # the outbound edge of the replica, like a real wire stack.
+            # zip+repeat builds the n delivery tuples in C.
             if keypair is not None:
                 msg = keypair.sign_message(msg)
-            for j in range(self.n):
-                self.queue.append((j, msg))
+            self.queue.extend(zip(recipients, repeat(msg, self.n)))
 
         # The owned clock tags each scheduled timeout with its owner index so
         # the delivery queue can route the fired event back to that replica.
