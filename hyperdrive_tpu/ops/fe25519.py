@@ -6,14 +6,14 @@ sum(l_i * 2^(13 i)), capacity 260 bits. With normalized limbs (< 2^13):
 
 - a limb product is < 2^26, and a schoolbook column accumulates at most 20
   products, staying < 2^30.4 — comfortably inside int32. (Normalization
-  leaves up to 2^10 of slack on low limbs — the micro-ripple after a fold
-  is single-step — so the worst real bound is 20 * (2^13 + 2^10)^2 < 2^31,
-  still safe);
+  leaves slack on low limbs — public results bound their limbs by
+  ``SLACK_MAX`` = 9,400, not 2^13 — and the worst real column bound is
+  20 * SLACK_MAX^2 = 1.767e9 < 2^31, still safe);
 - 2^260 = 608 (mod p), so columns 20..39 of a product fold back into
   columns 0..19 with a single multiply by 608;
 - bits 255..259 fold with a multiply by 19 (2^255 = 19 mod p), which keeps
   every public result under the invariant **value < 2^256** with all limbs
-  in [0, 2^13).
+  in [0, SLACK_MAX].
 
 Every function operates on arrays shaped ``[..., 20]`` (any batch prefix),
 contains only static shapes and static Python loops over limb indices, and
@@ -70,8 +70,11 @@ FOLD_255 = 19
 TOP_SHIFT = 8
 TOP_MASK = (1 << TOP_SHIFT) - 1
 
-#: Invariant slack: public results have limbs in [0, 2^13 + 2^10].
-SLACK_MAX = (1 << LIMB_BITS) + (1 << 10)
+#: Invariant slack: public results have limbs in [0, SLACK_MAX]. The bound
+#: comes from :func:`_reduce_cols`'s two-fold-pass tail (worst chain value
+#: 9,383 — see the bound walk there); 9,400 adds margin while keeping the
+#: schoolbook column bound 20 * SLACK_MAX^2 = 1.767e9 < 2^31 int32-safe.
+SLACK_MAX = 9_400
 
 
 def _make_sub_bias() -> "np.ndarray":
@@ -84,8 +87,8 @@ def _make_sub_bias() -> "np.ndarray":
     Construction: take the natural base-2^13 digits d_i of c*p and lend
     2^13 from each limb i+1 to limb i (m_0 = d_0 + 2^13, m_i = d_i + 2^13
     - 1 for 0 < i < 19, m_19 = d_19 - 1, where d_19 is the untruncated top
-    digit). Searching c finds digits big enough that every m_i >= 2^13 +
-    2^10 (the operand limb maximum)."""
+    digit). Searching c finds digits big enough that every m_i >=
+    SLACK_MAX (the operand limb maximum)."""
     for c in range(40, 4096):
         v = c * P_INT
         d = [(v >> (LIMB_BITS * i)) & LIMB_MASK for i in range(N_LIMBS - 1)]
@@ -249,23 +252,27 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
 
 def _reduce_cols(cols: jnp.ndarray) -> jnp.ndarray:
     """Shared reduction tail of :func:`mul`/:func:`sqr`: take the 39 product
-    columns (each < 2^30.7 — the callers' bound analyses guarantee this),
-    normalize to 20 invariant limbs, value < 2^256.
+    columns (each <= 20 * SLACK_MAX^2 = 1.767e9 < 2^31 — the callers' bound
+    analyses guarantee this), normalize to 20 invariant limbs, value < 2^256.
 
-    Two passes bring all 39 columns to <= 2^13 + 26; the x608 fold of
-    columns 20..38 (plus the passes' top carries as virtual column 39) then
-    keeps everything < 2^23, and three fold-passes + the top fold restore
-    the invariant."""
+    Bound walk (operand limbs <= SLACK_MAX = 9,400, so cols <= 1.767e9):
+    one pass leaves limbs <= 8,191 + (1.767e9 >> 13) = 223,913 with top
+    carry c1 <= 215,722; the x608 fold of columns 20..38 (c1 as virtual
+    column 39 into 19) keeps every column <= 223,913 * 609 < 2^27.03.
+    Fold-pass A: limbs <= 8,191 + (2^27.02 >> 13) = 24,836, and its top
+    carry (<= 16,037) folds x608 into limb 0 <= 9,758,687 < 2^23.3.
+    Fold-pass B: limb 1 <= 8,191 + (9,758,687 >> 13) = 9,382, all others
+    <= 8,194, top carry <= 3 folds to limb 0 <= 10,015. The top fold then
+    masks limb 0 and ripples <= 1 into limb 1: final limbs <= 9,383 —
+    inside SLACK_MAX, closing the invariant."""
     cols, c1 = _pass(cols)
-    cols, c2 = _pass(cols)
 
     low = cols[..., :N_LIMBS]
     high = cols[..., N_LIMBS:]  # columns 20..38 fold x608 into 0..18
     low = low.at[..., : N_LIMBS - 1].add(high * FOLD_260)
-    # Virtual column 39 (the passes' top carries) folds to column 19.
-    low = low.at[..., 19].add((c1 + c2) * FOLD_260)
+    # Virtual column 39 (the pass's top carry) folds to column 19.
+    low = low.at[..., 19].add(c1 * FOLD_260)
 
-    low = _pass_fold(low)
     low = _pass_fold(low)
     low = _pass_fold(low)
     return _fold_top(low)
@@ -273,11 +280,11 @@ def _reduce_cols(cols: jnp.ndarray) -> jnp.ndarray:
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Schoolbook product with modular folding. Inputs must satisfy the
-    invariant (limbs <= 2^13 + 2^10); output does too, value < 2^256.
+    invariant (limbs <= SLACK_MAX); output does too, value < 2^256.
 
     Bound chain: products <= SLACK_MAX^2 < 2^26.4, columns accumulate <= 20
-    of them -> < 2^30.7 (int32-safe), meeting :func:`_reduce_cols`'s
-    contract."""
+    of them -> <= 1.767e9 < 2^31 (int32-safe), meeting
+    :func:`_reduce_cols`'s contract."""
     batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
     cols = jnp.zeros((*batch, 2 * N_LIMBS - 1), dtype=jnp.int32)
     for i in range(N_LIMBS):
@@ -291,8 +298,8 @@ def sqr(a: jnp.ndarray) -> jnp.ndarray:
     halving the multiply work of :func:`mul`.
 
     Bound: the worst column sums 10 doubled cross products (col 19:
-    (0,19)..(9,10)) <= 10 * 2 * SLACK_MAX^2 < 2^30.7 — int32-safe, meeting
-    :func:`_reduce_cols`'s contract."""
+    (0,19)..(9,10)) <= 10 * 2 * SLACK_MAX^2 = 1.767e9 < 2^31 — int32-safe,
+    meeting :func:`_reduce_cols`'s contract."""
     a2 = a + a
     batch = a.shape[:-1]
     cols = jnp.zeros((*batch, 2 * N_LIMBS - 1), dtype=jnp.int32)

@@ -69,7 +69,7 @@ class TallyView:
     __slots__ = ("rep", "height", "counts", "R", "targets",
                  "l28_round", "l28_value", "dirty")
 
-    def __init__(self, rep: int, height: int, counts: dict, r_slots: int,
+    def __init__(self, rep: int, height: int, counts: Mapping, r_slots: int,
                  targets: dict, l28_round: int, l28_value: bytes,
                  dirty=frozenset()):
         self.rep = rep

@@ -48,9 +48,10 @@ def check_invariant(arr):
     a = np.asarray(arr)
     assert a.dtype == np.int32
     assert (a >= 0).all()
-    # Normalized limbs carry up to 2^10 of fold slack (see module doc);
-    # 20 * (2^13 + 2^10)^2 still fits int32, so this is the real invariant.
-    assert (a <= (1 << 13) + (1 << 10)).all()
+    # Normalized limbs carry fold slack bounded by SLACK_MAX (see the
+    # module doc); 20 * SLACK_MAX^2 still fits int32, so this is the real
+    # invariant.
+    assert (a <= fe.SLACK_MAX).all()
     if a.ndim == 1:
         assert fe.from_limbs(a) < 1 << 256
     else:
@@ -101,6 +102,16 @@ def test_mul_matches_bignum(rng):
         got = fe.from_limbs(np.asarray(out)[i]) % P
         want = ((x % (1 << 256)) * other[i]) % P
         assert got == want
+    # Worst-case column accumulation: both operands with every limb at the
+    # invariant maximum (the binding case for _reduce_cols's bound walk).
+    worst = jnp.broadcast_to(
+        jnp.full((fe.N_LIMBS,), fe.SLACK_MAX, dtype=jnp.int32),
+        (4, fe.N_LIMBS),
+    )
+    wv = fe.from_limbs(np.asarray(worst)[0])
+    wout = jmul(worst, worst)
+    check_invariant(wout)
+    assert fe.from_limbs(np.asarray(wout)[0]) % P == (wv * wv) % P
 
 
 def test_sqr_matches_bignum(rng):
@@ -113,7 +124,7 @@ def test_sqr_matches_bignum(rng):
         assert fe.from_limbs(np.asarray(out)[i]) % P == (x * x) % P
     # Worst-case column accumulation: all limbs at the invariant maximum.
     worst = jnp.broadcast_to(
-        jnp.full((fe.N_LIMBS,), (1 << 13) + (1 << 10), dtype=jnp.int32),
+        jnp.full((fe.N_LIMBS,), fe.SLACK_MAX, dtype=jnp.int32),
         (4, fe.N_LIMBS),
     )
     wv = fe.from_limbs(np.asarray(worst)[0])
