@@ -15,5 +15,20 @@ from hyperdrive_tpu.parallel.mesh import (
     make_sharded_step,
     sharded_verify_tally,
 )
+from hyperdrive_tpu.parallel.multihost import (
+    global_window_from_local,
+    init_distributed,
+    make_hybrid_mesh,
+    replicate_to_all_hosts,
+)
 
-__all__ = ["grid_pack", "make_mesh", "make_sharded_step", "sharded_verify_tally"]
+__all__ = [
+    "grid_pack",
+    "make_mesh",
+    "make_sharded_step",
+    "sharded_verify_tally",
+    "global_window_from_local",
+    "init_distributed",
+    "make_hybrid_mesh",
+    "replicate_to_all_hosts",
+]
