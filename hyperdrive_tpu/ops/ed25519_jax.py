@@ -13,10 +13,10 @@ Work split (host does the bit-twiddly, device does the wide math):
   negate A, pack everything into int32 limb tensors padded to a bucketed
   batch size (static shapes -> no recompiles).
 - **Device** (:func:`verify_kernel`): compute P = [s]B + [k](-A) with one
-  joint Horner loop — 63 iterations of 4 doublings + two table additions —
-  then accept iff P projectively equals the decompressed R. The B window
-  table is a compile-time constant; the (-A) table (16 multiples) is built
-  on device per signature.
+  joint Horner loop — 64 iterations of 4 doublings + two signed-window
+  table additions — then accept iff P projectively equals the decompressed
+  R. The B window table is a compile-time constant; the (-A) table (9
+  multiples, signed digits select +/-) is built on device per signature.
 
 Verification semantics match the host oracle
 (:func:`hyperdrive_tpu.crypto.ed25519.verify`) bit-for-bit: malformed
@@ -68,8 +68,10 @@ def _identity_like(batch_shape):
 
 
 def _point_select(onehot, table):
-    """Table lookup as multiply-accumulate: ``onehot`` [B, 16] x ``table``
-    components each [B, 16, 20] (or [16, 20] shared) -> component [B, 20].
+    """Table lookup as multiply-accumulate: ``onehot`` [B, V] x ``table``
+    components each [B, V, 20] (or [V, 20] shared) -> component [B, 20],
+    for any table width V (9 signed-window entries in verify_kernel, 16
+    unsigned in rlc_kernel).
 
     One-hot matmul instead of gather: gathers scatter badly on TPU; a
     [B,16] x [16,*] contraction rides the vector units.
@@ -149,11 +151,14 @@ _N_WINDOWS = 64  # 256 bits / 4
 
 
 @functools.lru_cache(maxsize=None)
-def _b_niels_np():
-    """[v]B for v in 0..15 as affine niels limbs (y+x, y-x, 2d*x*y)."""
+def _b_niels_np(entries: int = 16):
+    """[v]B for v in 0..entries-1 as affine niels limbs (y+x, y-x, 2d*x*y).
+
+    The per-signature kernel selects over 9 entries (signed digits, |d| <=
+    8); the RLC kernel keeps the unsigned 16-entry table."""
     yp, ym, t2 = [], [], []
     pt = host_ed.IDENTITY
-    for v in range(16):
+    for v in range(entries):
         x, y, z, _ = pt
         zinv = pow(z, P - 2, P)
         xa, ya = (x * zinv) % P, (y * zinv) % P
@@ -162,6 +167,47 @@ def _b_niels_np():
         t2.append((K2D * xa * ya) % P)
         pt = host_ed.point_add(pt, host_ed.BASE)
     return (fe.to_limbs(yp), fe.to_limbs(ym), fe.to_limbs(t2))
+
+
+def _recode_signed(nibbles):
+    """[B, 64] unsigned base-16 digits -> [64, B] signed digits in [-8, 7].
+
+    Standard signed-window recoding: digits >= 8 borrow 16 and carry 1
+    into the next position. Both verified scalars are < 2^253 (s is
+    range-checked against L, k is reduced mod L), so the top digit is at
+    most 1 + carry = 2 and the carry never overflows. Halving the digit
+    magnitude halves the table the per-window selects read (9 entries
+    instead of 16) — negation of a niels entry is a swap + one field
+    negation, far cheaper than the wider select."""
+    xs = jnp.moveaxis(nibbles, -1, 0)
+
+    def step(carry, col):
+        d = col + carry
+        ge = (d >= 8).astype(jnp.int32)
+        return ge, d - 16 * ge
+
+    _, out = lax.scan(step, jnp.zeros_like(xs[0]), xs)
+    return out
+
+
+def _select_signed(digit, table, shared: bool):
+    """Select entry [|digit|] from a 9-entry niels table and negate it when
+    the digit is negative: a niels negation swaps (y+x, y-x) and negates
+    the 2d*t component; any z passes through.
+
+    ``digit``: [B] signed; ``table``: niels components each [B, 9, 20]
+    (per-signature) or [9, 20] (``shared``); returns the selected entry."""
+    lanes9 = jnp.arange(9, dtype=jnp.int32)
+    sign = digit < 0
+    oh = lanes9[None, :] == jnp.abs(digit)[:, None]
+    sel = _point_select(oh, table)
+    yp, ym, t2 = sel[0], sel[1], sel[2]
+    out = (
+        fe.select(sign, ym, yp),
+        fe.select(sign, yp, ym),
+        fe.select(sign, fe.neg(t2), t2),
+    )
+    return out if shared else (*out, sel[3])
 
 
 # ------------------------------------------------------------------ kernel
@@ -184,23 +230,26 @@ def verify_kernel(ax, ay, at, rx, ry, s_nibbles, k_nibbles):
     zero = jnp.zeros_like(one)
     k2d = jnp.asarray(_K2D_LIMBS, dtype=jnp.int32)
 
-    # Per-signature table of the 16 multiples of A' (affine, z = 1), built
-    # with a scan so the traced graph holds a single addition (15
+    # Signed-digit recoding: the window selects then read a 9-entry table
+    # (|d| <= 8) instead of 16, and negation is a cheap swap+neg.
+    k_signed = _recode_signed(k_nibbles)  # [64, B]
+    s_signed = _recode_signed(s_nibbles)
+
+    # Per-signature table of the multiples [0..8]A' (affine, z = 1), built
+    # with a scan so the traced graph holds a single addition (8
     # executed), then converted to niels form in one batched shot.
     a_niels = (fe.add(ay, ax), fe.sub(ay, ax), fe.mul(at, k2d))
 
     def table_step(pt, _):
         return _madd(pt, a_niels, need_t=True), pt
 
-    _, stacked = lax.scan(table_step, _identity_like((bsz,)), None, length=16)
-    sx, sy, sz, st = (jnp.moveaxis(c, 0, 1) for c in stacked)  # [B, 16, 20]
+    _, stacked = lax.scan(table_step, _identity_like((bsz,)), None, length=9)
+    sx, sy, sz, st = (jnp.moveaxis(c, 0, 1) for c in stacked)  # [B, 9, 20]
     ta = (fe.add(sy, sx), fe.sub(sy, sx), fe.mul(st, k2d), sz)
 
     tb = tuple(
-        jnp.asarray(comp, dtype=jnp.int32) for comp in _b_niels_np()
-    )  # each [16, 20]
-
-    lanes = jnp.arange(16, dtype=jnp.int32)
+        jnp.asarray(comp, dtype=jnp.int32) for comp in _b_niels_np(9)
+    )  # each [9, 20]
 
     def body(i, acc3):
         w = _N_WINDOWS - 1 - i
@@ -208,10 +257,10 @@ def verify_kernel(ax, ay, at, rx, ry, s_nibbles, k_nibbles):
             0, _WINDOW - 1, lambda _, p: _dbl(p, need_t=False), acc3
         )
         acc4 = _dbl(acc3, need_t=True)
-        k_digit = lax.dynamic_slice_in_dim(k_nibbles, w, 1, axis=1)  # [B,1]
-        s_digit = lax.dynamic_slice_in_dim(s_nibbles, w, 1, axis=1)
-        acc4 = _padd(acc4, _point_select(lanes[None, :] == k_digit, ta), need_t=True)
-        return _madd(acc4, _point_select(lanes[None, :] == s_digit, tb), need_t=False)
+        kd = lax.dynamic_slice_in_dim(k_signed, w, 1, axis=0)[0]  # [B]
+        sd = lax.dynamic_slice_in_dim(s_signed, w, 1, axis=0)[0]
+        acc4 = _padd(acc4, _select_signed(kd, ta, shared=False), need_t=True)
+        return _madd(acc4, _select_signed(sd, tb, shared=True), need_t=False)
 
     px, py, pz = lax.fori_loop(0, _N_WINDOWS, body, (zero, one, one))
 
