@@ -253,16 +253,25 @@ def verify_kernel(ax, ay, at, rx, ry, s_nibbles, k_nibbles):
 
     def body(i, acc3):
         w = _N_WINDOWS - 1 - i
-        acc3 = lax.fori_loop(
-            0, _WINDOW - 1, lambda _, p: _dbl(p, need_t=False), acc3
-        )
+        # The three T-less doublings are unrolled statically: a nested
+        # lax.fori_loop would put a while-loop fusion barrier inside every
+        # window, and the whole window body fuses better as straight line.
+        for _ in range(_WINDOW - 1):
+            acc3 = _dbl(acc3, need_t=False)
         acc4 = _dbl(acc3, need_t=True)
         kd = lax.dynamic_slice_in_dim(k_signed, w, 1, axis=0)[0]  # [B]
         sd = lax.dynamic_slice_in_dim(s_signed, w, 1, axis=0)[0]
         acc4 = _padd(acc4, _select_signed(kd, ta, shared=False), need_t=True)
         return _madd(acc4, _select_signed(sd, tb, shared=True), need_t=False)
 
-    px, py, pz = lax.fori_loop(0, _N_WINDOWS, body, (zero, one, one))
+    # Two windows per traced iteration: halving the loop-carried barrier
+    # count buys ~0.7% on v5e (69.2 -> 69.7k sigs/s); a 4-window unroll
+    # measured no better and doubles the traced body, so stop at 2.
+    def body2(j, acc3):
+        acc4 = body(2 * j, acc3)
+        return body(2 * j + 1, acc4)
+
+    px, py, pz = lax.fori_loop(0, _N_WINDOWS // 2, body2, (zero, one, one))
 
     ok_x = fe.eq(px, fe.mul(rx, pz))
     ok_y = fe.eq(py, fe.mul(ry, pz))
