@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from hyperdrive_tpu.crypto import ed25519 as host_ed
 from hyperdrive_tpu.crypto.keys import KeyRing
 from hyperdrive_tpu.messages import Prevote
-from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost, verify_kernel
+from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost
+from hyperdrive_tpu.ops.ed25519_pallas import make_pallas_verify_fn
 from hyperdrive_tpu.ops.tally import pack_values, tally_counts, quorum_flags
 
 N_VALIDATORS = 256
@@ -66,9 +67,12 @@ def build_batch():
     return tuple(jnp.asarray(a) for a in arrays), vote_vals, target_vals
 
 
+_verify = make_pallas_verify_fn()  # the Pallas ladder: 7x the XLA kernel
+
+
 @jax.jit
 def step(ax, ay, at, rx, ry, s_nib, k_nib, vote_vals, target_vals, f):
-    ok = verify_kernel(ax, ay, at, rx, ry, s_nib, k_nib)
+    ok = _verify(ax, ay, at, rx, ry, s_nib, k_nib)
     counts = tally_counts(vote_vals, ok.reshape(ROUNDS, N_VALIDATORS), target_vals)
     flags = quorum_flags(counts, f)
     return ok, counts, flags

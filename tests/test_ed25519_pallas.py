@@ -1,0 +1,87 @@
+"""Differential tests for the Pallas verify ladder (interpret mode).
+
+The kernel must agree bit-for-bit with BOTH the host oracle and the XLA
+kernel on every lane class: valid signatures, corrupted scalars, wrong
+digests, and the zero-padded lanes the packer emits for malformed inputs.
+Interpret mode runs the real kernel logic (including the scratch-table
+build and signed recoding) on CPU; the TPU measurements live in bench.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hyperdrive_tpu.crypto import ed25519 as host_ed
+from hyperdrive_tpu.crypto.keys import KeyRing
+from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost, make_verify_fn
+from hyperdrive_tpu.ops.ed25519_pallas import verify_pallas
+
+BLOCK = 64  # small block: interpret-mode cost scales with padded size
+
+
+def _pack(items):
+    host = Ed25519BatchHost(buckets=(len(items),))
+    arrays, prevalid, n = host.pack(items)
+    return tuple(jnp.asarray(a) for a in arrays), prevalid, n
+
+
+def _host_verdicts(items):
+    return np.array(
+        [host_ed.verify(pub, digest, sig) for pub, digest, sig in items]
+    )
+
+
+def build_mixed(n=BLOCK, seed=7):
+    """n lanes covering every verdict class."""
+    rng = np.random.default_rng(seed)
+    ring = KeyRing.deterministic(16, namespace=b"pl-test")
+    items = []
+    for i in range(n):
+        kp = ring[i % 16]
+        digest = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        sig = host_ed.sign(kp.seed, digest)
+        kind = i % 4
+        if kind == 1:  # corrupted s scalar bit
+            sig = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
+        elif kind == 2:  # signature over a different digest
+            digest = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        elif kind == 3 and i % 8 == 3:  # malformed point -> prevalid False
+            sig = b"\xff" * 64
+        items.append((kp.public, digest, sig))
+    return items
+
+
+def test_pallas_matches_host_oracle_and_xla_kernel():
+    items = build_mixed()
+    arrays, prevalid, n = _pack(items)
+
+    got = np.asarray(
+        verify_pallas(*arrays, block=BLOCK, interpret=True)
+    ) & prevalid
+    want = _host_verdicts(items)
+    np.testing.assert_array_equal(got[:n], want)
+
+    xla = np.asarray(make_verify_fn()(*arrays)) & prevalid
+    np.testing.assert_array_equal(got, xla)
+
+
+def test_pallas_pads_partial_blocks():
+    items = build_mixed(n=40, seed=11)  # 40 -> padded to 64
+    arrays, prevalid, n = _pack(items)
+    assert arrays[0].shape[0] == 40
+    got = np.asarray(
+        verify_pallas(*arrays, block=BLOCK, interpret=True)
+    ) & prevalid
+    assert got.shape == (40,)
+    np.testing.assert_array_equal(got[:n], _host_verdicts(items))
+
+
+def test_pallas_rejects_all_zero_lanes():
+    z20 = jnp.zeros((BLOCK, 20), dtype=jnp.int32)
+    z64 = jnp.zeros((BLOCK, 64), dtype=jnp.int32)
+    got = np.asarray(
+        verify_pallas(z20, z20, z20, z20, z20, z64, z64,
+                      block=BLOCK, interpret=True)
+    )
+    assert not got.any()
