@@ -23,8 +23,11 @@ import jax.numpy as jnp
 from hyperdrive_tpu.crypto import ed25519 as host_ed
 from hyperdrive_tpu.crypto.keys import KeyRing
 from hyperdrive_tpu.messages import Prevote
-from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost
-from hyperdrive_tpu.ops.ed25519_pallas import make_pallas_verify_fn
+from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost, make_verify_fn
+from hyperdrive_tpu.ops.ed25519_pallas import (
+    make_pallas_verify_fn,
+    resolve_backend,
+)
 from hyperdrive_tpu.ops.tally import pack_values, tally_counts, quorum_flags
 
 N_VALIDATORS = 256
@@ -67,7 +70,11 @@ def build_batch():
     return tuple(jnp.asarray(a) for a in arrays), vote_vals, target_vals
 
 
-_verify = make_pallas_verify_fn()  # the Pallas ladder: 7x the XLA kernel
+# Kernel backend: the Pallas ladder on TPU (7x), the XLA kernel elsewhere.
+# `python bench.py xla` forces the fallback so its published figure stays
+# reproducible with this same harness.
+BACKEND = resolve_backend(sys.argv[1] if len(sys.argv) > 1 else None)
+_verify = make_pallas_verify_fn() if BACKEND == "pallas" else make_verify_fn()
 
 
 @jax.jit
@@ -131,6 +138,7 @@ def main():
                 "value": round(votes_per_sec, 1),
                 "unit": "votes/s",
                 "vs_baseline": round(votes_per_sec / TARGET_VOTES_PER_SEC, 4),
+                "backend": BACKEND,
                 "batch": BATCH,
                 "iters": iters,
                 "trial_rates": [round(r, 1) for r in rates],

@@ -677,13 +677,9 @@ class TpuBatchVerifier:
         # 488.9k vs 69.7k sigs/s in bench.py) on real TPU backends, the
         # XLA kernel elsewhere (the Mosaic interpreter is far too slow
         # for production windows; CPU tests run the XLA kernel).
-        if backend == "auto":
-            from hyperdrive_tpu.ops.ed25519_pallas import pallas_backend_ok
+        from hyperdrive_tpu.ops.ed25519_pallas import resolve_backend
 
-            backend = "pallas" if pallas_backend_ok() else "xla"
-        if backend not in ("pallas", "xla"):
-            raise ValueError(f"unknown backend {backend!r}")
-        self.backend = backend
+        self.backend = resolve_backend(backend)
 
     def _device_verify(self, arrays):
         dev_in = [jnp.asarray(a) for a in arrays]

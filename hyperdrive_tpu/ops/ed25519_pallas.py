@@ -52,7 +52,12 @@ from hyperdrive_tpu.crypto import ed25519 as host_ed
 from hyperdrive_tpu.ops import fe25519 as fe
 from hyperdrive_tpu.ops.ed25519_jax import _b_niels_np, _recode_signed
 
-__all__ = ["verify_pallas", "make_pallas_verify_fn", "pallas_backend_ok"]
+__all__ = [
+    "verify_pallas",
+    "make_pallas_verify_fn",
+    "pallas_backend_ok",
+    "resolve_backend",
+]
 
 N = fe.N_LIMBS
 _LB = fe.LIMB_BITS
@@ -425,15 +430,33 @@ def make_pallas_verify_fn(block: int = _BLOCK, interpret: bool = False):
     return run
 
 
-def pallas_backend_ok() -> bool:
-    """True when the default JAX backend compiles Mosaic kernels (real TPU
-    — including the axon remote-compile platform). CPU/interpret is only
-    for tests: the interpreter is orders of magnitude too slow for real
-    windows."""
+def pallas_backend_ok(devices=None) -> bool:
+    """True when the target devices compile Mosaic kernels (real TPU —
+    including the axon remote-compile platform). ``devices``: the devices
+    the kernel will actually run on (e.g. ``mesh.devices.flat``); defaults
+    to the process default backend. CPU/interpret is only for tests: the
+    interpreter is orders of magnitude too slow for real windows."""
     try:
+        if devices is not None:
+            plats = {d.platform for d in np.asarray(devices).flat}
+            return plats <= {"tpu", "axon"} and bool(plats)
         return jax.default_backend() in ("tpu", "axon")
     except Exception:  # pragma: no cover - no backend at all
         return False
+
+
+def resolve_backend(backend=None, devices=None) -> str:
+    """Normalize a backend choice to "pallas" or "xla".
+
+    ``backend``: "pallas"/"xla" pass through; None or "auto" selects
+    "pallas" when ``devices`` (or the default backend) are Mosaic-capable.
+    The one resolution rule shared by every consumer (TpuBatchVerifier,
+    the sharded mesh step, bench.py) so the selection logic cannot drift."""
+    if backend in (None, "auto"):
+        return "pallas" if pallas_backend_ok(devices) else "xla"
+    if backend not in ("pallas", "xla"):
+        raise ValueError(f"unknown backend {backend!r}")
+    return backend
 
 
 def verify_pallas(ax, ay, at, rx, ry, s_nib, k_nib,

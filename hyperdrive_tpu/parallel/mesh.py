@@ -49,7 +49,23 @@ def make_mesh(devices=None, hr: int = 1, val: int | None = None) -> Mesh:
     return Mesh(arr, axis_names=("hr", "val"))
 
 
-def _local_step(ax, ay, at, rx, ry, s_nib, k_nib, vote_vals, target_vals, f):
+def _pick_kernel(backend: str | None, mesh: Mesh):
+    """Resolve the per-shard verify kernel: the Pallas ladder when the
+    MESH'S devices are Mosaic-capable (7x the XLA kernel — see
+    ops/ed25519_pallas.py), the XLA kernel elsewhere (CPU meshes in tests
+    and the dryrun — which can coexist with a TPU default backend, so the
+    decision keys off the mesh, not the process default)."""
+    from hyperdrive_tpu.ops.ed25519_pallas import resolve_backend
+
+    if resolve_backend(backend, devices=mesh.devices) == "pallas":
+        from hyperdrive_tpu.ops.ed25519_pallas import verify_pallas
+
+        return verify_pallas
+    return verify_kernel
+
+
+def _local_step(ax, ay, at, rx, ry, s_nib, k_nib, vote_vals, target_vals, f,
+                *, kernel=verify_kernel):
     """Per-shard work: verify local signatures, tally locally, psum.
 
     Shapes (local shard): ax.. [R, V, 20], nibbles [R, V, 64],
@@ -60,7 +76,7 @@ def _local_step(ax, ay, at, rx, ry, s_nib, k_nib, vote_vals, target_vals, f):
     def flat(a):
         return a.reshape((r_l * v_l,) + a.shape[2:])
 
-    ok = verify_kernel(
+    ok = kernel(
         flat(ax), flat(ay), flat(at), flat(rx), flat(ry),
         flat(s_nib), flat(k_nib),
     ).reshape(r_l, v_l)
@@ -72,19 +88,25 @@ def _local_step(ax, ay, at, rx, ry, s_nib, k_nib, vote_vals, target_vals, f):
     return counts, flags, ok
 
 
-def sharded_verify_tally(mesh: Mesh):
+def sharded_verify_tally(mesh: Mesh, backend: str | None = None):
     """Compile the full verify+tally step over ``mesh``.
 
     Input global shapes: signature arrays [R, V, ...] sharded (hr, val);
     target values [R, 8] sharded (hr,); f replicated. Outputs: counts and
     flags [R] sharded over 'hr' (replicated over 'val' after the psum),
     and the verification mask [R, V].
+
+    ``backend``: None (auto — Pallas ladder on TPU, XLA kernel on CPU
+    meshes), or "pallas"/"xla" explicitly. The per-shard local batch must
+    be a multiple of the Pallas block or small enough to pad (the
+    verify_pallas wrapper pads ragged shards).
     """
     spec_rv = P("hr", "val")
     spec_r = P("hr")
+    kernel = _pick_kernel(backend, mesh)
 
     shard_fn = jax.shard_map(
-        _local_step,
+        partial(_local_step, kernel=kernel),
         mesh=mesh,
         in_specs=(
             spec_rv, spec_rv, spec_rv, spec_rv, spec_rv,  # ax..ry
