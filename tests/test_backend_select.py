@@ -1,0 +1,34 @@
+"""Backend resolution: one rule, shared by the verifier and the mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+from hyperdrive_tpu.ops.ed25519_pallas import pallas_backend_ok, resolve_backend
+
+
+def test_resolve_passthrough_and_validation():
+    assert resolve_backend("pallas") == "pallas"
+    assert resolve_backend("xla") == "xla"
+    with pytest.raises(ValueError):
+        resolve_backend("cuda")
+
+
+def test_auto_on_cpu_devices_is_xla():
+    # conftest pins the suite to the CPU backend: both sentinels resolve
+    # to the XLA kernel, for the process default and for explicit devices.
+    for sentinel in (None, "auto"):
+        assert resolve_backend(sentinel) == "xla"
+    assert not pallas_backend_ok(np.array(jax.devices()))
+    assert resolve_backend(None, devices=np.array(jax.devices())) == "xla"
+
+
+def test_verifier_reports_backend():
+    v = TpuBatchVerifier(buckets=(64,))
+    assert v.backend == "xla"  # CPU test environment
+    v2 = TpuBatchVerifier(buckets=(64,), backend="xla")
+    assert v2.backend == "xla"
+    with pytest.raises(ValueError):
+        TpuBatchVerifier(buckets=(64,), backend="bogus")
