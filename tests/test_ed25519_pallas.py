@@ -8,7 +8,6 @@ build and signed recoding) on CPU; the TPU measurements live in bench.py.
 """
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 
