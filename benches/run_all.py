@@ -132,7 +132,8 @@ def _wall_tracer():
 
 
 def _run_signed_burst(ver, heights: int, dedup: bool, seed: int,
-                      device_tally: bool = False) -> dict:
+                      device_tally: bool = False,
+                      max_steps: int = 50_000_000) -> dict:
     from hyperdrive_tpu.harness import Simulation
 
     sim = Simulation(
@@ -150,7 +151,7 @@ def _run_signed_burst(ver, heights: int, dedup: bool, seed: int,
     for r in sim.replicas:
         r.tracer = wall_tr
     t0 = time.perf_counter()
-    res = sim.run(max_steps=50_000_000)
+    res = sim.run(max_steps=max_steps)
     wall = time.perf_counter() - t0
     res.assert_safety()
     assert res.completed, f"stalled at {res.heights}"

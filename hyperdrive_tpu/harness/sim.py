@@ -162,7 +162,9 @@ class ScenarioRecord:
         for _ in range(nmsgs):
             to = r.u32()
             msg = unmarshal_message(r)
-            key = (msg, msg.signature)
+            # Timeout deliveries carry no signature; key them by value
+            # alone (their dataclass equality covers every field).
+            key = (msg, getattr(msg, "signature", None))
             rec.messages.append((to, interned.setdefault(key, msg)))
         if version >= 3:
             nb = r.u32()

@@ -502,3 +502,25 @@ def test_burst_rejects_byzantine_signer():
     for r in sim.replicas:
         for logs in r.proc.state.prevote_logs.values():
             assert bad not in logs
+
+
+def test_record_replay_with_timeouts(tmp_path):
+    # Regression: dumps containing Timeout deliveries (any run that
+    # exercises liveness — offline proposers force propose timeouts)
+    # failed to LOAD because message interning read msg.signature, which
+    # Timeout events do not carry. Exactly the runs worth replaying.
+    # Replica 1 proposes height 1 round 0 ((h+r) % n), so taking it
+    # offline forces a propose timeout immediately.
+    sim = Simulation(n=4, target_height=3, seed=91, offline={1})
+    res = sim.run(max_steps=200_000)
+    assert res.completed
+    res.assert_safety()
+    from hyperdrive_tpu.messages import Timeout
+
+    assert any(isinstance(m, Timeout) for _, m in res.record.messages)
+
+    path = os.path.join(tmp_path, "timeouts.dump")
+    res.record.dump(path)
+    replayed = Simulation.replay(ScenarioRecord.load(path))
+    assert replayed.commits == res.commits
+    assert replayed.heights == res.heights
