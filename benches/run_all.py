@@ -133,7 +133,8 @@ def _wall_tracer():
 
 def _run_signed_burst(ver, heights: int, dedup: bool, seed: int,
                       device_tally: bool = False,
-                      max_steps: int = 50_000_000) -> dict:
+                      max_steps: int = 50_000_000,
+                      record: bool = True) -> dict:
     from hyperdrive_tpu.harness import Simulation
 
     sim = Simulation(
@@ -146,6 +147,7 @@ def _run_signed_burst(ver, heights: int, dedup: bool, seed: int,
         batch_verifier=ver,
         dedup_verify=dedup,
         device_tally=device_tally,
+        record=record,
     )
     wall_tr = _wall_tracer()
     for r in sim.replicas:

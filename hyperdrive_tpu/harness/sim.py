@@ -189,6 +189,19 @@ class ScenarioRecord:
             return cls.unmarshal(Reader(fh.read(), rem=1 << 30))
 
 
+class _Discard:
+    """Zero-cost message sink for record=False runs (append is a no-op,
+    so the hot loops keep a single unconditional call either way)."""
+
+    __slots__ = ()
+
+    def append(self, item) -> None:
+        pass
+
+
+_DISCARD = _Discard()
+
+
 @dataclass
 class SimulationResult:
     completed: bool
@@ -196,7 +209,7 @@ class SimulationResult:
     virtual_time: float
     heights: list[Height]
     commits: list[dict[Height, Value]]
-    record: ScenarioRecord
+    record: "ScenarioRecord | None"  # None when the run had record=False
     alive: list[bool]
 
     def assert_safety(self) -> None:
@@ -240,6 +253,7 @@ class Simulation:
         tally_check=None,
         payload_bytes: int = 0,
         dedup_reconstruct: bool = True,
+        record: bool = True,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -305,6 +319,13 @@ class Simulation:
         # list.pop(0) would make 256-replica x 10k-height runs quadratic).
         self.queue: list[tuple[int, object]] = []
         self._qhead = 0
+        # ``record=False`` turns off the replay recorder: every delivered
+        # message is otherwise retained for the dump/replay workflow, which
+        # at depth dominates memory (a 1,000-height 256-replica run holds
+        # 131M deliveries, ~12 GB — BENCH.md config 4 dedup_run_deep).
+        # Unrecorded runs report ``result.record = None`` so a dump
+        # attempt fails loudly instead of replaying an empty scenario.
+        self._record_on = record
         self.record = ScenarioRecord(
             seed=seed, n=n, f=self.f, target_height=target_height
         )
@@ -590,6 +611,7 @@ class Simulation:
             return self._run_burst(max_steps)
 
         steps = 0
+        record_messages = self.record.messages if self._record_on else _DISCARD
         while steps < max_steps and not self._completed():
             if self._qhead >= len(self.queue):
                 # Network drained: advance virtual time to the next timeout.
@@ -629,7 +651,7 @@ class Simulation:
 
             if self.delivery_cost:
                 self.clock.now += self.delivery_cost
-            self.record.messages.append((to, msg))
+            record_messages.append((to, msg))
             self.replicas[to].handle(msg)
 
         return SimulationResult(
@@ -638,7 +660,7 @@ class Simulation:
             virtual_time=self.clock.now,
             heights=[r.current_height() for r in self.replicas],
             commits=self.commits,
-            record=self.record,
+            record=self.record if self._record_on else None,
             alive=self.alive,
         )
 
@@ -690,7 +712,9 @@ class Simulation:
             # accumulated votes to keep its per-message order.
             delivered = 0
             per_replica: dict[int, list] = {}
-            record_messages = self.record.messages
+            record_messages = (
+                self.record.messages if self._record_on else _DISCARD
+            )
             for to, msg in batch:
                 steps += 1
                 if self.drop_rate and not isinstance(msg, Timeout):
@@ -715,7 +739,8 @@ class Simulation:
                 delivered += 1
             for to, msgs in per_replica.items():
                 self.replicas[to].handle_burst(msgs)
-            self.record.bursts.append(delivered)
+            if self._record_on:
+                self.record.bursts.append(delivered)
             self._settle()
 
         return SimulationResult(
@@ -724,7 +749,7 @@ class Simulation:
             virtual_time=self.clock.now,
             heights=[r.current_height() for r in self.replicas],
             commits=self.commits,
-            record=self.record,
+            record=self.record if self._record_on else None,
             alive=self.alive,
         )
 

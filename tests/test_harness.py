@@ -524,3 +524,22 @@ def test_record_replay_with_timeouts(tmp_path):
     replayed = Simulation.replay(ScenarioRecord.load(path))
     assert replayed.commits == res.commits
     assert replayed.heights == res.heights
+
+
+def test_record_false_runs_without_recorder():
+    # Long benchmark runs opt out of the replay recorder (its delivered-
+    # message list dominates memory at depth); semantics are unchanged
+    # and the result says so loudly via record=None.
+    on = Simulation(n=4, target_height=5, seed=23)
+    off = Simulation(n=4, target_height=5, seed=23, record=False)
+    r_on, r_off = on.run(), off.run()
+    assert r_on.completed and r_off.completed
+    assert r_off.commits == r_on.commits
+    assert r_off.record is None
+    assert not off.record.messages  # nothing was retained
+
+    bon = Simulation(n=4, target_height=5, seed=23, burst=True)
+    boff = Simulation(n=4, target_height=5, seed=23, burst=True, record=False)
+    b_on, b_off = bon.run(), boff.run()
+    assert b_off.commits == b_on.commits
+    assert b_off.record is None and not boff.record.bursts
