@@ -41,7 +41,7 @@ from hyperdrive_tpu.messages import (
     marshal_message,
     unmarshal_message,
 )
-from hyperdrive_tpu.replica import Replica, ReplicaOptions
+from hyperdrive_tpu.replica import Replica, ReplicaOptions, merge_drain
 from hyperdrive_tpu.testutil import (
     BroadcasterCallbacks,
     CatcherCallbacks,
@@ -254,6 +254,7 @@ class Simulation:
         payload_bytes: int = 0,
         dedup_reconstruct: bool = True,
         record: bool = True,
+        shared_superstep: Optional[bool] = None,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -333,6 +334,28 @@ class Simulation:
         self.burst = burst
         self.batch_verifier = batch_verifier
         self.dedup_verify = dedup_verify
+        #: Shared-superstep fast path: with no per-delivery adversary
+        #: (reorder/drops), every live replica receives the identical
+        #: broadcast sequence, so the superstep keeps ONE shared broadcast
+        #: list — one queue entry, one sort, one verify per broadcast
+        #: instead of one per delivery. Per-replica state stays honest:
+        #: each replica still filters, inserts, and cascades its own copy
+        #: of the window. Trajectories, records, and replays are identical
+        #: to the per-delivery path (the expansion happens at record time).
+        #: ``shared_superstep`` (like ``batch_ingest``) is a differential-
+        #: testing knob: None = auto (on whenever eligible), False forces
+        #: the per-delivery path so equivalence can be asserted run-for-run.
+        self._shared_mode = (
+            burst and not reorder and drop_rate == 0.0
+            if shared_superstep is None
+            else bool(shared_superstep)
+        )
+        if self._shared_mode and not (burst and not reorder and drop_rate == 0.0):
+            raise ValueError(
+                "shared_superstep=True requires burst mode with no "
+                "per-delivery adversary (reorder/drop_rate)"
+            )
+        self._shared: list = []
         #: Burst mode defaults to batched window ingestion (one rule
         #: cascade per window — see Process.ingest); pass False to force
         #: per-message dispatch for differential comparison.
@@ -385,6 +408,11 @@ class Simulation:
                 for i in range(n)
             ]
         self.record.signatories = list(self.signatories)
+        self._max_capacity = max_capacity
+        #: Sender -> tie-break index for the shared-lane sort; seeded with
+        #: the whitelist so it matches every replica's pre-registered mq
+        #: order map (replica.py registers signatories at construction).
+        self._order_pos = {s: v for v, s in enumerate(self.signatories)}
         if device_tally:
             from hyperdrive_tpu.ops.votegrid import VoteGrid
 
@@ -536,14 +564,24 @@ class Simulation:
 
         recipients = range(self.n)
 
-        def bcast(msg):
-            # Broadcast to all, including self (reference: 174-208). In
-            # signed mode the sender attaches its detached signature here —
-            # the outbound edge of the replica, like a real wire stack.
-            # zip+repeat builds the n delivery tuples in C.
-            if keypair is not None:
-                msg = keypair.sign_message(msg)
-            self.queue.extend(zip(recipients, repeat(msg, self.n)))
+        if self._shared_mode:
+            def bcast(msg):
+                # Shared-superstep mode: ONE queue entry per broadcast
+                # (to=-1 means "all live replicas"); the burst loop
+                # expands it for accounting/recording and appends the
+                # message once to the shared lane.
+                if keypair is not None:
+                    msg = keypair.sign_message(msg)
+                self.queue.append((-1, msg))
+        else:
+            def bcast(msg):
+                # Broadcast to all, including self (reference: 174-208). In
+                # signed mode the sender attaches its detached signature here —
+                # the outbound edge of the replica, like a real wire stack.
+                # zip+repeat builds the n delivery tuples in C.
+                if keypair is not None:
+                    msg = keypair.sign_message(msg)
+                self.queue.extend(zip(recipients, repeat(msg, self.n)))
 
         # The owned clock tags each scheduled timeout with its owner index so
         # the delivery queue can route the fired event back to that replica.
@@ -711,10 +749,61 @@ class Simulation:
             # their delivery clock point, after flushing that replica's
             # accumulated votes to keep its per-message order.
             delivered = 0
-            per_replica: dict[int, list] = {}
             record_messages = (
                 self.record.messages if self._record_on else _DISCARD
             )
+            if self._shared_mode:
+                # Shared-superstep path: a (-1, msg) entry is one broadcast
+                # to every live replica. Accounting (steps, clock, record,
+                # burst sizes) expands per delivery exactly as the
+                # per-delivery loop would — broadcast-major, ascending
+                # replica order — but the message itself is appended ONCE
+                # to the shared lane; _settle filters/inserts it per
+                # replica (the per-sender fast-lane capacity is applied
+                # there, height-aware, matching delivery-time accounting).
+                alive = self.alive
+                live = [i for i in range(self.n) if alive[i]]
+                nlive = len(live)
+                shared = self._shared
+                cost = self.delivery_cost
+                tracer = self.tracer
+                for to, msg in batch:
+                    if to < 0:
+                        steps += self.n
+                        if not nlive:
+                            continue
+                        if cost:
+                            self.clock.now += cost * nlive
+                        if record_messages is not _DISCARD:
+                            for i in live:
+                                record_messages.append((i, msg))
+                        delivered += nlive
+                        t = type(msg)
+                        tracer.count(
+                            "replica.msg.prevote" if t is Prevote
+                            else "replica.msg.precommit" if t is Precommit
+                            else "replica.msg.propose",
+                            nlive,
+                        )
+                        shared.append(msg)
+                        continue
+                    steps += 1
+                    if not alive[to]:
+                        continue
+                    if cost:
+                        self.clock.now += cost
+                    record_messages.append((to, msg))
+                    self.replicas[to].handle(msg)
+                    delivered += 1
+                    # A targeted event (timeout/reset) may kill nobody but
+                    # never changes aliveness; live stays valid.
+                if self._record_on:
+                    self.record.bursts.append(delivered)
+                shared_batch = self._shared
+                self._shared = []
+                self._settle(shared_batch)
+                continue
+            per_replica: dict[int, list] = {}
             for to, msg in batch:
                 steps += 1
                 if self.drop_rate and not isinstance(msg, Timeout):
@@ -753,62 +842,191 @@ class Simulation:
             alive=self.alive,
         )
 
-    def _settle(self) -> None:
+    def _settle(self, shared: "list | None" = None) -> None:
         """Drain every live replica's window, verify ALL windows in one
         aggregated ``batch_verifier`` launch, dispatch the survivors; repeat
         until the network is quiescent — the flush-until-quiescent contract
-        (reference: replica/replica.go:251-264) lifted to the superstep."""
+        (reference: replica/replica.go:251-264) lifted to the superstep.
+
+        ``shared`` is the shared-superstep broadcast lane (one entry per
+        broadcast; every live replica receives the same sequence). The
+        first pass sorts it once, verifies it once, and hands every
+        lockstep replica the SAME window list; later passes fall back to
+        per-replica drains for whatever the cascade made newly eligible.
+        """
         while True:
-            windows: list[tuple[int, list]] = []
-            for i, r in enumerate(self.replicas):
-                if not self.alive[i]:
-                    continue
-                w = r.drain_pending()
-                if w:
-                    windows.append((i, w))
+            shared_window = None
+            if shared:
+                shared_window, windows = self._shared_windows(shared)
+                shared = None
+            else:
+                shared = None
+                windows = []
+                for i, r in enumerate(self.replicas):
+                    if not self.alive[i]:
+                        continue
+                    w = r.drain_pending()
+                    if w:
+                        windows.append((i, w))
             if not windows:
                 return
-            keeps: list = [None] * len(windows)
-            if self.batch_verifier is not None and self.dedup_verify:
-                # One lane per distinct broadcast. The same message OBJECT
-                # fans out to all receivers, so identity keying suffices —
-                # no 128-byte tuple keys, no per-delivery digest calls.
-                # (Two equal-content distinct objects would just occupy two
-                # lanes; verification is deterministic so verdicts agree.
-                # The window lists keep every object alive, so ids are
-                # stable for the duration of the pass.)
-                index: dict[int, int] = {}
-                items = []
-                slots: list[list[int]] = []
-                for _, w in windows:
-                    row = []
-                    for m in w:
-                        j = index.get(id(m))
-                        if j is None:
-                            j = index[id(m)] = len(items)
-                            items.append((m.sender, m.digest(), m.signature))
-                        row.append(j)
-                    slots.append(row)
-                self.tracer.observe("sim.verify.launch", len(items))
-                mask = self.batch_verifier.verify_signatures(items)
-                keeps = [[mask[j] for j in row] for row in slots]
-            elif self.batch_verifier is not None:
-                items = [
-                    (m.sender, m.digest(), m.signature)
-                    for _, w in windows
-                    for m in w
-                ]
-                self.tracer.observe("sim.verify.launch", len(items))
-                mask = self.batch_verifier.verify_signatures(items)
-                off = 0
-                for wi, (i, w) in enumerate(windows):
-                    keeps[wi] = mask[off : off + len(w)]
-                    off += len(w)
+            keeps = self._verify_windows(windows, shared_window)
             if self.device_tally:
                 self._dispatch_tallied(windows, keeps)
             else:
                 for (i, w), keep in zip(windows, keeps):
                     self.replicas[i].dispatch_window(w, keep)
+
+    def _order_key(self, sender) -> int:
+        """The sim-level sender tie-break index: whitelist order for
+        signatories (identical to every replica's pre-registered mq order),
+        first-seen registration after that."""
+        o = self._order_pos.get(sender)
+        if o is None:
+            o = self._order_pos[sender] = len(self._order_pos)
+        return o
+
+    def _shared_windows(self, shared: list):
+        """Turn the superstep's shared broadcast lane into per-replica
+        windows. One global sort by the drain contract's key — ascending
+        (height, round), senders tie-broken by registration order, arrival
+        FIFO within ties (sort stability). Lockstep replicas (backlog-free,
+        window entirely at their height — the overwhelmingly common case)
+        share the sorted list itself; stragglers get a per-replica split:
+        current-height rows merge with their drained backlog, future rows
+        buffer into their mq exactly as delivery-time filtering would."""
+        okey = self._order_key
+        # Per-sender fast-lane capacity, height-aware, in ARRIVAL order —
+        # exactly the per-delivery path's lane accounting (only messages at
+        # a replica's current height consume its budget; future-height
+        # messages ride to the mq, whose own capacity applies there). A
+        # sender can only exceed the cap when the superstep holds more than
+        # ``cap`` broadcasts total, so the common case pays one length
+        # check. Capped-out rows are resolved lazily per distinct replica
+        # height (lockstep replicas share one computation).
+        cap = self._max_capacity
+        dropped_for: dict = {}
+        if len(shared) > cap:
+            arrival = list(shared)
+
+            def dropped_at(cur) -> set:
+                d = dropped_for.get(cur)
+                if d is None:
+                    d = dropped_for[cur] = set()
+                    counts: dict = {}
+                    for m in arrival:
+                        if m.height == cur:
+                            c = counts.get(m.sender, 0)
+                            if c >= cap:
+                                d.add(id(m))
+                            else:
+                                counts[m.sender] = c + 1
+                return d
+        else:
+            def dropped_at(cur) -> set:
+                return ()
+
+        shared.sort(key=lambda m: (m.height, m.round, okey(m.sender)))
+        hmin = shared[0].height
+        hmax = shared[-1].height
+        windows: list[tuple[int, list]] = []
+        shared_capped: dict = {}  # cur -> capped shared list (lockstep case)
+        for i, r in enumerate(self.replicas):
+            if not self.alive[i]:
+                continue
+            cur = r.proc.current_height
+            plain = not r._lane and not r.mq.has_eligible(cur)
+            if plain and hmin == hmax == cur:
+                if len(shared) <= cap:
+                    windows.append((i, shared))
+                    continue
+                w = shared_capped.get(cur)
+                if w is None:
+                    d = dropped_at(cur)
+                    w = shared_capped[cur] = [
+                        m for m in shared if id(m) not in d
+                    ]
+                windows.append((i, w))
+                continue
+            d = dropped_at(cur)
+            cur_rows: list = []
+            for m in shared:
+                h = m.height
+                if h == cur:
+                    if id(m) not in d:
+                        cur_rows.append(m)
+                elif h > cur:
+                    t = type(m)
+                    if t is Prevote:
+                        r.mq.insert_prevote(m)
+                    elif t is Precommit:
+                        r.mq.insert_precommit(m)
+                    else:
+                        r.mq.insert_propose(m)
+            w = merge_drain(r.drain_pending(), cur_rows, okey)
+            if w:
+                windows.append((i, w))
+        return shared, windows
+
+    def _verify_windows(self, windows, shared_window=None) -> list:
+        """One aggregated verification launch for a settle pass's windows;
+        returns the per-window keep masks (None entries = no verifier)."""
+        keeps: list = [None] * len(windows)
+        if self.batch_verifier is None:
+            return keeps
+        if self.dedup_verify:
+            # One lane per distinct broadcast. The same message OBJECT
+            # fans out to all receivers, so identity keying suffices —
+            # no 128-byte tuple keys, no per-delivery digest calls.
+            # (Two equal-content distinct objects would just occupy two
+            # lanes; verification is deterministic so verdicts agree.
+            # The window lists keep every object alive, so ids are
+            # stable for the duration of the pass.) Windows that ARE the
+            # shared list skip the keying entirely: their keep mask is the
+            # mask's shared prefix, one list reused by every replica.
+            index: dict[int, int] = {}
+            items: list = []
+            shared_len = 0
+            if shared_window is not None:
+                items = [
+                    (m.sender, m.digest(), m.signature) for m in shared_window
+                ]
+                shared_len = len(items)
+                for j, m in enumerate(shared_window):
+                    index[id(m)] = j
+            slots: list = []
+            for _, w in windows:
+                if w is shared_window:
+                    slots.append(None)
+                    continue
+                row = []
+                for m in w:
+                    j = index.get(id(m))
+                    if j is None:
+                        j = index[id(m)] = len(items)
+                        items.append((m.sender, m.digest(), m.signature))
+                    row.append(j)
+                slots.append(row)
+            self.tracer.observe("sim.verify.launch", len(items))
+            mask = self.batch_verifier.verify_signatures(items)
+            mask = mask.tolist() if hasattr(mask, "tolist") else list(mask)
+            shared_keep = (
+                mask if shared_len == len(mask) else mask[:shared_len]
+            )
+            for wi, row in enumerate(slots):
+                keeps[wi] = shared_keep if row is None else [mask[j] for j in row]
+        else:
+            items = []
+            bounds = []
+            for _, w in windows:
+                start = len(items)
+                items.extend((m.sender, m.digest(), m.signature) for m in w)
+                bounds.append((start, len(items)))
+            self.tracer.observe("sim.verify.launch", len(items))
+            mask = self.batch_verifier.verify_signatures(items)
+            mask = mask.tolist() if hasattr(mask, "tolist") else list(mask)
+            keeps = [mask[a:b] for a, b in bounds]
+        return keeps
 
     def _dispatch_tallied(self, windows, keeps) -> None:
         """Device-tally dispatch: insert every window, scatter the accepted

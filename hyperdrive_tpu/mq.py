@@ -241,6 +241,13 @@ class MessageQueue:
             self._register_head(sender)
         return out
 
+    def has_eligible(self, height: Height) -> bool:
+        """True iff some queued message has height <= ``height`` — an O(1)
+        peek the burst settle uses to skip the drain/merge machinery for
+        replicas with an empty backlog (the common case)."""
+        head = self._peek_head()
+        return head is not None and head[0] <= height
+
     def drain_all(self, height: Height) -> list[Message]:
         """Pop EVERY eligible message (height <= ``height``) in the same
         global ascending (height, round) order as :meth:`drain_window`.

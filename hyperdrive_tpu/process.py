@@ -259,23 +259,85 @@ class Process:
         """
         commit_rounds = set()
         vote_rounds = set()
+        # The vote inserts are inlined from State.add_prevote/add_precommit
+        # (same semantics, property-tested equivalent): at 256 validators a
+        # settle window is ~512 votes x 256 replicas, and the per-message
+        # call chain (_insert_* -> State.add_*) costs more than the dict
+        # operations themselves. Windows arrive (height, round)-sorted, so
+        # the per-round dict views are cached across consecutive messages.
+        st = self.state
+        cur_h = st.current_height
+        catcher = self.catcher
+        traces = st.trace_logs
+        last_rnd = None
+        last_is_pc = None
+        votes = counts = trace = None
         for msg in msgs:
             t = type(msg)
             if t is Prevote:
-                if self._insert_prevote(msg):
-                    vote_rounds.add(msg.round)
-                    if on_accepted is not None:
-                        on_accepted(msg, False)
+                if msg.height != cur_h:
+                    continue
+                rnd = msg.round
+                if rnd != last_rnd or last_is_pc is not False:
+                    last_rnd, last_is_pc = rnd, False
+                    votes = st.prevote_logs.get(rnd)
+                    if votes is None:
+                        votes = st.prevote_logs[rnd] = {}
+                    counts = st.prevote_counts.get(rnd)
+                    if counts is None:
+                        counts = st.prevote_counts[rnd] = {}
+                    trace = traces.get(rnd)
+                    if trace is None:
+                        trace = traces[rnd] = set()
+                sender = msg.sender
+                existing = votes.get(sender)
+                if existing is not None:
+                    if msg != existing and catcher is not None:
+                        catcher.catch_double_prevote(msg, existing)
+                    continue
+                votes[sender] = msg
+                v = msg.value
+                counts[v] = counts.get(v, 0) + 1
+                trace.add(sender)
+                vote_rounds.add(rnd)
+                if on_accepted is not None:
+                    on_accepted(msg, False)
             elif t is Precommit:
-                if self._insert_precommit(msg):
-                    vote_rounds.add(msg.round)
-                    commit_rounds.add(msg.round)
-                    if on_accepted is not None:
-                        on_accepted(msg, True)
+                if msg.height != cur_h:
+                    continue
+                rnd = msg.round
+                if rnd != last_rnd or last_is_pc is not True:
+                    last_rnd, last_is_pc = rnd, True
+                    votes = st.precommit_logs.get(rnd)
+                    if votes is None:
+                        votes = st.precommit_logs[rnd] = {}
+                    counts = st.precommit_counts.get(rnd)
+                    if counts is None:
+                        counts = st.precommit_counts[rnd] = {}
+                    trace = traces.get(rnd)
+                    if trace is None:
+                        trace = traces[rnd] = set()
+                sender = msg.sender
+                existing = votes.get(sender)
+                if existing is not None:
+                    if msg != existing and catcher is not None:
+                        catcher.catch_double_precommit(msg, existing)
+                    continue
+                votes[sender] = msg
+                v = msg.value
+                counts[v] = counts.get(v, 0) + 1
+                trace.add(sender)
+                vote_rounds.add(rnd)
+                commit_rounds.add(rnd)
+                if on_accepted is not None:
+                    on_accepted(msg, True)
             else:
                 if self._insert_propose(msg):
                     vote_rounds.add(msg.round)
                     commit_rounds.add(msg.round)
+                # The propose insert may have touched the cached round's
+                # trace set; invalidate so the next vote re-fetches.
+                last_rnd = None
         return (commit_rounds, vote_rounds)
 
     def ingest_cascade(self, plan, tallies=None) -> None:

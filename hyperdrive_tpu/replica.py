@@ -54,7 +54,40 @@ from hyperdrive_tpu.scheduler import RoundRobin
 from hyperdrive_tpu.state import State
 from hyperdrive_tpu.types import DEFAULT_HEIGHT, Height, MessageType, Round, Signatory, Step
 
-__all__ = ["Replica", "ReplicaOptions", "ResetHeight"]
+__all__ = ["Replica", "ReplicaOptions", "ResetHeight", "merge_drain"]
+
+
+def merge_drain(backlog: list, fresh: list, order_of) -> list:
+    """Merge two message lists under the drain ordering contract: global
+    ascending (height, round), senders tie-broken by ``order_of``
+    registration order, ``backlog`` entries preceding ``fresh`` on full
+    ties (backlog predates by construction), FIFO within each list.
+
+    Shared by :meth:`Replica.drain_pending` (queue backlog + fast lane)
+    and the harness's shared-superstep window builder (queue backlog +
+    shared broadcast lane) — the run-for-run equivalence of the two burst
+    paths depends on them merging identically, so there is exactly one
+    implementation of the contract.
+    """
+    if not fresh:
+        return backlog
+    if not backlog:
+        fresh = [
+            (m.height, m.round, order_of(m.sender), j, m)
+            for j, m in enumerate(fresh)
+        ]
+        fresh.sort()
+        return [t[4] for t in fresh]
+    keyed = [
+        (m.height, m.round, order_of(m.sender), 0, j, m)
+        for j, m in enumerate(backlog)
+    ]
+    keyed += [
+        (m.height, m.round, order_of(m.sender), 1, j, m)
+        for j, m in enumerate(fresh)
+    ]
+    keyed.sort()
+    return [t[5] for t in keyed]
 
 #: Precomputed metric names — the dispatch path must not pay string
 #: formatting per message.
@@ -154,6 +187,14 @@ class Replica:
         )
         self.procs_allowed: set[Signatory] = set(signatories)
         self.mq = MessageQueue(max_capacity=opts.max_capacity)
+        # Pre-register the whitelist in the queue's tie-break order map:
+        # "senders tie-broken by registration order" then means whitelist
+        # order — identical across replicas and across driving modes — so a
+        # burst run, its replay, and the lock-step differential all merge
+        # equal-(height, round) messages identically. (Unknown senders still
+        # register on first use, after the whitelist block.)
+        for s in signatories:
+            self.mq.order_of(s)
         self.did_handle_message = did_handle_message
         self.verifier = verifier
         self._inbox: _queue.Queue = _queue.Queue(maxsize=opts.max_capacity)
@@ -191,10 +232,12 @@ class Replica:
                 if replica._last_commit_time is not None:
                     t.observe("replica.height.latency", now - replica._last_commit_time)
                 replica._last_commit_time = now
-                replica.logger.info(
-                    "commit %s",
-                    _kv(height=height, round=replica.proc.current_round, value=value),
-                )
+                if replica.logger.isEnabledFor(20):  # INFO — kv() is eager
+                    replica.logger.info(
+                        "commit %s",
+                        _kv(height=height, round=replica.proc.current_round,
+                            value=value),
+                    )
                 return committer.commit(height, value)
 
         return _TracingCommitter()
@@ -448,25 +491,7 @@ class Replica:
             return backlog
         self._lane = []
         self._lane_counts = {}
-        order_of = self.mq.order_of
-        if not backlog:
-            # Lane-only: every message is at the current height.
-            keyed = [
-                (m.round, order_of(m.sender), j, m)
-                for j, m in enumerate(lane)
-            ]
-            keyed.sort()
-            return [t[3] for t in keyed]
-        keyed = [
-            (m.height, m.round, order_of(m.sender), 0, j, m)
-            for j, m in enumerate(backlog)
-        ]
-        keyed += [
-            (m.height, m.round, order_of(m.sender), 1, j, m)
-            for j, m in enumerate(lane)
-        ]
-        keyed.sort()
-        return [t[5] for t in keyed]
+        return merge_drain(backlog, lane, self.mq.order_of)
 
     def dispatch_window(self, window, keep=None) -> None:
         """Phase 2: feed the verified survivors of ``window`` to the Process.

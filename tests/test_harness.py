@@ -7,6 +7,8 @@ deterministic record/replay of a full run.
 
 import os
 
+import pytest
+
 from hyperdrive_tpu.harness import ScenarioRecord, Simulation
 
 
@@ -286,6 +288,48 @@ def test_burst_differential_modes_agree_and_replay_preserves_mode(tmp_path):
     replayed = Simulation.replay(loaded)
     assert replayed.commits == serial.commits
     assert replayed.heights == serial.heights
+
+
+def test_shared_superstep_is_delivery_for_delivery_identical():
+    # The shared-superstep fast path (one queue entry / one sort / one
+    # verify per broadcast) must reproduce the per-delivery burst path
+    # EXACTLY: same step count, same recorded delivery stream, same burst
+    # boundaries, same commits — trajectory equality, not just agreement.
+    kw = dict(n=7, target_height=6, seed=83, burst=True, sign=True)
+    fast = Simulation(**kw)
+    assert fast._shared_mode
+    fres = fast.run()
+    slow = Simulation(**kw, shared_superstep=False)
+    assert not slow._shared_mode
+    sres = slow.run()
+    assert fres.completed and sres.completed
+    assert fres.steps == sres.steps
+    assert fres.virtual_time == sres.virtual_time
+    assert fres.commits == sres.commits
+    assert fres.record.bursts == sres.record.bursts
+    assert fres.record.messages == sres.record.messages
+    fres.assert_safety()
+
+
+def test_shared_superstep_identical_under_tight_lane_capacity():
+    # Near max_capacity the two burst paths must still agree delivery for
+    # delivery: the shared lane applies the per-sender fast-lane cap
+    # height-aware at settle time, exactly as delivery-time accounting
+    # would (a commit-boundary superstep mixes heights, so a height-blind
+    # cap would drop different messages than the per-delivery path).
+    kw = dict(n=4, target_height=4, seed=87, burst=True, max_capacity=2)
+    fres = Simulation(**kw).run(max_steps=100_000)
+    sres = Simulation(**kw, shared_superstep=False).run(max_steps=100_000)
+    assert fres.steps == sres.steps
+    assert fres.commits == sres.commits
+    assert fres.record.messages == sres.record.messages
+    fres.assert_safety()
+
+
+def test_shared_superstep_rejected_under_per_delivery_adversary():
+    with pytest.raises(ValueError):
+        Simulation(n=4, target_height=2, seed=1, burst=True, reorder=True,
+                   shared_superstep=True)
 
 
 def test_device_tally_matches_host_and_is_exercised():
