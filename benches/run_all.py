@@ -137,18 +137,25 @@ def _run_signed_burst(ver, heights: int, dedup: bool, seed: int,
                       record: bool = True) -> dict:
     from hyperdrive_tpu.harness import Simulation
 
-    sim = Simulation(
-        n=256,
-        target_height=heights,
-        seed=seed,
-        timeout=20.0,
-        sign=True,
-        burst=True,
-        batch_verifier=ver,
-        dedup_verify=dedup,
-        device_tally=device_tally,
-        record=record,
-    )
+    def build(h, rec):
+        return Simulation(
+            n=256,
+            target_height=h,
+            seed=seed,
+            timeout=20.0,
+            sign=True,
+            burst=True,
+            batch_verifier=ver,
+            dedup_verify=dedup,
+            device_tally=device_tally,
+            record=rec,
+        )
+
+    # 2-height warm pass: compiles whatever this mode launches (the fused
+    # verify+scatter+tally kernel in device-tally mode) outside the timed
+    # region, mirroring ver.warmup() for the plain verify kernels.
+    build(2, False).run(max_steps=max_steps)
+    sim = build(heights, record)
     wall_tr = _wall_tracer()
     for r in sim.replicas:
         r.tracer = wall_tr

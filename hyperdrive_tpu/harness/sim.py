@@ -416,12 +416,29 @@ class Simulation:
         if device_tally:
             from hyperdrive_tpu.ops.votegrid import VoteGrid
 
-            self.vote_grid = VoteGrid(n, len(self.signatories))
+            # 4 round slots: covers the happy path plus three retry
+            # rounds on device; deeper rounds (rare) fall back to the
+            # authoritative host counters. Halving the slot window halves
+            # the grid tensors and every launch's transfer.
+            self.vote_grid = VoteGrid(n, len(self.signatories), r_slots=4)
             self._grid_height = [-1] * n
             self._grid_dirty: list[set] = [set() for _ in range(n)]
             self._sender_pos = {
                 s: v for v, s in enumerate(self.signatories)
             }
+            #: Fused verify+scatter+tally (ONE device round trip per
+            #: settle, same as the verify-only baseline): available when
+            #: the verifier exposes its traceable kernel and the run
+            #: dedups verification (shared verdicts = shared scatter).
+            self._fused_ok = (
+                self._shared_mode
+                and dedup_verify
+                and hasattr(self.batch_verifier, "fused_inner")
+                and hasattr(getattr(self.batch_verifier, "host", None),
+                            "pack")
+            )
+            if self._fused_ok:
+                self.vote_grid.attach_fused(self.batch_verifier.fused_inner)
         self.payload_bytes = payload_bytes
         self.dedup_reconstruct = dedup_reconstruct
         self._bundle_cache: dict[Value, bytes] = {}
@@ -459,6 +476,18 @@ class Simulation:
                     verifier_for(i) if verifier_for else None,
                 )
             )
+        if device_tally:
+            # The grid answers the hot quorum queries; the host keeps the
+            # logs (checkpoints, evidence) but skips the derived per-value
+            # tally dicts — declined queries fall back to State.count_*'s
+            # log scan.
+            for r in self.replicas:
+                r.proc.host_counts = False
+            # Whitelist identity snapshot: a replica whose procs_allowed
+            # was replaced (signatory rotation) can no longer ride the
+            # shared scatter (its accept filter diverged from the grid's
+            # validator axis), so the fused path checks identity.
+            self._allowed_objs = [r.procs_allowed for r in self.replicas]
 
     # ------------------------------------------------------------- wiring
 
@@ -870,6 +899,29 @@ class Simulation:
                         windows.append((i, w))
             if not windows:
                 return
+            if (
+                shared_window is not None
+                and self.device_tally
+                and self._fused_ok
+                and len(shared_window)
+                <= self.batch_verifier.host.buckets[-1]
+                and all(w is shared_window for _, w in windows)
+                and all(
+                    self.replicas[i].procs_allowed is self._allowed_objs[i]
+                    for i, _ in windows
+                )
+            ):
+                if self._dispatch_fused(shared_window, windows):
+                    continue
+                # Vote-free window (the propose settle): verification is
+                # still needed, but there is nothing to scatter or tally —
+                # skip the grid entirely (reset defers to the height's
+                # first vote-bearing settle) and cascade on host fallback,
+                # whose logs are near-empty this early in the height.
+                keeps = self._verify_windows(windows, shared_window)
+                for (i, w), keep in zip(windows, keeps):
+                    self.replicas[i].dispatch_window(w, keep)
+                continue
             keeps = self._verify_windows(windows, shared_window)
             if self.device_tally:
                 self._dispatch_tallied(windows, keeps)
@@ -1152,6 +1204,195 @@ class Simulation:
             if self._tally_check is not None:
                 view = self._tally_check(view, self.replicas[i].proc)
             self.replicas[i].ingest_cascade_window(plan, view)
+
+    def _dispatch_fused(self, shared, windows) -> None:
+        """Device-tally settle in ONE launch: Ed25519-verify the shared
+        window, scatter the verified votes into every lockstep replica's
+        grid (presence-guarded, shared rows), tally — then the host inserts
+        with the mask and cascades against the counts. The settle pays a
+        single blocking sync (the mask), exactly what the verify-only
+        baseline pays; the packed counts ride the same async copy and are
+        ready by cascade time.
+
+        Eligibility (checked by the caller): shared-superstep lockstep
+        (every window IS the shared list), dedup verification, single-chip
+        grid, un-rotated whitelists, window within one verify bucket.
+        """
+        from hyperdrive_tpu.ops.tally import pack_value
+        from hyperdrive_tpu.ops.votegrid import TallyView
+
+        grid = self.vote_grid
+        R = grid.R
+        n = self.n
+        h = shared[0].height
+
+        if not any(
+            type(m) is Prevote or type(m) is Precommit for m in shared
+        ):
+            # No votes anywhere in the window: nothing can scatter and no
+            # count can have changed — tell the caller to run the
+            # verify-only settle. Grid heights stay stale on purpose; the
+            # next vote-bearing settle's reset brings them forward.
+            return False
+
+        items = [(m.sender, m.digest(), m.signature) for m in shared]
+        self.tracer.observe("sim.verify.launch", len(items))
+        arrays, prevalid, nitems = self.batch_verifier.host.pack(items)
+
+        # The dense one-superstep update image: one lane per (plane,
+        # round, validator), first parseable claimant wins (the host's
+        # first-wins insert rule); conflicting claims poison the round for
+        # this height (host counters stay authoritative there). Proposes
+        # aren't scattered — they feed the target prediction below.
+        upd_lane = np.full((2, R, grid.V), -1, dtype=np.int32)
+        upd_vals = np.zeros((2, R, grid.V, 8), dtype=np.int32)
+        k = 0
+        hazard: set = set()
+        win_props: dict = {}
+        sender_pos = self._sender_pos
+        for j, m in enumerate(shared):
+            t = type(m)
+            if t is Prevote:
+                plane = 0
+            elif t is Precommit:
+                plane = 1
+            else:
+                rnd = m.round
+                if 0 <= rnd < R:
+                    win_props[rnd] = None if rnd in win_props else m
+                continue
+            rnd = m.round
+            if rnd < 0 or rnd >= R:
+                continue
+            v = sender_pos.get(m.sender)
+            if v is None:
+                # Whitelisted sender outside the grid's validator axis
+                # (post-rotation): the device count would diverge.
+                hazard.add((plane, rnd))
+                continue
+            if upd_lane[plane, rnd, v] >= 0:
+                hazard.add((plane, rnd))
+                continue
+            if not prevalid[j]:
+                # Unparseable signature: the host rejects it
+                # deterministically; the lane stays unclaimed for a later
+                # well-formed row.
+                continue
+            upd_lane[plane, rnd, v] = j
+            upd_vals[plane, rnd, v] = np.frombuffer(m.value, dtype="<i4")
+            k += 1
+        self.tracer.observe("sim.tally.launch", k)
+
+        # Per-replica launch metadata. Targets come from PRE-insert propose
+        # logs plus this window's (schedule-checked) proposes — identical
+        # to the post-insert logs except when a window propose fails
+        # verification, in which case the host log stays empty at that
+        # round and the cascade never queries it.
+        reset = np.zeros(n, dtype=bool)
+        participate = np.zeros(n, dtype=bool)
+        targets = np.zeros((n, R, 8), dtype=np.int32)
+        tvalid = np.zeros((n, R), dtype=bool)
+        l28_slot = np.full(n, -1, dtype=np.int32)
+        l28_target = np.zeros((n, 8), dtype=np.int32)
+        fs = np.zeros(n, dtype=np.int32)
+        tmaps: dict[int, dict] = {}
+        l28_vals: dict[int, bytes] = {}
+        # Lockstep replicas almost always share identical propose logs
+        # (the very same broadcast objects), so the target row is computed
+        # once and fanned out; any replica that diverges (or any window
+        # with in-flight proposes, whose schedule check is per-replica
+        # scheduler state) gets the full per-replica build.
+        ref = None  # (logs, round, trow, tvalid_row, tmap, l28s, l28t, l28v)
+        for i, _ in windows:
+            participate[i] = True
+            if self._grid_height[i] != h:
+                reset[i] = True
+                self._grid_height[i] = h
+                self._grid_dirty[i] = set()
+            dirty = self._grid_dirty[i]
+            dirty.update(hazard)
+            proc = self.replicas[i].proc
+            st = proc.state
+            fs[i] = proc.f
+            if (
+                ref is not None
+                and not win_props
+                and st.propose_logs == ref[0]
+                and st.current_round == ref[1]
+            ):
+                targets[i] = ref[2]
+                tvalid[i] = ref[3]
+                tmaps[i] = ref[4]
+                l28_slot[i] = ref[5]
+                l28_target[i] = ref[6]
+                if ref[7] is not None:
+                    l28_vals[i] = ref[7]
+                continue
+            tmap: dict = {}
+            for rnd, p in st.propose_logs.items():
+                if 0 <= rnd < R:
+                    targets[i, rnd] = pack_value(p.value)
+                    tvalid[i, rnd] = True
+                    tmap[rnd] = p.value
+            scheduler = proc.scheduler
+            for rnd, wp in win_props.items():
+                if rnd in tmap:
+                    continue  # logged propose wins; window dup is rejected
+                if wp is None:
+                    # Conflicting window proposes: the accepted one depends
+                    # on per-row verdicts; don't predict.
+                    dirty.add((0, rnd))
+                    dirty.add((1, rnd))
+                    continue
+                if scheduler is not None and scheduler.schedule(
+                    h, rnd
+                ) != wp.sender:
+                    continue  # out-of-turn: host rejects it
+                targets[i, rnd] = pack_value(wp.value)
+                tvalid[i, rnd] = True
+                tmap[rnd] = wp.value
+            tmaps[i] = tmap
+            cur = st.propose_logs.get(st.current_round)
+            if cur is not None and 0 <= cur.valid_round < R:
+                l28_slot[i] = cur.valid_round
+                l28_target[i] = pack_value(cur.value)
+                l28_vals[i] = cur.value
+            if ref is None and not win_props:
+                ref = (
+                    st.propose_logs, st.current_round, targets[i].copy(),
+                    tvalid[i].copy(), tmap, int(l28_slot[i]),
+                    l28_target[i].copy(), l28_vals.get(i),
+                )
+
+        fused_out = grid.fused_update_and_tally(
+            arrays, upd_lane, upd_vals, reset, participate,
+            targets, tvalid, l28_slot, l28_target, fs,
+        )
+        # The settle's ONE blocking sync: mask and packed counts arrive in
+        # the same transfer.
+        keep = (fused_out.mask() & prevalid)[:nitems].tolist()
+        counts = fused_out.counts()
+
+        plans = []
+        for i, w in windows:
+            plans.append(
+                (i, self.replicas[i].ingest_insert_window(w, keep))
+            )
+        for i, plan in plans:
+            view = TallyView(
+                i,
+                h,
+                counts,
+                R,
+                tmaps[i],
+                int(l28_slot[i]),
+                l28_vals.get(i, b""),
+                dirty=self._grid_dirty[i],
+            )
+            if self._tally_check is not None:
+                view = self._tally_check(view, self.replicas[i].proc)
+            self.replicas[i].ingest_cascade_window(plan, view)
+        return True
 
     # -------------------------------------------------------------- replay
 

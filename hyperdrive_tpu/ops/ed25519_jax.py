@@ -653,6 +653,16 @@ def rlc_scalars(s_nib, k_nib, prevalid, binder: bytes):
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _pallas_padded_verify(block: int):
+    """Identity-stable (cached) padding wrapper around ``verify_pallas``
+    for one block size — consumers embed it in larger jits (the fused
+    vote-grid kernel), whose compile caches key on callable identity."""
+    from hyperdrive_tpu.ops.ed25519_pallas import verify_pallas
+
+    return functools.partial(verify_pallas, block=block)
+
+
 class TpuBatchVerifier:
     """Drop-in Verifier (see :mod:`hyperdrive_tpu.verifier`) that batches a
     whole mq drain window into one device launch.
@@ -684,14 +694,32 @@ class TpuBatchVerifier:
     def _device_verify(self, arrays):
         dev_in = [jnp.asarray(a) for a in arrays]
         if self.backend == "pallas":
-            from hyperdrive_tpu.ops.ed25519_pallas import _BLOCK, verify_pallas
-
-            # Small buckets keep a matching block so a 64-signature window
-            # is not padded to 256 lanes (4x the ladder work on the
-            # latency-sensitive windows).
-            block = min(_BLOCK, dev_in[0].shape[0])
-            return verify_pallas(*dev_in, block=block)
+            return self._pallas_verify(dev_in[0].shape[0])(*dev_in)
         return self._fn(*dev_in)
+
+    @staticmethod
+    def _pallas_block(batch: int) -> int:
+        """Small buckets keep a matching block so a 64-signature window is
+        not padded to 256 lanes (4x the ladder work on the latency-
+        sensitive windows) — but never below 128: sub-128-lane blocks are
+        under the TPU tile width and outside the measured sweep, so a
+        64-lane bucket runs one 128-lane block with verify_pallas's
+        padding absorbing the tail."""
+        from hyperdrive_tpu.ops.ed25519_pallas import _BLOCK
+
+        return min(_BLOCK, max(batch, 128))
+
+    def _pallas_verify(self, batch: int):
+        return _pallas_padded_verify(self._pallas_block(batch))
+
+    def fused_inner(self, batch: int):
+        """The traceable batch-verify callable ((ax..k_nib) -> bool[B]) for
+        composition inside a larger jit — the vote grid's fused
+        verify+scatter+tally launch embeds it so a settle pass pays one
+        device round trip for signatures AND quorum counts."""
+        if self.backend == "pallas":
+            return self._pallas_verify(batch)
+        return verify_kernel
 
     def warmup(self) -> None:
         """Compile the kernel for every bucket shape up front (XLA compiles

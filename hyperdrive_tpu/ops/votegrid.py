@@ -164,6 +164,23 @@ def _kernel(values, present, reset, idx, words, valid,
     values = flat_vals.reshape(n, 2, R, V, 8)
     present = flat_pres.reshape(n, 2, R, V)
 
+    packed = _tally(
+        values, present, targets, target_valid, l28_slot, l28_target, f,
+        axis_name=axis_name,
+    )
+    return values, present, packed
+
+
+def _tally(values, present, targets, target_valid, l28_slot, l28_target, f,
+           axis_name=None):
+    """The fused reduction shared by both grid kernels: per-(replica,
+    plane, round) matching/nil/total counts + quorum flags + the L28
+    cross-round lane, packed into ONE int32 output (over a tunnel-attached
+    device every host fetch is a full round trip, and eight per-launch
+    fetches dominated the launch cost at ~0.1s each). Layout:
+    [n, 2, R, 6] = (matching, nil, total, quorum_matching, quorum_nil,
+    quorum_any) flattened, then the two L28 lanes appended per replica."""
+    R = values.shape[2]
     pres_i = present.astype(jnp.int32)
     eq_target = (
         jnp.all(values == targets[:, None, :, None, :], axis=-1)
@@ -192,31 +209,91 @@ def _kernel(values, present, reset, idx, words, valid,
         total = jax.lax.psum(total, axis_name)
         l28 = jax.lax.psum(l28, axis_name)
 
-    q = (2 * f + 1)[:, None, None]
     n_ = matching.shape[0]
-    # ONE packed int32 output instead of eight arrays: over a tunnel-
-    # attached device every host fetch is a full round trip, and eight
-    # per-launch fetches dominated the launch cost (~0.1s each). Layout:
-    # [n, 2, R, 6] = (matching, nil, total, quorum_matching, quorum_nil,
-    # quorum_any) flattened, then the two L28 lanes appended per replica.
-    six = jnp.stack(
-        [
-            matching,
-            nil,
-            total,
-            (matching >= q).astype(jnp.int32),
-            (nil >= q).astype(jnp.int32),
-            (total >= q).astype(jnp.int32),
-        ],
-        axis=-1,
-    )  # [n, 2, R, 6]
-    l28_pair = jnp.stack(
-        [l28, (l28 >= 2 * f + 1).astype(jnp.int32)], axis=-1
-    )  # [n, 2]
-    packed = jnp.concatenate(
-        [six.reshape(n_, -1), l28_pair], axis=1
-    )  # [n, 2*R*6 + 2]
-    return values, present, packed
+    # Counts only — quorum flags are derived host-side from (counts, f)
+    # at materialize time (LazyCounts), halving the per-launch transfer.
+    three = jnp.stack([matching, nil, total], axis=-1)  # [n, 2, R, 3]
+    return jnp.concatenate(
+        [three.reshape(n_, -1), l28[:, None]], axis=1
+    )  # [n, 2*R*3 + 1]
+
+
+def _fused_kernel(verify_inner, values, present,
+                  ax, ay, at, rx, ry, s_nib, k_nib,
+                  upd_lane, upd_vals, rep_meta, tpack):
+    """Verification + scatter + tally as ONE launch (the north-star
+    fusion: tallies are masked reductions fused behind the verification
+    mask, and the settle pass pays a single device round trip — the same
+    one the verify-only path already pays).
+
+    ``verify_inner``: the traceable Ed25519 batch kernel
+    ((ax..k_nib) -> bool[B]). The update is a DENSE one-superstep image of
+    the shared window (every lockstep replica receives the same
+    broadcasts), not a scatter — XLA scatters serialize badly on TPU
+    (measured ~10 ms per settle at 256 replicas), while this merge is
+    three elementwise passes over the grid:
+
+    - ``upd_lane [2, R, V]`` int32: the verify lane whose verdict gates
+      the lane's update, -1 where the window has no vote for that lane
+      (duplicate/conflicting claims are resolved host-side; conflicts
+      poison the round via the dirty set).
+    - ``upd_vals [2, R, V, 8]`` int32: the vote value per updated lane.
+    - ``rep_meta [n, 4]``: reset, participate, l28_slot, f.
+    - ``tpack [n, R*8 + R + 8]``: per-round target words | target-valid |
+      the L28 target words.
+
+    Writes are presence-guarded — an existing vote in a lane always wins,
+    reproducing the host logs' first-wins rule — so per-replica grids
+    stay exactly equal to the host automaton's accepted inserts without
+    per-replica update tensors.
+    """
+    n, _, R, V, _ = values.shape
+    mask = verify_inner(ax, ay, at, rx, ry, s_nib, k_nib)  # [B] bool
+    reset = rep_meta[:, 0].astype(bool)
+    participate = rep_meta[:, 1].astype(bool)
+    l28_slot = rep_meta[:, 2]
+    f = rep_meta[:, 3]
+    targets = tpack[:, : R * 8].reshape(n, R, 8)
+    target_valid = tpack[:, R * 8 : R * 8 + R].astype(bool)
+    l28_target = tpack[:, R * 8 + R :]
+
+    has = upd_lane >= 0
+    upd_ok = has & mask[jnp.where(has, upd_lane, 0)]  # [2, R, V]
+    present = present & ~reset[:, None, None, None]
+    write = (
+        upd_ok[None]
+        & participate[:, None, None, None]
+        & ~present  # presence guard: existing votes win
+    )  # [n, 2, R, V]
+    values = jnp.where(write[..., None], upd_vals[None], values)
+    present = present | write
+    packed = _tally(
+        values, present, targets, target_valid, l28_slot, l28_target, f
+    )
+    # ONE flat output = ONE device->host transfer: over the tunnel every
+    # array fetch is its own ~100ms round trip, so returning mask and
+    # counts separately would double the settle's sync cost.
+    out = jnp.concatenate(
+        [mask.astype(jnp.int32), packed.reshape(-1)]
+    )
+    return values, present, out
+
+
+def _fused_jit(verify_inner):
+    """Process-wide cache of the jitted fused kernel, keyed on the verify
+    callable's identity: every VoteGrid (one per Simulation) shares one
+    compiled executable per (kernel, shape) instead of recompiling."""
+    from functools import partial
+
+    fn = _FUSED_JITS.get(verify_inner)
+    if fn is None:
+        fn = _FUSED_JITS[verify_inner] = jax.jit(
+            partial(_fused_kernel, verify_inner), donate_argnums=(0, 1)
+        )
+    return fn
+
+
+_FUSED_JITS: dict = {}
 
 
 class CheckedTallyView:
@@ -292,6 +369,8 @@ class VoteGrid:
         self.V = n_validators
         self.R = r_slots
         self.buckets = tuple(sorted(buckets))
+        self._mesh = mesh
+        self._fused = None
         shape_v = (n_replicas, 2, r_slots, n_validators, 8)
         shape_p = (n_replicas, 2, r_slots, n_validators)
         if mesh is None:
@@ -336,6 +415,73 @@ class VoteGrid:
     def bucket_for(self, k: int) -> int:
         return bucketing.bucket_for(k, self.buckets)
 
+    # ------------------------------------------------------------ fused path
+
+    def attach_fused(self, inner_factory) -> None:
+        """Install the Ed25519 batch-kernel factory (``batch -> traceable
+        verify fn``, e.g. ``TpuBatchVerifier.fused_inner``) and enable the
+        fused verify+scatter+tally launcher (single-chip grids only — the
+        sharded grid keeps the two-launch path, where the fetch is local
+        and cheap). The factory MUST return identity-stable callables per
+        batch size: the jitted fused kernel is cached process-wide on that
+        identity (see :func:`_fused_jit`), so an unstable factory would
+        recompile per grid instance — a silent multi-second stall on every
+        new Simulation."""
+        if self._mesh is not None:
+            raise ValueError("fused path is single-chip; sharded grids "
+                             "use update_and_tally")
+        self._fused_factory = inner_factory
+        self._fused = {}
+
+    def _fused_for(self, b: int):
+        fn = self._fused.get(b)
+        if fn is None:
+            fn = self._fused[b] = _fused_jit(self._fused_factory(b))
+        return fn
+
+    def fused_update_and_tally(self, verify_arrays, upd_lane, upd_vals,
+                               reset, participate,
+                               targets, target_valid, l28_slot, l28_target,
+                               f):
+        """One launch: verify the packed signature batch, merge the shared
+        window's vote lanes (gated by the verification mask) into every
+        participating replica's grid, tally. Returns a :class:`_FusedOut`
+        whose ``mask()`` is the settle's one blocking sync and whose
+        ``counts()`` ride the same transfer.
+
+        ``verify_arrays``: the packer's (ax, ay, at, rx, ry, s_nib, k_nib),
+        already padded to a bucket size B — the fused kernel compiles once
+        per verify bucket. ``upd_lane [2, R, V]`` / ``upd_vals
+        [2, R, V, 8]``: the dense one-superstep update image (see
+        :func:`_fused_kernel`)."""
+        b = verify_arrays[0].shape[0]
+        n, R = self.n, self.R
+        rep_meta = np.empty((n, 4), dtype=np.int32)
+        rep_meta[:, 0] = reset
+        rep_meta[:, 1] = participate
+        rep_meta[:, 2] = l28_slot
+        rep_meta[:, 3] = f
+        tpack = np.empty((n, R * 8 + R + 8), dtype=np.int32)
+        tpack[:, : R * 8] = targets.reshape(n, R * 8)
+        tpack[:, R * 8 : R * 8 + R] = target_valid
+        tpack[:, R * 8 + R :] = l28_target
+        self._values, self._present, out = self._fused_for(b)(
+            self._values,
+            self._present,
+            *(jnp.asarray(a) for a in verify_arrays),
+            jnp.asarray(upd_lane),
+            jnp.asarray(upd_vals),
+            jnp.asarray(rep_meta),
+            jnp.asarray(tpack),
+        )
+        # Start the device->host copy immediately so the transfer overlaps
+        # whatever host work precedes the first access.
+        try:
+            out.copy_to_host_async()
+        except (AttributeError, NotImplementedError):
+            pass
+        return _FusedOut(out, b, self.n, self.R, f)
+
     def update_and_tally(self, idx, words, reset, targets, target_valid,
                          l28_slot, l28_target, f):
         """Scatter accepted votes, reduce, return counts as numpy.
@@ -377,7 +523,37 @@ class VoteGrid:
         # measured neutral at n=256 where some replica nearly always
         # queries. The packed array is an independent output, so the next
         # launch's donation of the grid buffers never invalidates it.
-        return LazyCounts(packed, self.n, self.R)
+        return LazyCounts(packed, self.n, self.R, f)
+
+
+class _FusedOut:
+    """One fused launch's flat output: ``mask()`` materializes it (the
+    settle's single blocking sync) and returns the verification mask;
+    ``counts()`` wraps the already-fetched tail as the TallyView mapping
+    for free."""
+
+    __slots__ = ("_out", "_b", "_n", "_R", "_f", "_np")
+
+    def __init__(self, out, b: int, n: int, r_slots: int, f):
+        self._out = out
+        self._b = b
+        self._n = n
+        self._R = r_slots
+        self._f = f
+        self._np = None
+
+    def mask(self) -> np.ndarray:
+        if self._np is None:
+            self._np = np.asarray(self._out)
+            self._out = None
+        return self._np[: self._b].astype(bool)
+
+    def counts(self) -> "LazyCounts":
+        self.mask()
+        return LazyCounts(
+            self._np[self._b :].reshape(self._n, -1), self._n, self._R,
+            self._f,
+        )
 
 
 class LazyCounts(Mapping):
@@ -385,7 +561,7 @@ class LazyCounts(Mapping):
     The key set is static, so shape probes (iteration, membership, len)
     never trigger the device round trip."""
 
-    __slots__ = ("_packed", "_n", "_R", "_dict")
+    __slots__ = ("_packed", "_n", "_R", "_f", "_dict")
 
     _KEYS = (
         "matching",
@@ -398,10 +574,11 @@ class LazyCounts(Mapping):
         "l28_quorum",
     )
 
-    def __init__(self, packed, n: int, r_slots: int):
+    def __init__(self, packed, n: int, r_slots: int, f):
         self._packed = packed
         self._n = n
         self._R = r_slots
+        self._f = f
         self._dict = None
 
     def _materialize(self) -> dict:
@@ -409,16 +586,20 @@ class LazyCounts(Mapping):
         if d is None:
             flat = np.asarray(self._packed)
             n, R = self._n, self._R
-            six = flat[:, : 2 * R * 6].reshape(n, 2, R, 6)
+            three = flat[:, : 2 * R * 3].reshape(n, 2, R, 3)
+            l28 = flat[:, 2 * R * 3]
+            # Quorum flags are host-derived (counts and f travel; flags
+            # don't — half the transfer for a handful of comparisons).
+            q = (2 * np.asarray(self._f).reshape(n) + 1)[:, None, None]
             d = self._dict = {
-                "matching": six[..., 0],
-                "nil": six[..., 1],
-                "total": six[..., 2],
-                "quorum_matching": six[..., 3].astype(bool),
-                "quorum_nil": six[..., 4].astype(bool),
-                "quorum_any": six[..., 5].astype(bool),
-                "l28": flat[:, 2 * R * 6],
-                "l28_quorum": flat[:, 2 * R * 6 + 1].astype(bool),
+                "matching": three[..., 0],
+                "nil": three[..., 1],
+                "total": three[..., 2],
+                "quorum_matching": three[..., 0] >= q,
+                "quorum_nil": three[..., 1] >= q,
+                "quorum_any": three[..., 2] >= q,
+                "l28": l28,
+                "l28_quorum": l28 >= q[:, 0, 0],
             }
             self._packed = None
         return d

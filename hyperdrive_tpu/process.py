@@ -155,6 +155,7 @@ class Process:
         "catcher",
         "state",
         "_tally_source",
+        "host_counts",
     )
 
     def __init__(
@@ -190,6 +191,12 @@ class Process:
         #: ingest_cascade call (see the _prevotes_for family); None means
         #: every threshold check reads the host counters.
         self._tally_source = None
+        #: When False (device-tally deployments), batched ingestion skips
+        #: maintaining the derived per-value tally dicts — the vote grid
+        #: answers the hot quorum queries, and declined queries fall back
+        #: to State.count_*'s O(V) log scan. The logs themselves (the
+        #: checkpoint/evidence source of truth) are always maintained.
+        self.host_counts = True
 
     # ---------------------------------------------------------------- inputs
 
@@ -269,6 +276,7 @@ class Process:
         cur_h = st.current_height
         catcher = self.catcher
         traces = st.trace_logs
+        hc = self.host_counts
         last_rnd = None
         last_is_pc = None
         votes = counts = trace = None
@@ -283,9 +291,14 @@ class Process:
                     votes = st.prevote_logs.get(rnd)
                     if votes is None:
                         votes = st.prevote_logs[rnd] = {}
-                    counts = st.prevote_counts.get(rnd)
-                    if counts is None:
-                        counts = st.prevote_counts[rnd] = {}
+                    if hc:
+                        counts = st.prevote_counts.get(rnd)
+                        if counts is None:
+                            counts = st.prevote_counts[rnd] = {}
+                    else:
+                        # A stale tally (e.g. rebuilt by a checkpoint
+                        # restore) must not shadow the scan fallback.
+                        st.prevote_counts.pop(rnd, None)
                     trace = traces.get(rnd)
                     if trace is None:
                         trace = traces[rnd] = set()
@@ -296,8 +309,9 @@ class Process:
                         catcher.catch_double_prevote(msg, existing)
                     continue
                 votes[sender] = msg
-                v = msg.value
-                counts[v] = counts.get(v, 0) + 1
+                if hc:
+                    v = msg.value
+                    counts[v] = counts.get(v, 0) + 1
                 trace.add(sender)
                 vote_rounds.add(rnd)
                 if on_accepted is not None:
@@ -311,9 +325,12 @@ class Process:
                     votes = st.precommit_logs.get(rnd)
                     if votes is None:
                         votes = st.precommit_logs[rnd] = {}
-                    counts = st.precommit_counts.get(rnd)
-                    if counts is None:
-                        counts = st.precommit_counts[rnd] = {}
+                    if hc:
+                        counts = st.precommit_counts.get(rnd)
+                        if counts is None:
+                            counts = st.precommit_counts[rnd] = {}
+                    else:
+                        st.precommit_counts.pop(rnd, None)
                     trace = traces.get(rnd)
                     if trace is None:
                         trace = traces[rnd] = set()
@@ -324,8 +341,9 @@ class Process:
                         catcher.catch_double_precommit(msg, existing)
                     continue
                 votes[sender] = msg
-                v = msg.value
-                counts[v] = counts.get(v, 0) + 1
+                if hc:
+                    v = msg.value
+                    counts[v] = counts.get(v, 0) + 1
                 trace.add(sender)
                 vote_rounds.add(rnd)
                 commit_rounds.add(rnd)

@@ -180,14 +180,29 @@ class State:
         return None
 
     def count_prevotes_for(self, round: int, value: bytes) -> int:
-        """Prevotes at ``round`` whose value equals ``value`` — O(1)."""
+        """Prevotes at ``round`` whose value equals ``value`` — O(1) from
+        the derived tally, with an O(V) log scan when the round has no
+        tally dict (device-tally ingestion skips host tally maintenance —
+        the vote grid answers the hot queries, and the rare declined query
+        lands here)."""
         counts = self.prevote_counts.get(round)
-        return counts.get(value, 0) if counts else 0
+        if counts is not None:
+            return counts.get(value, 0)
+        votes = self.prevote_logs.get(round)
+        if not votes:
+            return 0
+        return sum(1 for v in votes.values() if v.value == value)
 
     def count_precommits_for(self, round: int, value: bytes) -> int:
-        """Precommits at ``round`` whose value equals ``value`` — O(1)."""
+        """Precommits at ``round``; same contract as
+        :meth:`count_prevotes_for`."""
         counts = self.precommit_counts.get(round)
-        return counts.get(value, 0) if counts else 0
+        if counts is not None:
+            return counts.get(value, 0)
+        votes = self.precommit_logs.get(round)
+        if not votes:
+            return 0
+        return sum(1 for v in votes.values() if v.value == value)
 
     def rebuild_counts(self) -> None:
         """Recompute the derived tallies from the logs — for states whose

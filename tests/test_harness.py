@@ -420,6 +420,48 @@ def test_device_tally_signed_full_pipeline(tmp_path):
     assert replayed.heights == dev.heights
 
 
+def test_device_tally_fused_single_launch_pipeline():
+    # The fused settle: Ed25519 verification + grid scatter + tally in ONE
+    # launch (TpuBatchVerifier exposes its traceable kernel; the grid
+    # embeds it). Every device count still checked equal to the host
+    # counters, and the run must be trajectory-identical to the unfused
+    # device-tally run AND the plain host run.
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+    from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+    from hyperdrive_tpu.verifier import HostVerifier
+
+    views = []
+
+    def check(view, proc):
+        v = CheckedTallyView(view, proc)
+        views.append(v)
+        return v
+
+    kw = dict(n=4, target_height=4, seed=171, sign=True, burst=True)
+    ver = TpuBatchVerifier(buckets=(64, 256))
+    fused = Simulation(
+        **kw, batch_verifier=ver, dedup_verify=True,
+        device_tally=True, tally_check=check,
+    )
+    assert fused._fused_ok
+    fres = fused.run()
+    assert fres.completed
+    fres.assert_safety()
+    assert fused.vote_grid._fused, "fused launcher never compiled"
+    assert sum(v.hits for v in views) > 0, "device counts never consulted"
+
+    unfused = Simulation(
+        **kw, batch_verifier=HostVerifier(), dedup_verify=True,
+        device_tally=True, tally_check=CheckedTallyView,
+    ).run()
+    host = Simulation(
+        **kw, batch_verifier=HostVerifier(), dedup_verify=True
+    ).run()
+    assert fres.commits == unfused.commits == host.commits
+    assert fres.steps == unfused.steps == host.steps
+    assert fres.record.messages == unfused.record.messages
+
+
 def test_burst_signed_with_tpu_batch_verifier():
     # The full BASELINE config-4 pipeline at miniature scale: a signed
     # burst-mode network whose aggregated windows are verified by the
