@@ -81,6 +81,19 @@ class VirtualClock:
         self.now = max(self.now, deadline)
         return event, handler
 
+    def prune(self, keep) -> int:
+        """Drop scheduled events failing ``keep(event)``; returns the drop
+        count. Happy-path consensus never drains the queue, so timeouts
+        for long-committed heights pile up forever (~255/height at n=256 —
+        2.5M dead heap entries over a 10k-height run); the driver prunes
+        them once the heap gets large."""
+        kept = [e for e in self._heap if keep(e[2])]
+        dropped = len(self._heap) - len(kept)
+        if dropped:
+            heapq.heapify(kept)
+            self._heap = kept
+        return dropped
+
 
 @dataclass
 class ScenarioRecord:
@@ -670,10 +683,16 @@ class Simulation:
     def _completed(self) -> bool:
         return not self._pending_replicas
 
-    def run(self, max_steps: int = 2_000_000) -> SimulationResult:
-        for i, r in enumerate(self.replicas):
-            if self.alive[i]:
-                r.start()
+    def run(self, max_steps: int = 2_000_000, start: bool = True) -> SimulationResult:
+        """Drive the network to the target height. ``start=False`` resumes
+        a network whose replicas are already mid-protocol (the crash-
+        restore-rejoin scenario: phase two continues after a revived
+        replica was restored from its checkpoint) — replicas are NOT
+        (re)started, so nobody re-proposes or re-arms round timers."""
+        if start:
+            for i, r in enumerate(self.replicas):
+                if self.alive[i]:
+                    r.start()
         if self.burst:
             return self._run_burst(max_steps)
 
@@ -684,6 +703,10 @@ class Simulation:
                 # Network drained: advance virtual time to the next timeout.
                 if self.clock.pending() == 0:
                     break  # genuine stall — nothing can ever happen again
+                if self.clock.pending() > 65536:
+                    self._prune_clock()
+                    if self.clock.pending() == 0:
+                        break
                 event, owner = self.clock.fire_next()
                 self.queue.append((owner, event))
                 continue
@@ -741,6 +764,8 @@ class Simulation:
         in lock-step mode."""
         steps = 0
         while steps < max_steps and not self._completed():
+            if self.clock.pending() > 65536:
+                self._prune_clock()
             if self._qhead >= len(self.queue):
                 if self.clock.pending() == 0:
                     break  # genuine stall
@@ -869,6 +894,23 @@ class Simulation:
             commits=self.commits,
             record=self.record if self._record_on else None,
             alive=self.alive,
+        )
+
+    def _prune_clock(self) -> None:
+        """Drop timeouts for heights every live replica has already left —
+        they would fire as guaranteed no-ops (the Process height-guards
+        every on_timeout_*), and keeping them makes deep runs accumulate
+        memory linearly in committed heights."""
+        alive_heights = [
+            r.proc.current_height
+            for i, r in enumerate(self.replicas)
+            if self.alive[i]
+        ]
+        if not alive_heights:
+            return
+        min_h = min(alive_heights)
+        self.clock.prune(
+            lambda ev: not isinstance(ev, Timeout) or ev.height >= min_h
         )
 
     def _settle(self, shared: "list | None" = None) -> None:

@@ -222,6 +222,14 @@ def verify_kernel(ax, ay, at, rx, ry, s_nibbles, k_nibbles):
       s_nibbles:  [B, 64] little-endian base-16 digits of s
       k_nibbles:  [B, 64] little-endian base-16 digits of k
     Returns: bool [B] acceptance mask.
+
+    PRECONDITION: every scalar's nibbles must encode a value < 2^253
+    (both s and k). The signed-digit recode discards the final carry,
+    so a raw scalar >= 2^253 would silently verify as (scalar - 2^256)
+    instead of being rejected. The packer guarantees this — s is
+    range-checked against L and k is reduced mod L, with invalid lanes
+    zeroed and masked via ``prevalid`` — so only call this kernel on
+    packer output (or inputs honoring the same bound).
     """
     bsz = ax.shape[0]
     one = jnp.broadcast_to(

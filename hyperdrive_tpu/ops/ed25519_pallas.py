@@ -462,7 +462,12 @@ def resolve_backend(backend=None, devices=None) -> str:
 def verify_pallas(ax, ay, at, rx, ry, s_nib, k_nib,
                   block: int = _BLOCK, interpret: bool = False):
     """Drop-in equivalent of ``verify_kernel`` on the Pallas path: pads the
-    batch to a multiple of ``block``, runs the kernel, slices the mask."""
+    batch to a multiple of ``block``, runs the kernel, slices the mask.
+
+    Shares ``verify_kernel``'s PRECONDITION: scalar nibbles must encode
+    values < 2^253 (guaranteed by the packer; the signed recode drops the
+    final carry, so an out-of-range raw scalar would verify as
+    ``scalar - 2^256`` instead of being rejected)."""
     bsz = ax.shape[0]
     padded = ((bsz + block - 1) // block) * block
     if padded != bsz:

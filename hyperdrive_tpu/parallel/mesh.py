@@ -58,9 +58,20 @@ def _pick_kernel(backend: str | None, mesh: Mesh):
     from hyperdrive_tpu.ops.ed25519_pallas import resolve_backend
 
     if resolve_backend(backend, devices=mesh.devices) == "pallas":
-        from hyperdrive_tpu.ops.ed25519_pallas import verify_pallas
+        from hyperdrive_tpu.ops.ed25519_pallas import _BLOCK, verify_pallas
 
-        return verify_pallas
+        def kernel(ax, ay, at, rx, ry, s_nib, k_nib):
+            # Match the block to the per-shard local batch so fine-grained
+            # hr x val splits don't pad every shard to 256 lanes (up to 4x
+            # the ladder work), clamped at >=128 — sub-128 blocks are
+            # below the TPU tile width; verify_pallas pads a smaller
+            # batch up to one block.
+            block = min(_BLOCK, max(ax.shape[0], 128))
+            return verify_pallas(
+                ax, ay, at, rx, ry, s_nib, k_nib, block=block
+            )
+
+        return kernel
     return verify_kernel
 
 

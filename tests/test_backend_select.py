@@ -9,6 +9,72 @@ from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
 from hyperdrive_tpu.ops.ed25519_pallas import pallas_backend_ok, resolve_backend
 
 
+class _FakeLeg:
+    """Backend stub whose nth call costs delays[n] (then the last delay
+    forever) — a deterministic latency script for calibration tests."""
+
+    def __init__(self, delays):
+        import time as _t
+
+        self._t = _t
+        self.delays = list(delays)
+        self.calls = 0
+
+    def verify_signatures(self, items):
+        d = self.delays[min(self.calls, len(self.delays) - 1)]
+        self.calls += 1
+        self._t.sleep(d)
+        return [True] * len(items)
+
+
+def test_adaptive_calibration_median_survives_one_outlier():
+    # A single jittered sample must not flip routing: the device's first
+    # TIMED full-window rep is a 60x outlier, but the median-of-3 ignores
+    # it, so the computed crossover matches a clean run's to within the
+    # margin the two remaining clean samples allow.
+    from hyperdrive_tpu.verifier import AdaptiveVerifier
+
+    items = [(bytes(32), bytes(32), bytes(64))] * 64
+
+    def run(outlier: float):
+        # Device call order: warm full, warm tiny, timed full x3,
+        # timed tiny x3. The outlier lands on the first timed full rep.
+        dev = _FakeLeg(
+            [0.0, 0.0, outlier, 0.004, 0.004, 0.002, 0.002, 0.002]
+        )
+        host = _FakeLeg([0.008])
+        av = AdaptiveVerifier(device=dev, host=host, calibrate_at=64)
+        av.verify_signatures(items)
+        assert av.calibrated
+        return av.crossover
+
+    clean = run(0.004)
+    jittered = run(0.24)
+    assert jittered == pytest.approx(clean, rel=0.5)
+    # Sanity: a crossover from the outlier sample would be wildly larger
+    # (device "slower" than host at every size -> effectively infinite).
+    assert jittered < 10_000
+
+
+def test_adaptive_recalibrate_remeasures():
+    from hyperdrive_tpu.verifier import AdaptiveVerifier
+
+    items = [(bytes(32), bytes(32), bytes(64))] * 64
+    dev = _FakeLeg([0.0])
+    host = _FakeLeg([0.002])
+    av = AdaptiveVerifier(device=dev, host=host, calibrate_at=64)
+    av.verify_signatures(items)
+    assert av.calibrated
+    first_calls = dev.calls
+    av.verify_signatures(items)  # routed, no re-measurement burst
+    assert dev.calls <= first_calls + 1
+    av.recalibrate()
+    assert not av.calibrated
+    av.verify_signatures(items)
+    assert av.calibrated
+    assert dev.calls > first_calls + 1
+
+
 def test_resolve_passthrough_and_validation():
     assert resolve_backend("pallas") == "pallas"
     assert resolve_backend("xla") == "xla"

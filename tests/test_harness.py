@@ -590,6 +590,55 @@ def test_burst_rejects_byzantine_signer():
             assert bad not in logs
 
 
+def test_crash_restore_rejoin_from_checkpoint(tmp_path):
+    # The full crash-recovery story (reference contract: "State should be
+    # saved after every method call", process/state.go:18-20; death
+    # scenarios, replica_test.go:748-847): a replica checkpoints on every
+    # commit, dies mid-run, is restored from its checkpoint FILE, rejoins
+    # via reset_height, and the network completes with safety intact.
+    from hyperdrive_tpu.replica import ResetHeight
+    from hyperdrive_tpu.utils.checkpoint import restore_process, save_process
+
+    victim = 3
+    ckpt = os.path.join(tmp_path, "victim.ckpt")
+    sim = Simulation(n=7, target_height=8, seed=131, sign=True,
+                     kill_at_step={victim: 400})
+    orig = sim._on_commit
+
+    def commit_and_checkpoint(i, height, value):
+        out = orig(i, height, value)
+        if i == victim:
+            save_process(sim.replicas[victim].proc, ckpt)
+        return out
+
+    sim._on_commit = commit_and_checkpoint
+    res = sim.run(max_steps=500_000)
+    # Phase 1: the survivors (still a quorum) finished without the victim.
+    assert res.completed
+    assert not sim.alive[victim]
+    dead_height = sim.replicas[victim].current_height()
+    assert dead_height < 8
+
+    # Phase 2: restart the victim from its checkpoint file. The restored
+    # process is at the height of its last pre-crash commit...
+    restore_process(sim.replicas[victim].proc, ckpt)
+    restored_h = sim.replicas[victim].current_height()
+    assert 1 < restored_h <= dead_height
+    # ...rejoins via the resync mechanism, and catches up to the network.
+    sim.alive[victim] = True
+    net_height = max(c and max(c) or 0 for c in sim.commits) + 1
+    sim.replicas[victim].handle(ResetHeight(height=net_height))
+    sim.target_height = 12
+    sim._pending_replicas = {i for i in range(sim.n) if sim.alive[i]}
+    res2 = sim.run(max_steps=500_000, start=False)
+    assert res2.completed, f"rejoined network stalled at {res2.heights}"
+    res2.assert_safety()
+    # The revived replica committed every height from its rejoin point on.
+    revived = sim.commits[victim]
+    for h in range(net_height, 13):
+        assert h in revived
+
+
 def test_record_replay_with_timeouts(tmp_path):
     # Regression: dumps containing Timeout deliveries (any run that
     # exercises liveness — offline proposers force propose timeouts)

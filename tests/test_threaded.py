@@ -33,14 +33,26 @@ def value_for(height: int, round_: int) -> bytes:
 
 class ThreadedNetwork:
     """n replicas on real threads; broadcasts go straight into every
-    replica's inbox (including the sender's own)."""
+    replica's inbox (including the sender's own).
+
+    ``sign=True`` runs the full authenticated pipeline on threads: every
+    broadcast is Ed25519-signed on its sender's thread and every replica
+    verifies its drained windows through a HostVerifier — the threaded
+    analogue of the harness's signed mode."""
 
     def __init__(self, n: int, target_height: int, timeout: float = 0.2,
-                 offline: set | None = None):
+                 offline: set | None = None, sign: bool = False):
         self.n = n
         self.target = target_height
         self.offline = offline or set()
-        self.signatories = [sig(i) for i in range(n)]
+        self.ring = None
+        if sign:
+            from hyperdrive_tpu.crypto.keys import KeyRing
+
+            self.ring = KeyRing.deterministic(n, namespace=b"threaded")
+            self.signatories = list(self.ring.signatories)
+        else:
+            self.signatories = [sig(i) for i in range(n)]
         self.commits = [dict() for _ in range(n)]
         self.done = [threading.Event() for _ in range(n)]
         self.stop = threading.Event()
@@ -49,9 +61,14 @@ class ThreadedNetwork:
             self.replicas.append(self._build(i, timeout))
 
     def _build(self, i: int, timeout: float) -> Replica:
+        keypair = self.ring[i] if self.ring is not None else None
+
         def bcast(msg):
             # Broadcast to all, including self, via the thread-safe inboxes
-            # (reference: replica_test.go:174-208).
+            # (reference: replica_test.go:174-208). Signed mode attaches
+            # the sender's detached signature on the sender's own thread.
+            if keypair is not None:
+                msg = keypair.sign_message(msg)
             for j, r in enumerate(self.replicas_snapshot()):
                 if j not in self.offline:
                     r._enqueue(msg, self.stop)
@@ -72,6 +89,11 @@ class ThreadedNetwork:
             timeout=timeout,
             timeout_scaling=0.5,
         )
+        verifier = None
+        if self.ring is not None:
+            from hyperdrive_tpu.verifier import HostVerifier
+
+            verifier = HostVerifier()
         return Replica(
             ReplicaOptions(),
             self.signatories[i],
@@ -84,6 +106,7 @@ class ThreadedNetwork:
             BroadcasterCallbacks(
                 on_propose=bcast, on_prevote=bcast, on_precommit=bcast
             ),
+            verifier=verifier,
         )
 
     def replicas_snapshot(self):
@@ -128,6 +151,102 @@ def test_threaded_honest_network_commits_identically():
     for c in net.commits[1:]:
         for h in range(1, 6):
             assert c.get(h) == base[h]
+
+
+def test_threaded_signed_network_with_verifier():
+    # Signing + batched window verification on real threads: every
+    # broadcast carries a real Ed25519 signature made on the sender's
+    # thread, every replica's flush drains windows through a HostVerifier.
+    # Commit maps must still be byte-identical (the reference runs every
+    # scenario on goroutines; this is the authenticated variant).
+    net = ThreadedNetwork(n=4, target_height=4, timeout=0.5, sign=True)
+    assert net.run(budget_s=60.0), (
+        "signed threaded network stalled: heights="
+    ) + str([r.current_height() for r in net.replicas])
+    net.assert_safety()
+    base = net.commits[0]
+    assert set(base) >= set(range(1, 5))
+    for c in net.commits[1:]:
+        for h in range(1, 5):
+            assert c.get(h) == base[h]
+
+
+def test_threaded_kill_and_reset_height_rejoin():
+    # A replica's thread is stopped mid-run (its inbox goes dark, so the
+    # broadcast fan-out marks it offline to keep senders unblocked), the
+    # survivors — still a quorum — keep committing, then the replica's
+    # thread restarts and rejoins via the reset_height resync: it must
+    # catch up and commit every height from the rejoin point to the new
+    # target, with network-wide safety intact.
+    victim = 2
+    net = ThreadedNetwork(n=4, target_height=3, timeout=0.3)
+    victim_stop = threading.Event()
+    vthread = threading.Thread(
+        target=net.replicas[victim].run, args=(victim_stop,), daemon=True
+    )
+    vthread.start()
+    threads = []
+    for i, r in enumerate(net.replicas):
+        if i != victim:
+            t = threading.Thread(target=r.run, args=(net.stop,), daemon=True)
+            t.start()
+            threads.append(t)
+
+    # Phase 1: everyone runs; wait for the victim's first commits, then
+    # kill its thread. Marking it offline FIRST keeps broadcasters from
+    # blocking on its inbox once nothing drains it.
+    assert net.done[victim].wait(60.0), "victim never reached phase-1 target"
+    net.offline.add(victim)
+    victim_stop.set()
+    vthread.join(timeout=5.0)
+    killed_at = net.replicas[victim].current_height()
+
+    # Phase 2: survivors alone must keep committing (3 of 4 is a quorum).
+    net.target = 6
+    for ev in net.done:
+        ev.clear()
+    deadline = time.monotonic() + 60.0
+    for i in range(net.n):
+        if i == victim:
+            continue
+        assert net.done[i].wait(max(0.0, deadline - time.monotonic())), (
+            f"survivor {i} stalled at "
+            f"{net.replicas[i].current_height()} after the kill"
+        )
+
+    # Phase 3: restart the victim's thread and resync it via reset_height.
+    # The resync targets a height the survivors haven't reached yet (a
+    # margin above their last commit): a rejoiner must buffer that
+    # height's traffic from the start — resetting to a height whose
+    # round-0 messages already flew past would leave it waiting for votes
+    # nobody will resend.
+    net.target = 12
+    for ev in net.done:
+        ev.clear()
+    net.offline.discard(victim)
+    net_height = max(max(c) for c in net.commits if c) + 3
+    victim_stop = threading.Event()
+    vthread = threading.Thread(
+        target=net.replicas[victim].run, args=(victim_stop,), daemon=True
+    )
+    vthread.start()
+    net.replicas[victim].reset_height(net_height)
+    deadline = time.monotonic() + 120.0
+    for i in range(net.n):
+        assert net.done[i].wait(max(0.0, deadline - time.monotonic())), (
+            f"replica {i} stalled at {net.replicas[i].current_height()} "
+            "after the rejoin"
+        )
+    net.stop.set()
+    victim_stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    vthread.join(timeout=5.0)
+    net.assert_safety()
+    revived = net.commits[victim]
+    assert killed_at < net_height
+    for h in range(net_height, 13):
+        assert h in revived, f"revived replica missing height {h}"
 
 
 def test_threaded_offline_proposer_advances_via_real_timeouts():
