@@ -263,6 +263,7 @@ class Simulation:
         dedup_verify: bool = False,
         batch_ingest: Optional[bool] = None,
         device_tally: bool = False,
+        tally_mesh=None,
         tally_check=None,
         payload_bytes: int = 0,
         dedup_reconstruct: bool = True,
@@ -433,7 +434,14 @@ class Simulation:
             # rounds on device; deeper rounds (rare) fall back to the
             # authoritative host counters. Halving the slot window halves
             # the grid tensors and every launch's transfer.
-            self.vote_grid = VoteGrid(n, len(self.signatories), r_slots=4)
+            # ``tally_mesh``: shard the grid's validator axis over a
+            # ('hr', 'val') device mesh — sharded CONSENSUS, not just a
+            # sharded kernel: every settle's scatter routes rows by global
+            # validator index and the quorum counts psum over the mesh
+            # before the rule cascade consumes them.
+            self.vote_grid = VoteGrid(
+                n, len(self.signatories), r_slots=4, mesh=tally_mesh
+            )
             self._grid_height = [-1] * n
             self._grid_dirty: list[set] = [set() for _ in range(n)]
             self._sender_pos = {
@@ -445,6 +453,7 @@ class Simulation:
             #: dedups verification (shared verdicts = shared scatter).
             self._fused_ok = (
                 self._shared_mode
+                and tally_mesh is None  # fused launcher is single-chip
                 and dedup_verify
                 and hasattr(self.batch_verifier, "fused_inner")
                 and hasattr(getattr(self.batch_verifier, "host", None),

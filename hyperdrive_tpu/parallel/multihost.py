@@ -119,9 +119,14 @@ def make_hybrid_mesh(hr_dcn: int | None = None, val_ici: int | None = None) -> M
                 f"per-process mesh {per_granule_hr}x{val_ici} does not "
                 f"match the {local} devices attached to each process"
             )
+        # Granule = process: 'hr' tiles one row-block per process, which
+        # keeps 'val' on process-local (hence intra-slice) devices. This
+        # also holds on CPU pods, whose devices carry process indices but
+        # no slice indices (slice-granule grouping would see one slice).
         arr = mesh_utils.create_hybrid_device_mesh(
             mesh_shape=(per_granule_hr, val_ici),
             dcn_mesh_shape=(n_proc, 1),
+            process_is_granule=True,
         )
     else:
         arr = np.array(jax.devices()).reshape(hr_dcn, val_ici)

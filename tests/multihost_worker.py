@@ -1,0 +1,124 @@
+"""Worker process for the 2-process jax.distributed test.
+
+Launched by ``tests/test_multihost.py::test_two_process_distributed_step``
+as ``python tests/multihost_worker.py <coordinator> <num_procs> <rank>``
+with a 2-local-CPU-device platform, so the pod topology is 2 processes x
+2 devices = 4 global devices. Each process drives the REAL multi-process
+branches of hyperdrive_tpu.parallel.multihost — hybrid DCN x ICI mesh
+construction, host-local-to-global window assembly, broadcast
+replication — through the sharded verify+tally step, and checks its own
+round's psum'd counts. Prints "MULTIHOST_OK rank=<r> ..." on success;
+any failure raises (nonzero exit), which the parent asserts on.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coordinator, num_procs, rank = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    )
+
+    from hyperdrive_tpu.parallel import init_distributed
+
+    # The REAL initialize path (multihost.py) — before any other JAX API.
+    n_procs = init_distributed(
+        coordinator_address=coordinator,
+        num_processes=num_procs,
+        process_id=rank,
+    )
+    assert n_procs == num_procs, f"process_count {n_procs} != {num_procs}"
+
+    import numpy as np
+
+    import jax
+
+    from hyperdrive_tpu.crypto.keys import KeyRing
+    from hyperdrive_tpu.ops.tally import pack_values
+    from hyperdrive_tpu.parallel import (
+        global_window_from_local,
+        make_hybrid_mesh,
+        replicate_to_all_hosts,
+        sharded_verify_tally,
+    )
+
+    assert jax.process_count() == num_procs
+    n_global = len(jax.devices())
+    assert n_global == 2 * num_procs, f"global devices {n_global}"
+
+    # Hybrid mesh: 'hr' spans DCN (one row per process), 'val' stays on
+    # the process-local devices — the multi-process branch of
+    # make_hybrid_mesh (mesh_utils.create_hybrid_device_mesh).
+    mesh = make_hybrid_mesh()
+    assert mesh.axis_names == ("hr", "val")
+    assert mesh.devices.shape == (num_procs, 2)
+
+    # Every process derives the same deterministic votes; each packs only
+    # ITS round's slab (host-side packing parallelizes across the pod) and
+    # global_window_from_local assembles the global arrays without moving
+    # data between hosts.
+    R, V = num_procs, 2
+    f = V // 3  # 0 — quorum 1; every uncorrupted round reaches it
+    ring = KeyRing.deterministic(V, namespace=b"mh2p")
+    values = [bytes([r + 9]) * 32 for r in range(R)]
+    corrupt = {(1, 1)}  # round 1 loses one signature
+
+    from hyperdrive_tpu.parallel import grid_pack
+
+    shaped, prevalid = grid_pack(ring, R, V, values, corrupt=corrupt)
+    assert bool(prevalid.all())
+    local_slab = tuple(np.asarray(a)[rank : rank + 1] for a in shaped)
+    window = global_window_from_local(mesh, local_slab)
+
+    vote_local = np.stack([pack_values([values[rank]] * V)])
+    (vote_vals,) = global_window_from_local(mesh, (vote_local,))
+    target_local = pack_values([values[rank]])
+    from jax.sharding import PartitionSpec as P
+
+    (target_vals,) = global_window_from_local(
+        mesh, (target_local,), spec=P("hr")
+    )
+    # The broadcast-replication branch (broadcast_one_to_all).
+    f_arr = replicate_to_all_hosts(mesh, np.int32(f))
+
+    step = sharded_verify_tally(mesh)
+    counts, flags, ok = step(*window, vote_vals, target_vals, f_arr)
+
+    # counts are sharded over 'hr': this process's addressable shard IS
+    # its own round's psum-combined result.
+    my_matching = int(np.asarray(counts["matching"].addressable_shards[0].data)[0])
+    expect = V - sum(1 for (r, _) in corrupt if r == rank)
+    assert my_matching == expect, (
+        f"rank {rank}: matching {my_matching} != {expect}"
+    )
+    # ok is sharded (hr, val): this process holds one [1, 1] shard per
+    # local device; reassemble its row from the shard indices.
+    my_ok = {}
+    for s in ok.addressable_shards:
+        r0 = s.index[0].start or 0
+        v0 = s.index[1].start or 0
+        if r0 == rank:
+            my_ok[v0] = bool(np.asarray(s.data)[0, 0])
+    assert len(my_ok) == V, f"rank {rank}: missing ok shards ({my_ok})"
+    for v in range(V):
+        assert my_ok[v] == ((rank, v) not in corrupt), (
+            f"rank {rank}: verify mask wrong at validator {v}"
+        )
+
+    print(
+        f"MULTIHOST_OK rank={rank} procs={jax.process_count()} "
+        f"devices={n_global} matching={my_matching}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

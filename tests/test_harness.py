@@ -420,6 +420,44 @@ def test_device_tally_signed_full_pipeline(tmp_path):
     assert replayed.heights == dev.heights
 
 
+def test_device_tally_sharded_mesh_consensus():
+    # Sharded CONSENSUS on the 8-device virtual mesh: the vote grid's
+    # validator axis is split across devices, every settle's quorum counts
+    # psum over the mesh, and the rule cascade consumes them — with
+    # CheckedTallyView asserting device==host count-for-count, and the
+    # run trajectory-identical to the single-chip grid and the host run.
+    import jax
+
+    from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+    from hyperdrive_tpu.parallel import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual CPU platform")
+    mesh = make_mesh(devices=jax.devices()[:8], hr=1)
+
+    views = []
+
+    def check(view, proc):
+        v = CheckedTallyView(view, proc)
+        views.append(v)
+        return v
+
+    kw = dict(n=8, target_height=4, seed=201, sign=True, burst=True)
+    sharded = Simulation(
+        **kw, device_tally=True, tally_mesh=mesh, tally_check=check
+    ).run()
+    assert sharded.completed
+    sharded.assert_safety()
+    assert sum(v.hits for v in views) > 0, "sharded counts never consulted"
+
+    single = Simulation(
+        **kw, device_tally=True, tally_check=CheckedTallyView
+    ).run()
+    host = Simulation(**kw).run()
+    assert sharded.commits == single.commits == host.commits
+    assert sharded.steps == single.steps == host.steps
+
+
 def test_device_tally_fused_single_launch_pipeline():
     # The fused settle: Ed25519 verification + grid scatter + tally in ONE
     # launch (TpuBatchVerifier exposes its traceable kernel; the grid
