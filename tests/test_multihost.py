@@ -139,7 +139,6 @@ def test_two_process_distributed_step():
         )
         for rank in range(2)
     ]
-    outs = []
     for rank, p in enumerate(procs):
         try:
             out, _ = p.communicate(timeout=240)
@@ -147,7 +146,6 @@ def test_two_process_distributed_step():
             for q in procs:
                 q.kill()
             raise
-        outs.append(out)
         assert p.returncode == 0, f"worker {rank} failed:\n{out}"
         assert f"MULTIHOST_OK rank={rank} procs=2 devices=4" in out, out
 
