@@ -219,8 +219,7 @@ def _tally(values, present, targets, target_valid, l28_slot, l28_target, f,
 
 
 def _fused_kernel(verify_inner, values, present,
-                  ax, ay, at, rx, ry, s_nib, k_nib,
-                  upd_lane, upd_vals, rep_meta, tpack):
+                  ax, ay, at, rx, ry, s_nib, k_nib, side):
     """Verification + scatter + tally as ONE launch (the north-star
     fusion: tallies are masked reductions fused behind the verification
     mask, and the settle pass pays a single device round trip — the same
@@ -231,13 +230,19 @@ def _fused_kernel(verify_inner, values, present,
     the shared window (every lockstep replica receives the same
     broadcasts), not a scatter — XLA scatters serialize badly on TPU
     (measured ~10 ms per settle at 256 replicas), while this merge is
-    three elementwise passes over the grid:
+    three elementwise passes over the grid.
 
-    - ``upd_lane [2, R, V]`` int32: the verify lane whose verdict gates
-      the lane's update, -1 where the window has no vote for that lane
+    All host-built side inputs arrive as ONE flat int32 array (``side``)
+    — every separate ``jnp.asarray`` is its own host->device transfer
+    with per-call latency over a tunnel. Layout (sizes static from the
+    grid shape): upd_lane [2*R*V] | upd_vals [2*R*V*8] | rep_meta [n*4]
+    | tpack [n*(R*8+R+8)], where
+
+    - ``upd_lane [2, R, V]``: the verify lane whose verdict gates the
+      lane's update, -1 where the window has no vote for that lane
       (duplicate/conflicting claims are resolved host-side; conflicts
       poison the round via the dirty set).
-    - ``upd_vals [2, R, V, 8]`` int32: the vote value per updated lane.
+    - ``upd_vals [2, R, V, 8]``: the vote value per updated lane.
     - ``rep_meta [n, 4]``: reset, participate, l28_slot, f.
     - ``tpack [n, R*8 + R + 8]``: per-round target words | target-valid |
       the L28 target words.
@@ -249,6 +254,14 @@ def _fused_kernel(verify_inner, values, present,
     """
     n, _, R, V, _ = values.shape
     mask = verify_inner(ax, ay, at, rx, ry, s_nib, k_nib)  # [B] bool
+    lanes = 2 * R * V
+    o1 = lanes
+    o2 = o1 + lanes * 8
+    o3 = o2 + n * 4
+    upd_lane = side[:o1].reshape(2, R, V)
+    upd_vals = side[o1:o2].reshape(2, R, V, 8)
+    rep_meta = side[o2:o3].reshape(n, 4)
+    tpack = side[o3:].reshape(n, R * 8 + R + 8)
     reset = rep_meta[:, 0].astype(bool)
     participate = rep_meta[:, 1].astype(bool)
     l28_slot = rep_meta[:, 2]
@@ -455,13 +468,21 @@ class VoteGrid:
         [2, R, V, 8]``: the dense one-superstep update image (see
         :func:`_fused_kernel`)."""
         b = verify_arrays[0].shape[0]
-        n, R = self.n, self.R
-        rep_meta = np.empty((n, 4), dtype=np.int32)
+        n, R, V = self.n, self.R, self.V
+        lanes = 2 * R * V
+        tw = R * 8 + R + 8
+        side = np.empty(lanes * 9 + n * (4 + tw), dtype=np.int32)
+        o1 = lanes
+        o2 = o1 + lanes * 8
+        o3 = o2 + n * 4
+        side[:o1] = upd_lane.reshape(-1)
+        side[o1:o2] = upd_vals.reshape(-1)
+        rep_meta = side[o2:o3].reshape(n, 4)
         rep_meta[:, 0] = reset
         rep_meta[:, 1] = participate
         rep_meta[:, 2] = l28_slot
         rep_meta[:, 3] = f
-        tpack = np.empty((n, R * 8 + R + 8), dtype=np.int32)
+        tpack = side[o3:].reshape(n, tw)
         tpack[:, : R * 8] = targets.reshape(n, R * 8)
         tpack[:, R * 8 : R * 8 + R] = target_valid
         tpack[:, R * 8 + R :] = l28_target
@@ -469,10 +490,7 @@ class VoteGrid:
             self._values,
             self._present,
             *(jnp.asarray(a) for a in verify_arrays),
-            jnp.asarray(upd_lane),
-            jnp.asarray(upd_vals),
-            jnp.asarray(rep_meta),
-            jnp.asarray(tpack),
+            jnp.asarray(side),
         )
         # Start the device->host copy immediately so the transfer overlaps
         # whatever host work precedes the first access.
