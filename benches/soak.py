@@ -25,6 +25,7 @@ DEADLINE = time.time() + (float(sys.argv[1]) if len(sys.argv) > 1 else 1200.0)
 master = random.Random(os.getpid() ^ int(time.time()))
 
 runs = 0
+_DEVICE_VER = None
 while time.time() < DEADLINE:
     seed = master.randrange(1 << 30)
     rng = random.Random(seed)
@@ -43,21 +44,63 @@ while time.time() < DEADLINE:
             i: (lambda h, r, i=i: bytes([i + 1]) * 32)
             for i in rng.sample(range(n), rng.randint(1, f))
         }
-    sim = Simulation(
+    burst = rng.random() < 0.5
+    reorder = rng.random() < 0.5
+    drop_rate = rng.choice([0.0, 0.0, 0.05])
+    sign = rng.random() < 0.3
+    # Device-tally draws run the vote grid through random scenarios with
+    # CheckedTallyView asserting device==host on every consulted count.
+    device_tally = burst and rng.random() < 0.25
+    tally_check = None
+    if device_tally:
+        from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+
+        tally_check = CheckedTallyView
+    # Signed burst draws sometimes verify through the device kernel with
+    # deduplication — with device_tally that exercises the FUSED
+    # verify+merge+tally launch under random faults (XLA backend on CPU;
+    # one shared instance so kernels compile once per soak process).
+    batch_verifier = None
+    dedup_verify = False
+    if sign and burst and rng.random() < 0.5:
+        if _DEVICE_VER is None:
+            from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+            _DEVICE_VER = TpuBatchVerifier(buckets=(64, 256), backend="xla")
+        batch_verifier = _DEVICE_VER
+        dedup_verify = True
+    kwargs = dict(
         n=n,
         target_height=rng.randint(3, 12),
         seed=seed,
-        reorder=rng.random() < 0.5,
-        drop_rate=rng.choice([0.0, 0.0, 0.05]),
+        reorder=reorder,
+        drop_rate=drop_rate,
         kill_at_step=kills or None,
         offline=offline or None,
         byzantine_proposer=byz or None,
-        sign=rng.random() < 0.3,
-        burst=rng.random() < 0.5,
+        sign=sign,
+        burst=burst,
+        batch_verifier=batch_verifier,
+        dedup_verify=dedup_verify,
+        device_tally=device_tally,
+        tally_check=tally_check,
     )
+    sim = Simulation(**kwargs)
     res = sim.run(max_steps=400_000)
     try:
         res.assert_safety()  # safety must hold, completed or stalled
+        # Shared-superstep differential: when the fast path was eligible,
+        # a slice of draws re-runs the scenario on the per-delivery path
+        # and asserts the trajectories are delivery-for-delivery equal.
+        if sim._shared_mode and rng.random() < 0.2:
+            slow = Simulation(**kwargs, shared_superstep=False)
+            sres = slow.run(max_steps=400_000)
+            assert sres.steps == res.steps, "shared/slow step divergence"
+            assert sres.commits == res.commits, "shared/slow commit divergence"
+            if res.record is not None:
+                assert sres.record.messages == res.record.messages, (
+                    "shared/slow record divergence"
+                )
     except AssertionError as e:
         raise AssertionError(f"seed={seed}: {e}") from None
     if res.completed and rng.random() < 0.3:
