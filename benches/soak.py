@@ -85,22 +85,29 @@ while time.time() < DEADLINE:
         device_tally=device_tally,
         tally_check=tally_check,
     )
-    sim = Simulation(**kwargs)
-    res = sim.run(max_steps=400_000)
     try:
+        sim = Simulation(**kwargs)
+        res = sim.run(max_steps=400_000)
         res.assert_safety()  # safety must hold, completed or stalled
         # Shared-superstep differential: when the fast path was eligible,
         # a slice of draws re-runs the scenario on the per-delivery path
-        # and asserts the trajectories are delivery-for-delivery equal.
+        # and asserts the trajectories are delivery-for-delivery equal —
+        # the same equality the unit differential defines (steps, clock,
+        # commits, burst boundaries, recorded delivery stream).
         if sim._shared_mode and rng.random() < 0.2:
             slow = Simulation(**kwargs, shared_superstep=False)
             sres = slow.run(max_steps=400_000)
             assert sres.steps == res.steps, "shared/slow step divergence"
+            assert sres.virtual_time == res.virtual_time, (
+                "shared/slow clock divergence"
+            )
             assert sres.commits == res.commits, "shared/slow commit divergence"
-            if res.record is not None:
-                assert sres.record.messages == res.record.messages, (
-                    "shared/slow record divergence"
-                )
+            assert sres.record.bursts == res.record.bursts, (
+                "shared/slow burst-boundary divergence"
+            )
+            assert sres.record.messages == res.record.messages, (
+                "shared/slow record divergence"
+            )
     except AssertionError as e:
         raise AssertionError(f"seed={seed}: {e}") from None
     if res.completed and rng.random() < 0.3:
