@@ -236,12 +236,10 @@ class RecordedMessages:
 
     _TARGETED = None  # sentinel 'live' meaning a single (to, msg) delivery
 
-    def __init__(self, items=()):
+    def __init__(self):
         self._ops: list = []
         self._len = 0
         self._flat = None
-        for it in items:
-            self.append(it)
 
     def append(self, item) -> None:
         """One targeted delivery: item = (to, msg)."""
