@@ -171,6 +171,56 @@ def test_rlc_differential_random(rlc_verifier, ring, rng):
     assert got == want
 
 
+def test_pack_dedups_repeated_triples_identically(ring):
+    # A duplicate-heavy batch (every receiver re-verifying the same
+    # broadcasts) must pack each distinct triple once and fan the rows
+    # out — bit-identical to packing the expanded list row by row.
+    from hyperdrive_tpu.crypto import ed25519 as host_ed
+    from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost
+
+    host = Ed25519BatchHost(buckets=(16, 64))
+    base = []
+    for v in range(4):
+        d = bytes([v]) * 32
+        base.append((ring[v].public, d, host_ed.sign(ring[v].seed, d)))
+    base.append((b"\xff" * 32, b"\x00" * 32, b"\x01" * 64))  # malformed
+    repeated = base * 3 + base[:2]
+
+    arrays_r, prevalid_r, n_r = host.pack(repeated)
+    assert n_r == len(repeated)
+    # Reference: pack each item alone (no dedup possible) and compare rows.
+    for i, it in enumerate(repeated):
+        arrays_1, prevalid_1, _ = host.pack([it])
+        for a_r, a_1 in zip(arrays_r, arrays_1):
+            np.testing.assert_array_equal(a_r[i], a_1[0])
+        assert bool(prevalid_r[i]) == bool(prevalid_1[0])
+
+
+def test_verify_signatures_redundant_batch_matches_host(verifier, ring):
+    # A duplicate-heavy batch rides the device-expansion path (unique
+    # rows + gather index shipped, full ladder on every lane); verdicts
+    # must equal both the per-unique verdicts fanned out and the host
+    # oracle, including forged and malformed lanes.
+    from hyperdrive_tpu.crypto import ed25519 as host_ed
+    from hyperdrive_tpu.verifier import HostVerifier
+
+    base = []
+    for v in range(5):
+        d = bytes([v + 1]) * 32
+        sig = host_ed.sign(ring[v].seed, d)
+        if v == 2:  # forged lane: parses, must reject on device
+            sig = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
+        base.append((ring[v].public, d, sig))
+    base.append((b"\xff" * 32, b"\x07" * 32, b"\x01" * 64))  # malformed
+    repeated = base * 13  # 78 items, 6 unique -> dedup path engages
+    got = np.asarray(verifier.verify_signatures(repeated))
+    unique = np.asarray(verifier.verify_signatures(base))
+    np.testing.assert_array_equal(got, np.tile(unique, 13))
+    host = np.asarray(HostVerifier().verify_signatures(repeated))
+    np.testing.assert_array_equal(got, host)
+    assert got.any() and not got.all()
+
+
 def test_wrong_length_signatures_reject_deterministically(verifier, ring):
     # Wrong-length signatures must be structurally rejected on every path
     # (never zero-padded and verified: with an adversarial small-order
