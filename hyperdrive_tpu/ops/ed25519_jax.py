@@ -546,7 +546,7 @@ class Ed25519BatchHost:
     def bucket_for(self, n: int) -> int:
         return bucketing.bucket_for(n, self.buckets)
 
-    def pack(self, items):
+    def pack(self, items, _scan=None):
         """items: iterable of (pub32, digest, sig64).
 
         Returns (arrays, prevalid, n) where arrays feed verify_kernel,
@@ -556,23 +556,19 @@ class Ed25519BatchHost:
         items = list(items)
         n = len(items)
 
-        # Duplicate-heavy batches — e.g. one simulated chip carrying every
+        # Duplicate-HEAVY batches — e.g. one simulated chip carrying every
         # receiver's redundant verification load, where each broadcast's
         # triple repeats once per receiver — pack each DISTINCT triple
         # once and fan the packed rows out by index. Point decompression
         # dominates host packing cost (~45us/triple through the native
         # runtime), while a row copy is ~1us; identical inputs pack
-        # identically, so verdicts are unchanged.
-        index: dict = {}
-        inv = np.empty(n, dtype=np.int64)
-        uniq = []
-        for i, it in enumerate(items):
-            j = index.get(it)
-            if j is None:
-                j = index[it] = len(uniq)
-                uniq.append(it)
-            inv[i] = j
-        if len(uniq) < n:
+        # identically, so verdicts are unchanged. Majority-duplicate
+        # threshold: for lightly-duplicated batches the extra bucket-sized
+        # allocation + full-row copies cost more than the few repacks they
+        # save. ``_scan``: a precomputed (uniq, inv) from the caller's own
+        # :func:`_dedup_scan`, so the verify path scans each chunk once.
+        uniq, inv = _scan if _scan is not None else _dedup_scan(items)
+        if 2 * len(uniq) <= n:
             arrays_u, prevalid_u, nu = self.pack(uniq)
             bsz = self.bucket_for(max(n, 1))
             out = []
@@ -688,6 +684,22 @@ def rlc_scalars(s_nib, k_nib, prevalid, binder: bytes):
         _nibbles_from_rows(z_rows),
         _nibbles_from_rows(c_rows[None, :]),
     )
+
+
+def _dedup_scan(items):
+    """One pass over (pub, digest, sig) triples: returns (uniq, inv)
+    with items[i] == uniq[inv[i]]. Shared by the packer and the
+    verifier's device-expansion path so a chunk is hash-scanned once."""
+    index: dict = {}
+    uniq: list = []
+    inv = np.empty(len(items), dtype=np.int32)
+    for i, it in enumerate(items):
+        j = index.get(it)
+        if j is None:
+            j = index[it] = len(uniq)
+            uniq.append(it)
+        inv[i] = j
+    return uniq, inv
 
 
 @functools.lru_cache(maxsize=None)
@@ -807,12 +819,13 @@ class TpuBatchVerifier:
         pending = []
         for lo in range(0, len(items), cap):
             chunk = items[lo : lo + cap]
+            scan = None
             if self._rlc_fn is None:
-                dedup = self._verify_chunk_deduped(chunk)
-                if dedup is not None:
-                    pending.append(dedup)
+                scan = _dedup_scan(chunk)
+                if 2 * len(scan[0]) <= len(chunk):
+                    pending.append(self._verify_chunk_deduped(chunk, scan))
                     continue
-            arrays, prevalid, n = self.host.pack(chunk)
+            arrays, prevalid, n = self.host.pack(chunk, _scan=scan)
             if not prevalid.any():
                 pending.append((None, None, prevalid, n))
                 continue
@@ -880,32 +893,29 @@ class TpuBatchVerifier:
                 out.append((np.asarray(dev) & prevalid)[:n])
         return out[0] if len(out) == 1 else np.concatenate(out)
 
-    def _verify_chunk_deduped(self, chunk):
+    def _verify_chunk_deduped(self, chunk, scan):
         """Duplicate-heavy chunk path: pack each distinct triple once,
         ship the unique rows plus an expansion index, gather+verify on
-        device (see :func:`_expand_verify_jit`). Returns a ``pending``
-        entry, or None when the chunk is mostly unique (the plain path's
-        single gather-free launch wins there)."""
-        index: dict = {}
-        uniq: list = []
-        inv = np.empty(len(chunk), dtype=np.int32)
-        for i, it in enumerate(chunk):
-            j = index.get(it)
-            if j is None:
-                j = index[it] = len(uniq)
-                uniq.append(it)
-            inv[i] = j
-        if 2 * len(uniq) > len(chunk):
-            return None
+        device (see :func:`_expand_verify_jit`). ``scan``: the caller's
+        (uniq, inv) from :func:`_dedup_scan`. Returns a ``pending``
+        entry."""
+        uniq, inv = scan
         arrays_u, prevalid_u, nu = self.host.pack(uniq)
-        bn = self.host.bucket_for(len(chunk))
+        prevalid = np.zeros(
+            self.host.bucket_for(len(chunk)), dtype=bool
+        )
+        prevalid[: len(chunk)] = prevalid_u[inv]
+        if not prevalid.any():
+            # Every lane malformed (e.g. a flood of one unparseable
+            # triple): rejection is already decided host-side — skip the
+            # launch and its ~100ms mask round trip.
+            return (None, None, prevalid, len(chunk))
+        bn = prevalid.shape[0]
         inv_p = np.zeros(bn, dtype=np.int32)
         inv_p[: len(chunk)] = inv
         dev = _expand_verify_jit(self.fused_inner(bn))(
             *(jnp.asarray(a) for a in arrays_u), jnp.asarray(inv_p)
         )
-        prevalid = np.zeros(bn, dtype=bool)
-        prevalid[: len(chunk)] = prevalid_u[inv]
         return (dev, None, prevalid, len(chunk))
 
     def verify_batch(self, window):
