@@ -290,6 +290,35 @@ def test_burst_differential_modes_agree_and_replay_preserves_mode(tmp_path):
     assert replayed.heights == serial.heights
 
 
+def test_recorded_messages_list_compatibility():
+    # The broadcast-compact delivery log must behave exactly like the
+    # flat per-delivery list every consumer assumes: length accounting,
+    # indexing/slicing, iteration, equality against plain lists (loaded
+    # dumps), and appends remaining consistent after materialization.
+    from hyperdrive_tpu.harness.sim import RecordedMessages
+
+    log = RecordedMessages()
+    log.append((3, "t0"))
+    log.append_broadcast("b0", [0, 1, 2])
+    log.append((1, "t1"))
+    expect = [(3, "t0"), (0, "b0"), (1, "b0"), (2, "b0"), (1, "t1")]
+    assert len(log) == 5
+    assert log == expect and not log != expect
+    assert log[1] == (0, "b0")
+    assert log[1:4] == expect[1:4]
+    assert list(log) == expect
+    # Appends after the flat view exists stay visible and consistent.
+    log.append_broadcast("b1", [2, 0])
+    log.append((0, "t2"))
+    expect += [(2, "b1"), (0, "b1"), (0, "t2")]
+    assert len(log) == 8
+    assert log == expect
+    other = RecordedMessages()
+    for to, m in expect:
+        other.append((to, m))
+    assert log == other
+
+
 def test_shared_superstep_is_delivery_for_delivery_identical():
     # The shared-superstep fast path (one queue entry / one sort / one
     # verify per broadcast) must reproduce the per-delivery burst path
