@@ -41,15 +41,17 @@ def main():
         r = json.load(fh)
     run["measured_at"] = time.strftime("%Y-%m-%d %H:%M:%S")
     run["note"] = (
-        "replay recorder disabled (record=False; retaining every delivery "
-        "for replay costs ~12 GB and ~25% of throughput at this depth). "
-        "The round-2 depth decay was diagnosed to two growing structures: "
-        "the recorder (fixed by record=False) and the virtual clock's "
-        "timeout heap, which accumulated ~255 stale propose-timeouts per "
-        "height because the happy path never drains the queue "
-        "(VirtualClock.prune now drops timeouts below every live "
-        "replica's height once the heap passes 64k entries); with both "
-        "fixed, a 300-height probe shows no rate decay beyond +-5% noise"
+        "replay recorder disabled (record=False: a 10k-height dump would "
+        "serialize 1.3B deliveries — the replay workflow isn't meaningful "
+        "at this depth; in-memory recording itself is now broadcast-"
+        "compact and near-free). The round-2 depth decay was diagnosed to "
+        "two growing structures: the then-per-delivery recorder and the "
+        "virtual clock's timeout heap, which accumulated ~255 stale "
+        "propose-timeouts per height because the happy path never drains "
+        "the queue (VirtualClock.prune now drops timeouts below every "
+        "live replica's height once the heap passes 64k entries); with "
+        "both fixed, a 300-height probe shows no rate decay beyond +-5% "
+        "noise"
     )
     r["dedup_run_deep"] = run
     r["cap"] = (

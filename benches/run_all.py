@@ -421,11 +421,12 @@ def config_4() -> dict:
     return {
         "config": "4: 256 validators, Ed25519 TPU batch-verify offload",
         "cap": (
-            "e2e runs are 100 heights (dedup/device-tally, measured as 5 "
-            "PAIRED alternating 20-height blocks per mode so tunnel drift "
-            "cannot bias the comparison) and 20 heights (redundant), not "
-            "BASELINE's 10k — rates are sustained and height-invariant "
-            "once warm; nothing here is projected"
+            "e2e runs are 100 heights (dedup/device-tally, measured as "
+            "PAIRED alternating 10-height blocks per mode so tunnel drift "
+            "cannot bias the comparison) and 20 heights (redundant); the "
+            "full BASELINE 10k-height depth is dedup_run_deep — rates are "
+            "sustained and height-invariant once warm; nothing here is "
+            "projected"
         ),
         "device": str(jax.devices()[0]),
         "warmup_s": round(warm_s, 1),
