@@ -1,12 +1,29 @@
-"""Benchmark: votes verified per second on one TPU chip, 256 validators.
+"""Benchmark: SUSTAINED votes verified per second on one TPU chip.
 
 The north-star metric (BASELINE.md): batched Ed25519 verification of
 consensus votes — 256 validators' signatures over vote digests, verified
 in wide batches fused with the quorum tally — target >= 50k votes/sec on
 one v5e chip.
 
+Round-4 headline: the sustained UNIQUE-signature pipeline. Every timed
+launch consumes a fresh batch of 65,536 distinct signatures; the host
+packs batch k+1 while the device verifies batch k. No input reuse — this
+is the rate a deployment's mq drain loop could sustain (reference hot
+path: /root/reference/process/process.go:574-579), not a kernel ceiling
+fed by a pre-packed buffer.
+
+Data path (ops/ed25519_wire.py): point decompression runs ON DEVICE; the
+host does SHA-512 challenges + range checks only. The consensus validator
+set is known, so A ships as a 4-byte index into a device-resident
+decompressed-pubkey table — 100 B/lane over the link (R 32 + s 32 + k 32
++ idx 4). On this tunnel-attached chip (~8 MB/s H2D, BENCH.md) the
+pipeline is TRANSFER-bound, so bytes/lane — not kernel speed and not host
+speed — set the sustained rate; the full-wire (128 B/lane) rate, the
+device-only ceiling, and the host pack rate are reported alongside so the
+bottleneck is visible.
+
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 """
 
 from __future__ import annotations
@@ -20,134 +37,215 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from hyperdrive_tpu.crypto import ed25519 as host_ed
 from hyperdrive_tpu.crypto.keys import KeyRing
 from hyperdrive_tpu.messages import Prevote
-from hyperdrive_tpu.ops.ed25519_jax import Ed25519BatchHost, make_verify_fn
-from hyperdrive_tpu.ops.ed25519_pallas import (
-    make_pallas_verify_fn,
-    resolve_backend,
+from hyperdrive_tpu.ops.ed25519_pallas import resolve_backend
+from hyperdrive_tpu.ops.ed25519_wire import (
+    Ed25519WireHost,
+    ValidatorTable,
+    make_semiwire_verify_fn,
+    make_wire_verify_fn,
 )
 from hyperdrive_tpu.ops.tally import pack_values, tally_counts, quorum_flags
 
 N_VALIDATORS = 256
-# In-flight (height, round) pairs per launch. Measured Pallas-backend
-# sweep on v5e (8-iter pipeline): 128 rounds (32k sigs) -> 489k/s,
-# 256 (64k) -> 532k/s, 512 (128k) -> 565k/s, 1024 (256k) -> 580k/s.
-# Gains flatten under 3% per doubling past 256 rounds while per-launch
-# latency doubles; 256 rounds (0.12 s/launch) is the shipped operating
-# point. (XLA-fallback sweep peaked at 64.4-66k/s around 128-256 rounds.)
+# In-flight (height, round) pairs per launch: 256 rounds x 256 validators
+# = 65,536 signatures/launch (the round-3 sweep's operating point — past
+# 256 rounds gains flatten under 3%/doubling while launch latency
+# doubles).
 ROUNDS = 256
-BATCH = N_VALIDATORS * ROUNDS  # 65536 signatures per device launch
+BATCH = N_VALIDATORS * ROUNDS
 TARGET_VOTES_PER_SEC = 50_000.0
 
+#: Timed launches per trial. Every launch gets its own fresh signature
+#: batch within a trial (pack || transfer || verify overlap); batches are
+#: re-used ACROSS trials but re-packed in full each time, so no packed
+#: tensor ever crosses a trial boundary.
+ITERS = 4
+TRIALS = 3
 
-def build_batch():
-    """256 validators each sign one prevote per round; rounds tile the
-    batch so packing cost stays small while the device sees 4096 distinct
-    (pubkey, digest, signature) lanes."""
-    ring = KeyRing.deterministic(N_VALIDATORS, namespace=b"bench")
-    value = b"\x2a" * 32
-    items = []
-    base_msgs = []
-    for v in range(N_VALIDATORS):
-        pv = Prevote(height=1, round=0, value=value, sender=ring[v].public)
-        digest = pv.digest()
-        sig = host_ed.sign(ring[v].seed, digest)
-        base_msgs.append((ring[v].public, digest, sig))
-    for r in range(ROUNDS):
-        items.extend(base_msgs)
-
-    host = Ed25519BatchHost(buckets=(BATCH,))
-    arrays, prevalid, n = host.pack(items)
-    assert n == BATCH and prevalid.all()
-
-    vote_vals = jnp.asarray(
-        np.broadcast_to(
-            pack_values([value])[0], (ROUNDS, N_VALIDATORS, 8)
-        ).copy()
-    )
-    target_vals = jnp.asarray(pack_values([value] * ROUNDS))
-    return tuple(jnp.asarray(a) for a in arrays), vote_vals, target_vals
-
-
-# Kernel backend: the Pallas ladder on TPU (7.5x), the XLA kernel elsewhere.
-# `python bench.py xla` forces the fallback so its published figure stays
-# reproducible with this same harness.
 BACKEND = resolve_backend(sys.argv[1] if len(sys.argv) > 1 else None)
-_verify = make_pallas_verify_fn() if BACKEND == "pallas" else make_verify_fn()
+
+
+def _verify_fns():
+    if BACKEND == "pallas":
+        from hyperdrive_tpu.ops.ed25519_pallas import (
+            make_pallas_semiwire_verify_fn,
+            make_pallas_wire_verify_fn,
+        )
+
+        return make_pallas_semiwire_verify_fn(), make_pallas_wire_verify_fn()
+    return make_semiwire_verify_fn(), make_wire_verify_fn()
+
+
+_semi_verify, _full_verify = _verify_fns()
 
 
 @jax.jit
-def step(ax, ay, at, rx, ry, s_nib, k_nib, vote_vals, target_vals, f):
-    ok = _verify(ax, ay, at, rx, ry, s_nib, k_nib)
-    counts = tally_counts(vote_vals, ok.reshape(ROUNDS, N_VALIDATORS), target_vals)
+def step(idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid,
+         vote_vals, target_vals, f):
+    ok = _semi_verify(idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid)
+    counts = tally_counts(
+        vote_vals, ok.reshape(ROUNDS, N_VALIDATORS), target_vals
+    )
     flags = quorum_flags(counts, f)
     return ok, counts, flags
 
 
-def main():
-    t0 = time.time()
-    arrays, vote_vals, target_vals = build_batch()
-    f = jnp.int32(N_VALIDATORS // 3)
-    pack_s = time.time() - t0
+@jax.jit
+def step_full(a_rows, r_rows, s_rows, k_rows, vote_vals, target_vals, f):
+    ok = _full_verify(a_rows, r_rows, s_rows, k_rows)
+    counts = tally_counts(
+        vote_vals, ok.reshape(ROUNDS, N_VALIDATORS), target_vals
+    )
+    flags = quorum_flags(counts, f)
+    return ok, counts, flags
 
-    # Warmup / compile. (np.asarray, not block_until_ready: the latter is
-    # unreliable over the axon tunnel — materializing is the only honest
-    # completion barrier.)
-    ok, counts, flags = step(*arrays, vote_vals, target_vals, f)
-    if not bool(np.asarray(ok).all()):
-        print(
-            json.dumps(
-                {
-                    "metric": "votes verified/sec/chip @256 validators",
-                    "value": 0.0,
-                    "unit": "votes/s",
-                    "vs_baseline": 0.0,
-                    "error": "verification kernel rejected valid signatures",
-                }
+
+def build_batches(ring):
+    """ITERS batches of 65,536 UNIQUE signatures: 256 validators each
+    sign one prevote per (round, iter) — every digest distinct, so no
+    dedup/caching anywhere in the pipeline can shortcut the work.
+    Signing is the signers' cost, not the verifier's: generated here,
+    untimed, through the native signer."""
+    batches = []
+    tallies = []
+    for it in range(ITERS):
+        items = []
+        values = []
+        for r in range(ROUNDS):
+            value = bytes([it, r % 256, r // 256]) + b"\x2a" * 29
+            values.append(value)
+            for v in range(N_VALIDATORS):
+                pv = Prevote(
+                    height=1 + it, round=r, value=value,
+                    sender=ring[v].public,
+                )
+                digest = pv.digest()
+                items.append(
+                    (ring[v].public, digest, ring[v].sign_digest(digest))
+                )
+        vote_vals = jnp.asarray(
+            np.repeat(
+                pack_values(values)[:, None, :], N_VALIDATORS, axis=1
             )
         )
+        target_vals = jnp.asarray(pack_values(values))
+        batches.append(items)
+        tallies.append((vote_vals, target_vals))
+    return batches, tallies
+
+
+def _timed_trials(launch_fn):
+    """TRIALS timed pipelines of ITERS launches; returns votes/s rates.
+    The last launch's mask is materialized inside the timed region (the
+    device executes enqueued programs in order, so that transfer bounds
+    the whole pipeline); np.asarray is the completion barrier —
+    block_until_ready is unreliable over the axon tunnel. EVERY launch's
+    mask is then checked after the clock stops: the published rate must
+    never cover unverified work, and the post-timing fetches cost the
+    trials nothing."""
+    rates = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        oks = [launch_fn(k) for k in range(ITERS)]
+        np.asarray(oks[-1])
+        dt = time.perf_counter() - t0
+        for ok in oks:
+            if not bool(np.asarray(ok).all()):
+                raise RuntimeError("pipeline rejected valid signatures")
+        rates.append(BATCH * ITERS / dt)
+    return rates
+
+
+def main():
+    ring = KeyRing.deterministic(N_VALIDATORS, namespace=b"bench")
+    table = ValidatorTable([ring[v].public for v in range(N_VALIDATORS)])
+    tbl = table.arrays()
+    host = Ed25519WireHost(buckets=(BATCH,))
+    f = jnp.int32(N_VALIDATORS // 3)
+
+    t0 = time.perf_counter()
+    batches, tallies = build_batches(ring)
+    gen_s = time.perf_counter() - t0
+
+    # Warmup / compile + correctness gate on batch 0 (both paths).
+    rows0, prevalid0, n0 = host.pack_wire_indexed(batches[0], table)
+    assert n0 == BATCH and prevalid0.all()
+    dev0 = tuple(jnp.asarray(r) for r in rows0)
+    ok, counts, flags = step(*dev0, *tbl, *tallies[0], f)
+    if not bool(np.asarray(ok).all()):
+        print(json.dumps({
+            "metric": "sustained votes verified/sec/chip @256 validators",
+            "value": 0.0, "unit": "votes/s", "vs_baseline": 0.0,
+            "error": "verification kernel rejected valid signatures",
+        }))
         sys.exit(1)
     assert bool(np.asarray(flags["quorum_matching"]).all())
+    full0, fpv0, _ = host.pack_wire(batches[0])
+    fdev0 = tuple(jnp.asarray(r) for r in full0)
+    assert fpv0.all()
+    ok_f, _, _ = step_full(*fdev0, *tallies[0], f)
+    assert bool(np.asarray(ok_f).all())
 
-    # Steady state: dispatch the in-order stream, materialize the last
-    # result inside the timed region (the device executes enqueued programs
-    # in order, so the final transfer bounds the pipeline). Three timed
-    # trials so the reported rate carries its own variance instead of a
-    # single 8-iter sample.
-    iters = 8
-    trials = 3
-    rates = []
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        last = None
-        for _ in range(iters):
-            ok, counts, flags = step(*arrays, vote_vals, target_vals, f)
-            last = ok
-        final = np.asarray(last)  # materialization = the completion barrier
-        dt = time.perf_counter() - t0
-        if not bool(final.all()):
-            raise RuntimeError("verification kernel rejected valid signatures")
-        rates.append(BATCH * iters / dt)
-
-    votes_per_sec = float(np.median(rates))
-    print(
-        json.dumps(
-            {
-                "metric": "votes verified/sec/chip @256 validators",
-                "value": round(votes_per_sec, 1),
-                "unit": "votes/s",
-                "vs_baseline": round(votes_per_sec / TARGET_VOTES_PER_SEC, 4),
-                "backend": BACKEND,
-                "batch": BATCH,
-                "iters": iters,
-                "trial_rates": [round(r, 1) for r in rates],
-                "host_pack_seconds": round(pack_s, 2),
-                "device": str(jax.devices()[0]),
-            }
+    # --- Headline: sustained indexed-wire pipeline, fresh signatures
+    # every launch (pack -> enqueue -> pack next while device works).
+    def launch_indexed(k):
+        rows, prevalid, _ = host.pack_wire_indexed(batches[k], table)
+        if not prevalid.all():
+            raise RuntimeError(f"batch {k}: packer rejected lanes")
+        ok, counts, flags = step(
+            *(jnp.asarray(r) for r in rows), *tbl, *tallies[k], f
         )
+        return ok
+
+    sustained = _timed_trials(launch_indexed)
+
+    # --- Secondary: full-wire path (arbitrary pubkeys, 128 B/lane).
+    def launch_full(k):
+        rows, prevalid, _ = host.pack_wire(batches[k])
+        if not prevalid.all():
+            raise RuntimeError(f"batch {k}: packer rejected lanes")
+        ok, counts, flags = step_full(
+            *(jnp.asarray(r) for r in rows), *tallies[k], f
+        )
+        return ok
+
+    sustained_full = _timed_trials(launch_full)
+
+    # --- Device ceiling: same pipelining, pre-packed device-resident
+    # inputs reused (no per-launch transfer).
+    device_only = _timed_trials(
+        lambda k: step(*dev0, *tbl, *tallies[0], f)[0]
     )
+
+    # --- Pack-only rate (the host leg in isolation).
+    t0 = time.perf_counter()
+    host.pack_wire_indexed(batches[1], table)
+    pack_s = time.perf_counter() - t0
+
+    votes_per_sec = float(np.median(sustained))
+    print(json.dumps({
+        "metric": "sustained votes verified/sec/chip @256 validators",
+        "value": round(votes_per_sec, 1),
+        "unit": "votes/s",
+        "vs_baseline": round(votes_per_sec / TARGET_VOTES_PER_SEC, 4),
+        "backend": BACKEND,
+        "batch": BATCH,
+        "iters": ITERS,
+        "unique_signatures": True,
+        "bytes_per_lane": 100,
+        "sustained_trials": [round(r, 1) for r in sustained],
+        "sustained_full_wire_votes_per_s": round(
+            float(np.median(sustained_full)), 1
+        ),
+        "full_wire_bytes_per_lane": 128,
+        "device_only_votes_per_s": round(float(np.median(device_only)), 1),
+        "wire_pack_sigs_per_s": round(BATCH / pack_s, 1),
+        "wire_pack_seconds": round(pack_s, 3),
+        "siggen_seconds_untimed": round(gen_s, 1),
+        "device": str(jax.devices()[0]),
+    }))
 
 
 if __name__ == "__main__":

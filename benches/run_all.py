@@ -455,7 +455,10 @@ def config_4() -> dict:
             and p50_storm_routed <= 1.02 * p50_storm_host
         ),
         "adaptive_crossover_sigs": adaptive.crossover,
-        "adaptive_rates": [round(float(x), 1) for x in (adaptive.rates or ())],
+        "adaptive_calibration": {
+            k: round(float(v), 4 if k == "device_overhead_s" else 1)
+            for k, v in (adaptive.rates or {}).items()
+        },
         "device_sync_floor_ms": round(sync_floor * 1e3, 1),
         "sync_floor_equivalent_sigs": floor_sigs,
         "sub_crossover_note": (

@@ -342,6 +342,7 @@ class Simulation:
         dedup_reconstruct: bool = True,
         record: bool = True,
         shared_superstep: Optional[bool] = None,
+        small_window_host: Optional[bool] = None,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -431,10 +432,25 @@ class Simulation:
         #: window in well under a millisecond with bit-identical verdicts
         #: (differentially tested). This is the AdaptiveVerifier insight
         #: applied at the settle layer; vote-bearing windows stay on
-        #: device.
+        #: device. ``small_window_host`` is a differential-testing knob
+        #: (like ``shared_superstep``/``batch_ingest``): None = auto (on
+        #: for fused-capable device verifiers), False forces every window
+        #: — however small — through the device backend so e2e tests can
+        #: exercise the device verify path at miniature scales, True
+        #: demands the routing (error if there is no batch verifier to
+        #: route around, rather than silently doing nothing).
         self._small_win_host = None
-        if batch_verifier is not None and hasattr(
-            batch_verifier, "fused_inner"
+        if small_window_host is True and batch_verifier is None:
+            raise ValueError(
+                "small_window_host=True requires a batch_verifier to "
+                "route small windows away from"
+            )
+        if batch_verifier is not None and (
+            small_window_host is True
+            or (
+                small_window_host is None
+                and hasattr(batch_verifier, "fused_inner")
+            )
         ):
             from hyperdrive_tpu.verifier import HostVerifier
 
@@ -949,6 +965,15 @@ class Simulation:
                     delivered += 1
                     # A targeted event (timeout/reset) may kill nobody but
                     # never changes aliveness; live stays valid.
+                if __debug__:
+                    # Enforce the invariant the loop above leans on: if a
+                    # future scenario hook toggled aliveness from a handler,
+                    # broadcasts already expanded against the stale ``live``
+                    # list would silently diverge from _settle's windows.
+                    # Fail loudly instead.
+                    assert live == [
+                        i for i in range(self.n) if alive[i]
+                    ], "aliveness changed mid-superstep (targeted handler?)"
                 if self._record_on:
                     self.record.bursts.append(delivered)
                 shared_batch = self._shared

@@ -69,6 +69,7 @@ def load():
             _lib_err = f"native build failed: {e}"
             return None
         lib.hd_pack_batch.restype = ctypes.c_int
+        lib.hd_pack_wire.restype = ctypes.c_int
         lib.hd_decompress.restype = ctypes.c_int
         lib.hd_sha512.restype = None
         lib.hd_mod_l.restype = None
@@ -213,6 +214,37 @@ class NativePacker:
             _i32ptr(ry),
             _i32ptr(s_nib),
             _i32ptr(k_nib),
+            _u8ptr(prevalid),
+        )
+        return prevalid.astype(bool)
+
+    def pack_wire_into(
+        self,
+        items,
+        a_rows: np.ndarray,
+        r_rows: np.ndarray,
+        s_rows: np.ndarray,
+        k_rows: np.ndarray,
+    ) -> np.ndarray:
+        """Wire-path packing (device-side decompression): writes 32-byte
+        rows (pub, R, s, k) for every item passing the host range checks;
+        returns the bool prevalid mask. Same item contract as
+        :meth:`pack_into`."""
+        n = len(items)
+        pubs, digests, digest_lens, dstride, sigs, in_ok = _marshal_items(items)
+        prevalid = np.zeros(n, dtype=np.uint8)
+        self._lib.hd_pack_wire(
+            _u8ptr(pubs),
+            _u8ptr(digests),
+            _i32ptr(digest_lens),
+            ctypes.c_int(dstride),
+            _u8ptr(sigs),
+            _u8ptr(in_ok),
+            ctypes.c_int(n),
+            _u8ptr(a_rows),
+            _u8ptr(r_rows),
+            _u8ptr(s_rows),
+            _u8ptr(k_rows),
             _u8ptr(prevalid),
         )
         return prevalid.astype(bool)

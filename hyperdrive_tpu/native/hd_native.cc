@@ -900,4 +900,56 @@ int hd_pack_batch(const u8 *pubs, const u8 *digests, const int32_t *digest_lens,
   return 0;
 }
 
+// The wire packer: the host half of the device-decompression verify path
+// (hyperdrive_tpu/ops/ed25519_wire.py). Point decompression — the
+// expensive field exponentiations that dominate hd_pack_batch — moves to
+// the device; this loop keeps only the cheap checks and the challenge
+// hash. For each item with in_ok[i] != 0:
+//   - reject non-canonical y encodings of A and R (y >= p, sign masked);
+//   - range-check s < L;
+//   - compute k = SHA-512(R || A || digest) mod L;
+//   - copy pub/R/s/k into 32-byte rows of the four output arrays.
+// Rows failing any check keep prevalid[i] = 0 (buffers pre-zeroed by the
+// caller). Throughput is hash+mod-L bound: no Fe math at all.
+int hd_pack_wire(const u8 *pubs, const u8 *digests, const int32_t *digest_lens,
+                 int dstride, const u8 *sigs, const u8 *in_ok, int n,
+                 u8 *a_rows, u8 *r_rows, u8 *s_rows, u8 *k_rows,
+                 u8 *prevalid) {
+  for (int i = 0; i < n; i++) {
+    prevalid[i] = 0;
+    if (in_ok && !in_ok[i]) continue;
+    const u8 *pub = pubs + 32 * i;
+    const u8 *sig = sigs + 64 * i;
+
+    u8 ymasked[32];
+    memcpy(ymasked, pub, 32);
+    ymasked[31] &= 0x7f;
+    if (!lt_le32(ymasked, P_BYTES)) continue;
+    memcpy(ymasked, sig, 32);
+    ymasked[31] &= 0x7f;
+    if (!lt_le32(ymasked, P_BYTES)) continue;
+
+    u64 s_words[4];
+    memcpy(s_words, sig + 32, 32);
+    if (!sc_lt_l(s_words)) continue;
+
+    Sha512 h;
+    h.update(sig, 32);
+    h.update(pub, 32);
+    h.update(digests + (size_t)dstride * i, (size_t)digest_lens[i]);
+    u8 kh[64];
+    h.final(kh);
+    u64 kw[8], kr[4];
+    memcpy(kw, kh, 64);
+    sc_mod_l_512(kw, kr);
+
+    memcpy(a_rows + (size_t)32 * i, pub, 32);
+    memcpy(r_rows + (size_t)32 * i, sig, 32);
+    memcpy(s_rows + (size_t)32 * i, sig + 32, 32);
+    memcpy(k_rows + (size_t)32 * i, kr, 32);
+    prevalid[i] = 1;
+  }
+  return 0;
+}
+
 }  // extern "C"

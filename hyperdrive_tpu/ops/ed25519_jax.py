@@ -568,7 +568,7 @@ class Ed25519BatchHost:
         # save. ``_scan``: a precomputed (uniq, inv) from the caller's own
         # :func:`_dedup_scan`, so the verify path scans each chunk once.
         uniq, inv = _scan if _scan is not None else _dedup_scan(items)
-        if 2 * len(uniq) <= n:
+        if n and 2 * len(uniq) <= n:
             arrays_u, prevalid_u, nu = self.pack(uniq)
             bsz = self.bucket_for(max(n, 1))
             out = []

@@ -55,6 +55,10 @@ from hyperdrive_tpu.ops.ed25519_jax import _b_niels_np, _recode_signed
 __all__ = [
     "verify_pallas",
     "make_pallas_verify_fn",
+    "wire_verify_pallas",
+    "make_pallas_wire_verify_fn",
+    "semiwire_verify_pallas",
+    "make_pallas_semiwire_verify_fn",
     "pallas_backend_ok",
     "resolve_backend",
 ]
@@ -255,6 +259,33 @@ def dbl_L(p3, need_t):
     return (*out, mul_L(e, h)) if need_t else out
 
 
+def _nsqr_L(x, n):
+    if n < 4:
+        for _ in range(n):
+            x = sqr_L(x)
+        return x
+    return lax.fori_loop(0, n, lambda _, v: sqr_L(v), x)
+
+
+def _pow22523_L(a):
+    """a^((p-5)/8) = a^(2^252 - 3), limb-major (fe25519.pow22523's chain
+    with the limb axis leading)."""
+    z2 = sqr_L(a)
+    z8 = _nsqr_L(z2, 2)
+    z9 = mul_L(a, z8)
+    z11 = mul_L(z2, z9)
+    z22 = sqr_L(z11)
+    z_5_0 = mul_L(z9, z22)
+    z_10_0 = mul_L(_nsqr_L(z_5_0, 5), z_5_0)
+    z_20_0 = mul_L(_nsqr_L(z_10_0, 10), z_10_0)
+    z_40_0 = mul_L(_nsqr_L(z_20_0, 20), z_20_0)
+    z_50_0 = mul_L(_nsqr_L(z_40_0, 10), z_10_0)
+    z_100_0 = mul_L(_nsqr_L(z_50_0, 50), z_50_0)
+    z_200_0 = mul_L(_nsqr_L(z_100_0, 100), z_100_0)
+    z_250_0 = mul_L(_nsqr_L(z_200_0, 50), z_50_0)
+    return mul_L(_nsqr_L(z_250_0, 2), a)
+
+
 def _is_zero_mod_p_L(d):
     """True per lane iff d (a sub_L output: value < 2^256) is 0 mod p —
     i.e. its fully-carried digits equal 0, p, or 2p (3p > 2^256).
@@ -273,6 +304,69 @@ def _is_zero_mod_p_L(d):
     return z0 | zp | z2p
 
 
+def _settle_digits_L(x):
+    """Carry-settle to EXACT base-2^13 digits of the represented value
+    (< 2^256 by the public invariant, so q below is at most 2). Same
+    settling argument as :func:`_is_zero_mod_p_L`."""
+    for _ in range(N + 2):
+        x, c = _pass_L(x)
+        x = _upd(x, 0, 1, x[0:1] + c * _F260)
+    return x
+
+
+def _ge_digits_L(x, cdig):
+    """Lexicographic x >= c on settled digit arrays ([N, B] vs [N, 1])."""
+    res = x[0:1] >= cdig[0:1]
+    for i in range(1, N):
+        gt = x[i : i + 1] > cdig[i : i + 1]
+        eq = x[i : i + 1] == cdig[i : i + 1]
+        res = gt | (eq & res)
+    return res
+
+
+def _parity_L(x):
+    """[1, B] canonical parity bit of a field element (< 2^256): settle to
+    exact digits, count the p-subtractions q in {0, 1, 2} needed to reach
+    [0, p), and flip the digit parity per subtraction (p is odd)."""
+    xs = _settle_digits_L(x)
+    q = _ge_digits_L(xs, _C["pdig"]).astype(jnp.int32) + _ge_digits_L(
+        xs, _C["p2dig"]
+    ).astype(jnp.int32)
+    return (xs[0:1] + q) & 1
+
+
+def _decompress_L(y, sign):
+    """RFC 8032 x-recovery, limb-major: y [N, B] (bit 255 cleared, y < p
+    guaranteed by the wire packer), sign [1, B] int32 -> (x [N, B],
+    ok [1, B] bool). Mirrors ed25519_wire.decompress_device case-for-case
+    (the jnp/XLA twin); differential tests enforce bit-exact agreement
+    with the host oracle's _recover_x."""
+    blk = y.shape[1]
+    row = lax.broadcasted_iota(jnp.int32, (N, blk), 0)
+    one = (row == 0).astype(jnp.int32)
+    y2 = sqr_L(y)
+    u = sub_L(y2, one)
+    # Const column ([N, 1]) second: mul_L slices its FIRST operand per
+    # limb, and a [1, 1] slice would need a both-axes vector broadcast
+    # Mosaic does not implement.
+    v = add_L(mul_L(y2, _C["d"]), one)
+    v2 = sqr_L(v)
+    uv3 = mul_L(u, mul_L(v2, v))
+    uv7 = mul_L(uv3, sqr_L(v2))
+    x = mul_L(uv3, _pow22523_L(uv7))
+    vx2 = mul_L(v, sqr_L(x))
+    ok_direct = _is_zero_mod_p_L(sub_L(vx2, u))
+    ok_flip = _is_zero_mod_p_L(add_L(vx2, u))
+    x = _sel_rows(
+        ok_flip & jnp.logical_not(ok_direct), mul_L(x, _C["sqrtm1"]), x
+    )
+    ok = ok_direct | ok_flip
+    x_zero = _is_zero_mod_p_L(x)
+    ok = ok & jnp.logical_not(x_zero & (sign == 1))
+    x = _sel_rows(_parity_L(x) != sign, neg_L(x), x)
+    return x, ok
+
+
 # -------------------------------------------------------------- the kernel
 
 
@@ -285,9 +379,11 @@ def _verify_kernel_body(*refs):
 
 def _verify_kernel_inner(ax_ref, ay_ref, at_ref, rx_ref, ry_ref,
                          sd_ref, kd_ref, bias_ref, k2d_ref,
-                         pdig_ref, p2dig_ref, byp_ref, bym_ref, bt2_ref,
+                         pdig_ref, p2dig_ref, _d_ref, _sqrtm1_ref,
+                         byp_ref, bym_ref, bt2_ref,
                          ok_ref, tbl_ref):
-    blk = ax_ref.shape[1]
+    # (_d_ref/_sqrtm1_ref unused here: all three kernels share ONE const
+    # block — see _consts — so the tuple/ref alignment cannot drift.)
     ax, ay, at = ax_ref[:], ay_ref[:], at_ref[:]
     rx, ry = rx_ref[:], ry_ref[:]
 
@@ -296,6 +392,20 @@ def _verify_kernel_inner(ax_ref, ay_ref, at_ref, rx_ref, ry_ref,
     _C["p2dig"] = p2dig_ref[:]
     k2d = k2d_ref[:]
     byp_c, bym_c, bt2_c = byp_ref[:], bym_ref[:], bt2_ref[:]
+
+    ok_ref[:] = _ladder_ok(
+        ax, ay, at, rx, ry, sd_ref, kd_ref, tbl_ref, k2d,
+        byp_c, bym_c, bt2_c,
+    ).astype(jnp.int32)
+
+
+def _ladder_ok(ax, ay, at, rx, ry, sd_ref, kd_ref, tbl_ref, k2d,
+               byp_c, bym_c, bt2_c):
+    """The shared joint-Horner ladder + projective R check: [s]B + [k]A'
+    == R on pre-decompressed limb-major coordinates (A' = -A). Used by
+    both the packed-input kernel and the wire kernel (which decompresses
+    A and R in-kernel first). Returns the [1, B] bool acceptance row."""
+    blk = ax.shape[1]
 
     row = lax.broadcasted_iota(jnp.int32, (N, blk), 0)
     one = (row == 0).astype(jnp.int32)
@@ -370,7 +480,75 @@ def _verify_kernel_inner(ax_ref, ay_ref, at_ref, rx_ref, ry_ref,
 
     ok_x = _is_zero_mod_p_L(sub_L(px, mul_L(rx, pz)))
     ok_y = _is_zero_mod_p_L(sub_L(py, mul_L(ry, pz)))
-    ok_ref[:] = (ok_x & ok_y).astype(jnp.int32)
+    return ok_x & ok_y
+
+
+def _wire_kernel_body(*refs):
+    try:
+        _wire_kernel_inner(*refs)
+    finally:
+        _C.clear()
+
+
+def _wire_kernel_inner(ay_ref, asign_ref, ry_ref, rsign_ref,
+                       sd_ref, kd_ref, bias_ref, k2d_ref,
+                       pdig_ref, p2dig_ref, d_ref, sqrtm1_ref,
+                       byp_ref, bym_ref, bt2_ref, ok_ref, tbl_ref):
+    """Wire-input variant: decompress A and R in-kernel (the host ships
+    raw 32-byte encodings — see ops.ed25519_wire), negate A, then run the
+    shared ladder."""
+    _C["bias"] = bias_ref[:]
+    _C["pdig"] = pdig_ref[:]
+    _C["p2dig"] = p2dig_ref[:]
+    _C["d"] = d_ref[:]
+    _C["sqrtm1"] = sqrtm1_ref[:]
+    k2d = k2d_ref[:]
+    byp_c, bym_c, bt2_c = byp_ref[:], bym_ref[:], bt2_ref[:]
+
+    ay = ay_ref[:]
+    ry = ry_ref[:]
+    ax, ok_a = _decompress_L(ay, asign_ref[:])
+    rx, ok_r = _decompress_L(ry, rsign_ref[:])
+    nax = neg_L(ax)
+    nat = mul_L(nax, ay)
+
+    ok = _ladder_ok(
+        nax, ay, nat, rx, ry, sd_ref, kd_ref, tbl_ref, k2d,
+        byp_c, bym_c, bt2_c,
+    )
+    ok_ref[:] = (ok & ok_a & ok_r).astype(jnp.int32)
+
+
+def _semiwire_kernel_body(*refs):
+    try:
+        _semiwire_kernel_inner(*refs)
+    finally:
+        _C.clear()
+
+
+def _semiwire_kernel_inner(ax_ref, ay_ref, at_ref, ry_ref, rsign_ref,
+                           sd_ref, kd_ref, bias_ref, k2d_ref,
+                           pdig_ref, p2dig_ref, d_ref, sqrtm1_ref,
+                           byp_ref, bym_ref, bt2_ref, ok_ref, tbl_ref):
+    """Indexed-A wire variant: A arrives pre-decompressed and pre-negated
+    (gathered from the resident validator table OUTSIDE the kernel — the
+    gather is an XLA op on device-resident tensors, no host transfer);
+    only R is decompressed in-kernel."""
+    _C["bias"] = bias_ref[:]
+    _C["pdig"] = pdig_ref[:]
+    _C["p2dig"] = p2dig_ref[:]
+    _C["d"] = d_ref[:]
+    _C["sqrtm1"] = sqrtm1_ref[:]
+    k2d = k2d_ref[:]
+    byp_c, bym_c, bt2_c = byp_ref[:], bym_ref[:], bt2_ref[:]
+
+    ry = ry_ref[:]
+    rx, ok_r = _decompress_L(ry, rsign_ref[:])
+    ok = _ladder_ok(
+        ax_ref[:], ay_ref[:], at_ref[:], rx, ry,
+        sd_ref, kd_ref, tbl_ref, k2d, byp_c, bym_c, bt2_c,
+    )
+    ok_ref[:] = (ok & ok_r).astype(jnp.int32)
 
 
 def _b_niels_cols():
@@ -379,6 +557,88 @@ def _b_niels_cols():
         np.asarray(yp).T.copy(),
         np.asarray(ym).T.copy(),
         np.asarray(t2).T.copy(),
+    )
+
+
+_D_COL = fe.to_limbs(host_ed.D).reshape(N, 1)
+_SQRTM1_COL = fe.to_limbs(host_ed.SQRT_M1).reshape(N, 1)
+
+#: Number of shared const inputs (the [N, 1] columns + [N, 9] tables).
+_N_C1, _N_C9 = 6, 3
+
+
+def _consts():
+    """The ONE const block every kernel receives, in the ONE order every
+    ``*_kernel_inner`` declares its const refs: (bias, k2d, pdig, p2dig,
+    d, sqrtm1, byp, bym, bt2). Single-sourced so the tuple and the three
+    kernels' ref lists cannot drift — a positional mismatch here would
+    corrupt crypto verdicts silently."""
+    byp, bym, bt2 = _b_niels_cols()
+    return (
+        jnp.asarray(_SUB_BIAS_COL, dtype=jnp.int32),
+        jnp.asarray(_K2D_COL, dtype=jnp.int32),
+        jnp.asarray(_P_COL, dtype=jnp.int32),
+        jnp.asarray(_2P_COL, dtype=jnp.int32),
+        jnp.asarray(_D_COL, dtype=jnp.int32),
+        jnp.asarray(_SQRTM1_COL, dtype=jnp.int32),
+        jnp.asarray(byp, dtype=jnp.int32),
+        jnp.asarray(bym, dtype=jnp.int32),
+        jnp.asarray(bt2, dtype=jnp.int32),
+    )
+
+
+def _specs(block):
+    """(spec20, spec64, spec1, const_specs) for one block size."""
+    return (
+        pl.BlockSpec((N, block), lambda i: (0, i)),
+        pl.BlockSpec((64, block), lambda i: (0, i)),
+        pl.BlockSpec((1, block), lambda i: (0, i)),
+        [pl.BlockSpec((N, 1), lambda i: (0, 0))] * _N_C1
+        + [pl.BlockSpec((N, 9), lambda i: (0, 0))] * _N_C9,
+    )
+
+
+def _pallas_verify_call(body, block, interpret, in_specs, inputs):
+    """Shared pallas_call scaffolding: every verify kernel has the same
+    output row, grid, scratch table, and trailing const block."""
+    bsz = inputs[0].shape[-1]
+    _, _, spec1, const_specs = _specs(block)
+    ok = pl.pallas_call(
+        body,
+        out_shape=jax.ShapeDtypeStruct((1, bsz), jnp.int32),
+        grid=(bsz // block,),
+        in_specs=list(in_specs) + const_specs,
+        out_specs=spec1,
+        scratch_shapes=[pltpu.VMEM((9, 4, N, block), jnp.int32)],
+        interpret=interpret,
+    )(*inputs, *_consts())
+    return ok[0].astype(bool)
+
+
+def _check_block(bsz, block, padder: str):
+    if bsz % block != 0:
+        # The grid floor-divides; a ragged batch would leave the tail
+        # lanes UNWRITTEN and return garbage as crypto verdicts.
+        raise ValueError(
+            f"batch {bsz} is not a multiple of block {block}; "
+            f"use {padder}(), which pads"
+        )
+
+
+def _pad_to_block(block, arrays):
+    """Zero-pad each array's leading axis up to a multiple of ``block``
+    (callers slice the verdict row back; pad-lane outcomes are
+    discarded)."""
+    bsz = arrays[0].shape[0]
+    padded = ((bsz + block - 1) // block) * block
+    if padded == bsz:
+        return tuple(arrays)
+    return tuple(
+        jnp.concatenate(
+            [jnp.asarray(a),
+             jnp.zeros((padded - bsz, *a.shape[1:]), dtype=a.dtype)]
+        )
+        for a in arrays
     )
 
 
@@ -391,43 +651,108 @@ def make_pallas_verify_fn(block: int = _BLOCK, interpret: bool = False):
 
     @jax.jit
     def run(ax, ay, at, rx, ry, s_nib, k_nib):
-        bsz = ax.shape[0]
-        if bsz % block != 0:
-            # The grid floor-divides; a ragged batch would leave the tail
-            # lanes UNWRITTEN and return garbage as crypto verdicts.
-            raise ValueError(
-                f"batch {bsz} is not a multiple of block {block}; "
-                f"use verify_pallas(), which pads"
-            )
+        _check_block(ax.shape[0], block, "verify_pallas")
         sd = _recode_signed(s_nib)  # [64, B]
         kd = _recode_signed(k_nib)
-        spec20 = pl.BlockSpec((N, block), lambda i: (0, i))
-        spec64 = pl.BlockSpec((64, block), lambda i: (0, i))
-        spec1 = pl.BlockSpec((1, block), lambda i: (0, i))
-        c1 = pl.BlockSpec((N, 1), lambda i: (0, 0))
-        c9 = pl.BlockSpec((N, 9), lambda i: (0, 0))
-        byp, bym, bt2 = _b_niels_cols()
-        consts = (
-            jnp.asarray(_SUB_BIAS_COL, dtype=jnp.int32),
-            jnp.asarray(_K2D_COL, dtype=jnp.int32),
-            jnp.asarray(_P_COL, dtype=jnp.int32),
-            jnp.asarray(_2P_COL, dtype=jnp.int32),
-            jnp.asarray(byp, dtype=jnp.int32),
-            jnp.asarray(bym, dtype=jnp.int32),
-            jnp.asarray(bt2, dtype=jnp.int32),
+        spec20, spec64, _, _ = _specs(block)
+        return _pallas_verify_call(
+            _verify_kernel_body, block, interpret,
+            [spec20] * 5 + [spec64] * 2,
+            (ax.T, ay.T, at.T, rx.T, ry.T, sd, kd),
         )
-        ok = pl.pallas_call(
-            _verify_kernel_body,
-            out_shape=jax.ShapeDtypeStruct((1, bsz), jnp.int32),
-            grid=(bsz // block,),
-            in_specs=[spec20] * 5 + [spec64] * 2 + [c1] * 4 + [c9] * 3,
-            out_specs=spec1,
-            scratch_shapes=[pltpu.VMEM((9, 4, N, block), jnp.int32)],
-            interpret=interpret,
-        )(ax.T, ay.T, at.T, rx.T, ry.T, sd, kd, *consts)
-        return ok[0].astype(bool)
 
     return run
+
+
+@functools.lru_cache(maxsize=None)
+def make_pallas_wire_verify_fn(block: int = _BLOCK, interpret: bool = False):
+    """Jitted wire-path verify ``(a_rows, r_rows, s_rows, k_rows) ->
+    bool[B]`` — inputs are the [B, 32] uint8 rows the wire packer emits
+    (ops.ed25519_wire.Ed25519WireHost); byte->limb/nibble unpacking and
+    the signed recode run on device inside the jit, point decompression
+    runs inside the Mosaic kernel. B must be a multiple of ``block`` —
+    :func:`wire_verify_pallas` pads."""
+    from hyperdrive_tpu.ops.ed25519_wire import (
+        limbs_from_rows,
+        nibbles_from_rows,
+    )
+
+    @jax.jit
+    def run(a_rows, r_rows, s_rows, k_rows):
+        _check_block(a_rows.shape[0], block, "wire_verify_pallas")
+        ay, a_sign = limbs_from_rows(a_rows)
+        ry, r_sign = limbs_from_rows(r_rows)
+        sd = _recode_signed(nibbles_from_rows(s_rows))  # [64, B]
+        kd = _recode_signed(nibbles_from_rows(k_rows))
+        spec20, spec64, spec1, _ = _specs(block)
+        return _pallas_verify_call(
+            _wire_kernel_body, block, interpret,
+            [spec20, spec1, spec20, spec1] + [spec64] * 2,
+            (ay.T, a_sign[None, :], ry.T, r_sign[None, :], sd, kd),
+        )
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def make_pallas_semiwire_verify_fn(block: int = _BLOCK,
+                                   interpret: bool = False):
+    """Jitted indexed-A wire verify ``(idx, r_rows, s_rows, k_rows,
+    tnax, tay, tnat, tvalid) -> bool[B]``: A coordinates gather from the
+    device-resident validator table (see ops.ed25519_wire.ValidatorTable)
+    — the gather and byte unpacking run as XLA ops inside the jit, the
+    R decompression + ladder inside the Mosaic kernel."""
+    from hyperdrive_tpu.ops.ed25519_wire import (
+        limbs_from_rows,
+        nibbles_from_rows,
+    )
+
+    @jax.jit
+    def run(idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid):
+        _check_block(idx.shape[0], block, "semiwire_verify_pallas")
+        nax = jnp.take(tnax, idx, axis=0)
+        ay = jnp.take(tay, idx, axis=0)
+        nat = jnp.take(tnat, idx, axis=0)
+        ok_t = jnp.take(tvalid, idx, axis=0)
+        ry, r_sign = limbs_from_rows(r_rows)
+        sd = _recode_signed(nibbles_from_rows(s_rows))
+        kd = _recode_signed(nibbles_from_rows(k_rows))
+        spec20, spec64, spec1, _ = _specs(block)
+        ok = _pallas_verify_call(
+            _semiwire_kernel_body, block, interpret,
+            [spec20] * 3 + [spec20, spec1] + [spec64] * 2,
+            (nax.T, ay.T, nat.T, ry.T, r_sign[None, :], sd, kd),
+        )
+        return ok & ok_t
+
+    return run
+
+
+def semiwire_verify_pallas(idx, r_rows, s_rows, k_rows,
+                           tnax, tay, tnat, tvalid,
+                           block: int = _BLOCK, interpret: bool = False):
+    """Padding wrapper around :func:`make_pallas_semiwire_verify_fn`
+    (pad lanes index slot 0 with zero wire bytes; verdicts sliced off)."""
+    bsz = idx.shape[0]
+    idx, r_rows, s_rows, k_rows = _pad_to_block(
+        block, (idx, r_rows, s_rows, k_rows)
+    )
+    fn = make_pallas_semiwire_verify_fn(block=block, interpret=interpret)
+    return fn(idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid)[:bsz]
+
+
+def wire_verify_pallas(a_rows, r_rows, s_rows, k_rows,
+                       block: int = _BLOCK, interpret: bool = False):
+    """Drop-in equivalent of ``wire_verify_kernel`` on the Pallas path:
+    pads the batch to a multiple of ``block``, runs, slices the mask.
+    Padding rows are all-zero wire bytes; their verdicts are discarded by
+    the final slice, so their decode outcome is irrelevant."""
+    bsz = a_rows.shape[0]
+    a_rows, r_rows, s_rows, k_rows = _pad_to_block(
+        block, (a_rows, r_rows, s_rows, k_rows)
+    )
+    fn = make_pallas_wire_verify_fn(block=block, interpret=interpret)
+    return fn(a_rows, r_rows, s_rows, k_rows)[:bsz]
 
 
 def pallas_backend_ok(devices=None) -> bool:
@@ -469,14 +794,8 @@ def verify_pallas(ax, ay, at, rx, ry, s_nib, k_nib,
     final carry, so an out-of-range raw scalar would verify as
     ``scalar - 2^256`` instead of being rejected)."""
     bsz = ax.shape[0]
-    padded = ((bsz + block - 1) // block) * block
-    if padded != bsz:
-        pad = lambda a: jnp.concatenate(  # noqa: E731
-            [jnp.asarray(a),
-             jnp.zeros((padded - bsz, *a.shape[1:]), dtype=jnp.int32)]
-        )
-        ax, ay, at, rx, ry, s_nib, k_nib = (
-            pad(a) for a in (ax, ay, at, rx, ry, s_nib, k_nib)
-        )
+    ax, ay, at, rx, ry, s_nib, k_nib = _pad_to_block(
+        block, (ax, ay, at, rx, ry, s_nib, k_nib)
+    )
     fn = make_pallas_verify_fn(block=block, interpret=interpret)
     return fn(ax, ay, at, rx, ry, s_nib, k_nib)[:bsz]

@@ -1,0 +1,424 @@
+"""Wire-format batched Ed25519 verification: point decompression ON DEVICE.
+
+The packed path (:class:`hyperdrive_tpu.ops.ed25519_jax.Ed25519BatchHost`)
+decompresses A and R on the host — one ~255-bit field exponentiation per
+point — which caps a 1-core host at ~30k unique signatures/s while the
+device kernel verifies 500k+/s (BENCH.md round 3): the sustained
+unique-signature pipeline was pack-bound. This module moves BOTH
+decompressions into the device launch. The host ships raw wire bytes —
+pub (32 B), R (32 B), s (32 B), k (32 B) = 128 B/lane instead of ~930 B
+of packed limbs — and keeps only the cheap bit-twiddly steps: SHA-512
+challenge scalars (C-speed), s < L and canonical-y range checks, byte
+copies. Packing becomes hash-bound; the pipeline becomes device-bound.
+
+Semantics are bit-identical to the host oracle
+(:func:`hyperdrive_tpu.crypto.ed25519.verify`): the device decompression
+implements the same RFC 8032 x-recovery rules (the x2 == 0 edge cases and
+sign handling of ``_recover_x``, crypto/ed25519.py:106-122; reference
+trust-model seam: /root/reference/process/process.go:95-98). The combined
+square-root/division trick x = u*v^3*(u*v^7)^((p-5)/8) equals the
+oracle's x2 = u * inv(v) path on EVERY input because v = d*y^2 + 1 never
+vanishes mod p — -1/d is a quadratic non-residue (asserted in tests).
+Differential tests enforce exact agreement, including the adversarial
+decompression edge cases (non-canonical y, non-residue x2, sign bit on
+x == 0, s >= L).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hyperdrive_tpu.crypto import ed25519 as host_ed
+from hyperdrive_tpu.ops import bucketing
+from hyperdrive_tpu.ops import fe25519 as fe
+from hyperdrive_tpu.ops.ed25519_jax import verify_kernel
+
+__all__ = [
+    "limbs_from_rows",
+    "nibbles_from_rows",
+    "decompress_device",
+    "wire_verify_kernel",
+    "make_wire_verify_fn",
+    "semiwire_verify_kernel",
+    "make_semiwire_verify_fn",
+    "ValidatorTable",
+    "Ed25519WireHost",
+    "TpuWireVerifier",
+]
+
+P = host_ed.P
+_D_LIMBS = fe.to_limbs(host_ed.D)
+_SQRTM1_LIMBS = fe.to_limbs(host_ed.SQRT_M1)
+_MASK255 = (1 << 255) - 1
+
+
+# ------------------------------------------------------ device byte unpack
+
+
+def limbs_from_rows(rows: jnp.ndarray):
+    """[B, 32] uint8 little-endian field encodings -> ([B, 20] 13-bit
+    limbs with bit 255 cleared, [B] sign bits). Pure elementwise
+    shifts/masks — runs on device so the transfer stays 32 B/point."""
+    b = rows.astype(jnp.int32)
+    sign = b[:, 31] >> 7
+    b31 = b[:, 31] & 0x7F
+    limbs = []
+    for i in range(fe.N_LIMBS):
+        bit = 13 * i
+        byte, off = bit >> 3, bit & 7
+        v = b31 if byte == 31 else b[:, byte]
+        if byte + 1 < 32:
+            v = v | ((b31 if byte + 1 == 31 else b[:, byte + 1]) << 8)
+        if byte + 2 < 32:
+            v = v | ((b31 if byte + 2 == 31 else b[:, byte + 2]) << 16)
+        limbs.append((v >> off) & fe.LIMB_MASK)
+    return jnp.stack(limbs, axis=-1), sign
+
+
+def nibbles_from_rows(rows: jnp.ndarray) -> jnp.ndarray:
+    """[B, 32] uint8 little-endian scalars -> [B, 64] int32 base-16
+    digits (device-side mirror of ed25519_jax._nibbles_from_rows)."""
+    b = rows.astype(jnp.int32)
+    return jnp.stack([b & 0xF, b >> 4], axis=-1).reshape(b.shape[0], 64)
+
+
+# --------------------------------------------------- device decompression
+
+
+def decompress_device(y: jnp.ndarray, sign: jnp.ndarray):
+    """RFC 8032 x-recovery on limb tensors: solve x^2 = (y^2-1)/(d y^2+1).
+
+    ``y``: [B, 20] limbs (bit 255 cleared; caller guarantees y < p — the
+    wire packer range-checks), ``sign``: [B] int32. Returns (x [B, 20],
+    ok [B] bool). Matches crypto.ed25519._recover_x case-for-case:
+    x2 == 0 (possible only via u == 0, since v never vanishes) yields
+    x = 0 accepted iff sign == 0; a non-residue x2 rejects; otherwise the
+    root's parity is flipped to the sign bit."""
+    batch = y.shape[:-1]
+    one = jnp.broadcast_to(
+        jnp.asarray(fe.ONE, dtype=jnp.int32), (*batch, fe.N_LIMBS)
+    )
+    d = jnp.asarray(_D_LIMBS, dtype=jnp.int32)
+    y2 = fe.sqr(y)
+    u = fe.sub(y2, one)
+    v = fe.add(fe.mul(d, y2), one)
+    v2 = fe.sqr(v)
+    uv3 = fe.mul(u, fe.mul(v2, v))
+    uv7 = fe.mul(uv3, fe.sqr(v2))
+    x = fe.mul(uv3, fe.pow22523(uv7))
+    vx2 = fe.mul(v, fe.sqr(x))
+    ok_direct = fe.eq(vx2, u)
+    ok_flip = fe.eq(vx2, fe.neg(u))
+    sm1 = jnp.asarray(_SQRTM1_LIMBS, dtype=jnp.int32)
+    x = fe.select(ok_flip & ~ok_direct, fe.mul(x, sm1), x)
+    ok = ok_direct | ok_flip
+    x_zero = fe.is_zero(x)
+    ok = ok & ~(x_zero & (sign == 1))
+    parity = fe.canonical(x)[..., 0] & 1
+    x = fe.select(parity != sign, fe.neg(x), x)
+    return x, ok
+
+
+# ------------------------------------------------------------- the kernel
+
+
+def wire_verify_kernel(a_rows, r_rows, s_rows, k_rows):
+    """Batched verify straight from wire bytes (all [B, 32] uint8):
+    unpack, decompress A and R, negate A, then run the packed-path ladder
+    (:func:`~hyperdrive_tpu.ops.ed25519_jax.verify_kernel`). Returns
+    bool [B]. Lanes the packer marked invalid carry zero rows and must be
+    masked by the caller's ``prevalid`` (zero rows happen to reject, but
+    prevalid is the contract)."""
+    ay, a_sign = limbs_from_rows(a_rows)
+    ry, r_sign = limbs_from_rows(r_rows)
+    ax, ok_a = decompress_device(ay, a_sign)
+    rx, ok_r = decompress_device(ry, r_sign)
+    # The ladder computes [s]B + [k](-A): negate A here (x -> p - x,
+    # t = x' * y), exactly what the packed-path host packer pre-computes.
+    nax = fe.neg(ax)
+    nat = fe.mul(nax, ay)
+    s_nib = nibbles_from_rows(s_rows)
+    k_nib = nibbles_from_rows(k_rows)
+    ok = verify_kernel(nax, ay, nat, rx, ry, s_nib, k_nib)
+    return ok & ok_a & ok_r
+
+
+@functools.lru_cache(maxsize=None)
+def make_wire_verify_fn(jit: bool = True):
+    """Cached (one XLA compile per batch shape process-wide)."""
+    return jax.jit(wire_verify_kernel) if jit else wire_verify_kernel
+
+
+# ------------------------------------------- validator-resident (indexed)
+
+
+class ValidatorTable:
+    """Device-resident decompressed validator pubkeys.
+
+    Consensus verifies signatures from a KNOWN validator set (the
+    whitelist the replica installs — reference:
+    /root/reference/replica/replica.go:69-72); decompressing each pubkey
+    per signature is pure waste, and on a bandwidth-starved link even
+    SHIPPING the 32-byte encoding per lane is waste. This table
+    decompresses and negates each pubkey once on the host, uploads the
+    [V, 20] coordinate tensors once, and the indexed verify path then
+    ships a 4-byte validator index per lane (100 B/lane total vs the
+    full wire path's 128). Pubkeys that fail decompression occupy an
+    invalid slot — their signatures reject, matching the oracle."""
+
+    def __init__(self, pubkeys):
+        pubkeys = list(pubkeys)
+        v = len(pubkeys)
+        nax = np.zeros((max(v, 1), fe.N_LIMBS), dtype=np.int32)
+        ay = np.zeros_like(nax)
+        nat = np.zeros_like(nax)
+        valid = np.zeros(max(v, 1), dtype=bool)
+        self.index: dict = {}
+        for i, pub in enumerate(pubkeys):
+            self.index.setdefault(pub, i)  # first wins on duplicates
+            pt = host_ed.point_decompress(pub)
+            if pt is None:
+                continue
+            x, y = pt[0], pt[1]
+            nx = (P - x) % P
+            nax[i] = fe.to_limbs(nx)
+            ay[i] = fe.to_limbs(y)
+            nat[i] = fe.to_limbs((nx * y) % P)
+            valid[i] = True
+        self.n = v
+        self.nax = jnp.asarray(nax)
+        self.ay = jnp.asarray(ay)
+        self.nat = jnp.asarray(nat)
+        self.valid = jnp.asarray(valid)
+
+    def arrays(self):
+        return self.nax, self.ay, self.nat, self.valid
+
+
+def semiwire_verify_kernel(idx, r_rows, s_rows, k_rows,
+                           tnax, tay, tnat, tvalid):
+    """Indexed-A wire verify: gather the pre-decompressed, pre-negated A
+    coordinates from the resident validator table ([V, 20] each), then
+    decompress R on device and run the ladder. ``idx``: [B] int32 into
+    the table (prevalid lanes only — the packer rejects unknown pubs)."""
+    nax = jnp.take(tnax, idx, axis=0)
+    ay = jnp.take(tay, idx, axis=0)
+    nat = jnp.take(tnat, idx, axis=0)
+    ok_t = jnp.take(tvalid, idx, axis=0)
+    ry, r_sign = limbs_from_rows(r_rows)
+    rx, ok_r = decompress_device(ry, r_sign)
+    s_nib = nibbles_from_rows(s_rows)
+    k_nib = nibbles_from_rows(k_rows)
+    ok = verify_kernel(nax, ay, nat, rx, ry, s_nib, k_nib)
+    return ok & ok_r & ok_t
+
+
+@functools.lru_cache(maxsize=None)
+def make_semiwire_verify_fn(jit: bool = True):
+    return jax.jit(semiwire_verify_kernel) if jit else semiwire_verify_kernel
+
+
+# ------------------------------------------------------------- host packer
+
+
+class Ed25519WireHost:
+    """Range-checks and marshals (pub, digest, sig) triples into the wire
+    tensors the device kernels consume: four [bucket, 32] uint8 arrays
+    (A, R, s, k rows) plus the prevalid mask.
+
+    Host work per item: length checks, canonical-y checks for A and R
+    (y < p — the oracle's ``_recover_x`` rejection), the s < L
+    malleability check, and k = SHA-512(R||A||M) mod L. No field
+    exponentiations — that is the point. The native C++ path
+    (``hd_pack_wire``) and the pure-Python loop produce identical rows
+    and masks (differentially tested); ``HD_NO_NATIVE=1`` forces Python.
+    """
+
+    def __init__(self, buckets=(64, 256, 1024, 4096), use_native: bool = True):
+        self.buckets = tuple(sorted(buckets))
+        self._native = None
+        if use_native and not os.environ.get("HD_NO_NATIVE"):
+            from hyperdrive_tpu import native
+
+            packer = native.instance()
+            if packer is not None and hasattr(packer, "pack_wire_into"):
+                self._native = packer
+
+    def bucket_for(self, n: int) -> int:
+        return bucketing.bucket_for(n, self.buckets)
+
+    def pack_wire(self, items):
+        """items: iterable of (pub32, digest, sig64). Returns
+        ((a_rows, r_rows, s_rows, k_rows), prevalid, n) — rows are
+        [bucket, 32] uint8, prevalid is bool[bucket], n the true count."""
+        items = list(items)
+        n = len(items)
+        bsz = self.bucket_for(max(n, 1))
+        a_rows = np.zeros((bsz, 32), dtype=np.uint8)
+        r_rows = np.zeros_like(a_rows)
+        s_rows = np.zeros_like(a_rows)
+        k_rows = np.zeros_like(a_rows)
+        prevalid = np.zeros(bsz, dtype=bool)
+
+        if self._native is not None:
+            prevalid[:n] = self._native.pack_wire_into(
+                items, a_rows, r_rows, s_rows, k_rows
+            )
+            return (a_rows, r_rows, s_rows, k_rows), prevalid, n
+
+        for i, (pub, digest, sig) in enumerate(items):
+            if len(pub) != 32 or len(sig) != 64:
+                continue
+            if (int.from_bytes(pub, "little") & _MASK255) >= P:
+                continue
+            if (int.from_bytes(sig[:32], "little") & _MASK255) >= P:
+                continue
+            if int.from_bytes(sig[32:], "little") >= host_ed.L:
+                continue
+            k = host_ed.challenge_scalar(sig[:32], pub, digest)
+            a_rows[i] = np.frombuffer(pub, dtype=np.uint8)
+            r_rows[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+            s_rows[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+            k_rows[i] = np.frombuffer(
+                k.to_bytes(32, "little"), dtype=np.uint8
+            )
+            prevalid[i] = True
+        return (a_rows, r_rows, s_rows, k_rows), prevalid, n
+
+    def index_lanes(self, items, table: ValidatorTable):
+        """Map each item's pubkey to its table slot. Returns (idx int32
+        [bucket], all_known) — unknown pubkeys leave idx 0 and flip
+        all_known, telling the caller to use the full wire path for the
+        chunk (verdicts must never depend on table contents)."""
+        idx = np.zeros(self.bucket_for(max(len(items), 1)), dtype=np.int32)
+        lookup = table.index.get
+        lanes = np.fromiter(
+            (lookup(pub, -1) for pub, _, _ in items),
+            dtype=np.int32,
+            count=len(items),
+        )
+        all_known = bool((lanes >= 0).all()) if len(items) else True
+        idx[: len(items)] = np.maximum(lanes, 0)
+        return idx, all_known
+
+    def pack_wire_indexed(self, items, table: ValidatorTable):
+        """Indexed-A packing: like :meth:`pack_wire`, but A ships as an
+        int32 index into ``table`` (4 B/lane instead of 32). Requires
+        every pubkey to be in the table (callers route mixed chunks
+        through the full wire path). Returns ((idx, r_rows, s_rows,
+        k_rows), prevalid, n)."""
+        items = list(items)
+        # (pack_wire also fills A rows — one 32-byte memcpy per lane,
+        # noise next to the SHA-512 — which this path simply drops.)
+        (_, r_rows, s_rows, k_rows), prevalid, n = self.pack_wire(items)
+        idx, all_known = self.index_lanes(items, table)
+        if not all_known:
+            raise ValueError(
+                "pack_wire_indexed requires every pubkey in the table"
+            )
+        return (idx, r_rows, s_rows, k_rows), prevalid, n
+
+
+# --------------------------------------------------------------- verifier
+
+
+class TpuWireVerifier:
+    """Batch verifier over the wire path: 128 B/lane host->device, both
+    decompressions on device. Drop-in for
+    :class:`~hyperdrive_tpu.ops.ed25519_jax.TpuBatchVerifier` where raw
+    throughput on unique signatures matters (the sustained pipeline);
+    the packed path remains better when pubkey/decompression reuse is
+    high and host CPU is idle."""
+
+    def __init__(self, buckets=(64, 256, 1024, 4096), backend: str = "auto",
+                 table: "ValidatorTable | None" = None):
+        from hyperdrive_tpu.ops.ed25519_pallas import resolve_backend
+
+        self.host = Ed25519WireHost(buckets=buckets)
+        self.backend = resolve_backend(backend)
+        self._fn = make_wire_verify_fn(jit=True)
+        #: Optional resident validator table: chunks whose senders are all
+        #: in the table ride the indexed path (4-byte A per lane); any
+        #: unknown pubkey routes that chunk through the full wire path so
+        #: verdicts never depend on table contents.
+        self.table = table
+        self._semi_fn = make_semiwire_verify_fn(jit=True)
+
+    def _device_verify(self, rows):
+        dev_in = [jnp.asarray(a) for a in rows]
+        if self.backend == "pallas":
+            from hyperdrive_tpu.ops.ed25519_pallas import wire_verify_pallas
+
+            return wire_verify_pallas(*dev_in)
+        return self._fn(*dev_in)
+
+    def _device_verify_indexed(self, rows):
+        dev_in = [jnp.asarray(a) for a in rows]
+        tbl = self.table.arrays()
+        if self.backend == "pallas":
+            from hyperdrive_tpu.ops.ed25519_pallas import (
+                semiwire_verify_pallas,
+            )
+
+            return semiwire_verify_pallas(*dev_in, *tbl)
+        return self._semi_fn(*dev_in, *tbl)
+
+    def warmup(self) -> None:
+        for b in self.host.buckets:
+            z = jnp.zeros((b, 32), dtype=jnp.uint8)
+            np.asarray(self._device_verify((z, z, z, z)))
+            if self.table is not None:
+                zi = jnp.zeros(b, dtype=jnp.int32)
+                np.asarray(self._device_verify_indexed((zi, z, z, z)))
+
+    def verify_signatures(self, items) -> np.ndarray:
+        """items: list of (pub, digest, sig); returns bool[n]. Chunks at
+        the largest bucket; all launches are enqueued before the first
+        mask is materialized (one concatenated fetch — separate fetches
+        each cost a full tunnel round trip)."""
+        items = list(items)
+        if not items:
+            return np.zeros(0, dtype=bool)
+        cap = self.host.buckets[-1]
+        pending = []
+        for lo in range(0, len(items), cap):
+            chunk = items[lo : lo + cap]
+            rows, prevalid, n = self.host.pack_wire(chunk)
+            if not prevalid.any():
+                pending.append((None, prevalid, n))
+                continue
+            if self.table is not None:
+                idx, all_known = self.host.index_lanes(chunk, self.table)
+                if all_known:
+                    pending.append((
+                        self._device_verify_indexed((idx, *rows[1:])),
+                        prevalid,
+                        n,
+                    ))
+                    continue
+            pending.append((self._device_verify(rows), prevalid, n))
+        devs = [d for d, _, _ in pending if d is not None]
+        big = np.asarray(jnp.concatenate(devs)) if devs else None
+        off = 0
+        out = []
+        for dev, prevalid, n in pending:
+            if dev is None:
+                out.append(prevalid[:n].copy())
+                continue
+            width = dev.shape[0]
+            out.append((big[off : off + width] & prevalid)[:n])
+            off += width
+        return out[0] if len(out) == 1 else np.concatenate(out)
+
+    def verify_batch(self, window):
+        """Verifier-protocol entry (messages with detached signatures)."""
+        items = [(m.sender, m.digest(), m.signature) for m in window]
+        unsigned = np.array([not m.signature for m in window], dtype=bool)
+        ok = self.verify_signatures(items)
+        return list(ok & ~unsigned)

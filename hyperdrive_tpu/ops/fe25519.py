@@ -49,6 +49,7 @@ __all__ = [
     "sqr",
     "mul_small",
     "inv",
+    "pow22523",
     "canonical",
     "eq",
     "is_zero",
@@ -377,6 +378,35 @@ def inv(a: jnp.ndarray) -> jnp.ndarray:
     z_250_0 = mul(z_250_50, z_50_0)
     z_255_5 = nsqr(z_250_0, 5)
     return mul(z_255_5, z11)  # z^(2^255 - 21) = z^(p-2)
+
+
+def pow22523(a: jnp.ndarray) -> jnp.ndarray:
+    """a^((p-5)/8) = a^(2^252 - 3) — the exponent of the combined
+    square-root/division trick used by point decompression (RFC 8032
+    §5.1.3): x = u*v^3 * (u*v^7)^((p-5)/8). Same addition chain as
+    :func:`inv` up to the tail."""
+
+    def nsqr(x, n):
+        if n < 4:
+            for _ in range(n):
+                x = sqr(x)
+            return x
+        return lax.fori_loop(0, n, lambda _, v: sqr(v), x)
+
+    z2 = sqr(a)  # 2
+    z8 = nsqr(z2, 2)  # 8
+    z9 = mul(a, z8)  # 9
+    z11 = mul(z2, z9)  # 11
+    z22 = sqr(z11)  # 22
+    z_5_0 = mul(z9, z22)  # 2^5 - 2^0
+    z_10_0 = mul(nsqr(z_5_0, 5), z_5_0)
+    z_20_0 = mul(nsqr(z_10_0, 10), z_10_0)
+    z_40_0 = mul(nsqr(z_20_0, 20), z_20_0)
+    z_50_0 = mul(nsqr(z_40_0, 10), z_10_0)
+    z_100_0 = mul(nsqr(z_50_0, 50), z_50_0)
+    z_200_0 = mul(nsqr(z_100_0, 100), z_100_0)
+    z_250_0 = mul(nsqr(z_200_0, 50), z_50_0)
+    return mul(nsqr(z_250_0, 2), a)  # 2^252 - 3
 
 
 # ------------------------------------------------------------- canonical

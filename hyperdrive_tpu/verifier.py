@@ -116,8 +116,11 @@ class AdaptiveVerifier:
         self.crossover = int(crossover)
         self.calibrate_at = int(calibrate_at)
         self.calibrated = False
-        #: (host_sigs_per_s, device_sigs_per_s, device_overhead_s) once
-        #: measured — exposed for benchmark reporting.
+        #: Self-describing calibration record once measured — keys
+        #: ``host_sigs_per_s``, ``device_sigs_per_s``,
+        #: ``device_overhead_s`` (the single-launch time, i.e. dispatch +
+        #: transfer, in seconds — NOT a rate) — exposed for benchmark
+        #: reporting.
         self.rates = None
 
     @staticmethod
@@ -177,7 +180,11 @@ class AdaptiveVerifier:
         self.crossover = (
             int(t_dev_one / denom) + 1 if denom > 0 else 1 << 30
         )
-        self.rates = (host_rate, dev_rate, t_dev_one)
+        self.rates = {
+            "host_sigs_per_s": host_rate,
+            "device_sigs_per_s": dev_rate,
+            "device_overhead_s": t_dev_one,
+        }
         self.calibrated = True
         return mask_dev
 

@@ -83,6 +83,16 @@ def test_random_differential(verifier, ring, rng):
     assert got == want
 
 
+def test_pack_empty_batch(verifier):
+    # Regression: the dedup fan-out condition 2*len(uniq) <= n held for
+    # n == 0 and recursed with the same empty list forever.
+    arrays, prevalid, n = verifier.host.pack([])
+    assert n == 0
+    assert not prevalid.any()
+    assert arrays[0].shape[0] == verifier.host.buckets[0]
+    assert verifier.verify_signatures([]).tolist() == []
+
+
 def test_batch_padding_buckets(verifier, ring):
     # 1 item in a 16-bucket, 17 items in a 64-bucket: padding lanes must
     # not leak into results.

@@ -1,0 +1,338 @@
+"""Wire-path (device decompression) Ed25519: differential tests.
+
+The wire kernels decompress A and R on the device; they must agree with
+the host oracle bit-for-bit on every input class — including the
+decompression-specific adversarial encodings the packed path never sees
+on device (non-canonical y, non-residue x^2, the sign bit on x == 0).
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+import pytest
+
+from hyperdrive_tpu.crypto import ed25519 as host_ed
+from hyperdrive_tpu.crypto.keys import KeyRing
+from hyperdrive_tpu.messages import Prevote
+from hyperdrive_tpu.ops import fe25519 as fe
+from hyperdrive_tpu.ops.ed25519_wire import (
+    Ed25519WireHost,
+    TpuWireVerifier,
+    decompress_device,
+    limbs_from_rows,
+    make_wire_verify_fn,
+)
+from hyperdrive_tpu.verifier import HostVerifier
+
+P = host_ed.P
+
+
+@pytest.fixture(scope="module")
+def ring():
+    return KeyRing.deterministic(8, namespace=b"wiretest")
+
+
+def test_minus_one_over_d_is_nonresidue():
+    # The premise that makes the combined sqrt-ratio trick EXACTLY equal
+    # to the oracle's x2 = u * inv(v) path: v = d*y^2 + 1 can only vanish
+    # if -1/d is a square mod p. It is not — so v != 0 for every y and no
+    # divergence case exists.
+    t = (-pow(host_ed.D, P - 2, P)) % P
+    assert pow(t, (P - 1) // 2, P) == P - 1
+
+
+def _wire_verify(items, host=None):
+    host = host or Ed25519WireHost(buckets=(64,))
+    rows, prevalid, n = host.pack_wire(items)
+    fn = make_wire_verify_fn()
+    ok = np.asarray(fn(*(jnp.asarray(r) for r in rows)))
+    return (ok & prevalid)[:n]
+
+
+def _oracle(items):
+    return [host_ed.verify(p, m, s) for p, m, s in items]
+
+
+def test_wire_matches_oracle_valid_and_corrupted(ring, rng):
+    items = []
+    for i in range(24):
+        kp = ring[rng.randrange(len(ring))]
+        msg = rng.randbytes(rng.randint(0, 64))
+        sig = host_ed.sign(kp.seed, msg)
+        roll = rng.random()
+        if roll < 0.3:
+            sig = bytearray(sig)
+            sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            sig = bytes(sig)
+        elif roll < 0.4:
+            msg = msg + b"x"
+        items.append((kp.public, msg, sig))
+    got = _wire_verify(items).tolist()
+    assert got == _oracle(items)
+
+
+def test_wire_adversarial_decompression_cases(ring):
+    kp = ring[0]
+    msg = b"wire adversarial"
+    sig = host_ed.sign(kp.seed, msg)
+
+    def enc(y, sign):
+        return int.to_bytes(y | (sign << 255), 32, "little")
+
+    identity = enc(1, 0)  # (0, 1): x == 0, sign 0 -> decodes
+    zero_sign = enc(1, 1)  # x == 0 with sign bit -> oracle rejects
+    y_zero = enc(0, 0)  # x^2 = -1: a residue -> decodes to (sqrt(-1), 0)
+    noncanon_p = enc(P, 0)  # y == p: non-canonical -> reject
+    noncanon_max = enc((1 << 255) - 1, 0)  # y > p -> reject
+    # Scan for a y whose x^2 is a non-residue (rejects in _recover_x).
+    nonres = None
+    for y in range(2, 50):
+        if host_ed.point_decompress(enc(y, 0)) is None:
+            nonres = enc(y, 0)
+            break
+    assert nonres is not None
+    s_big = sig[:32] + int.to_bytes(
+        int.from_bytes(sig[32:], "little") + host_ed.L, 32, "little"
+    )  # s >= L
+
+    cases = [
+        (kp.public, msg, sig),  # control: valid
+        # R replaced by each crafted encoding:
+        (kp.public, msg, identity + sig[32:]),
+        (kp.public, msg, zero_sign + sig[32:]),
+        (kp.public, msg, y_zero + sig[32:]),
+        (kp.public, msg, noncanon_p + sig[32:]),
+        (kp.public, msg, noncanon_max + sig[32:]),
+        (kp.public, msg, nonres + sig[32:]),
+        # A replaced by each crafted encoding:
+        (identity, msg, sig),
+        (zero_sign, msg, sig),
+        (y_zero, msg, sig),
+        (noncanon_p, msg, sig),
+        (nonres, msg, sig),
+        # scalar range:
+        (kp.public, msg, s_big),
+        # wrong lengths:
+        (kp.public[:31], msg, sig),
+        (kp.public, msg, sig[:63]),
+    ]
+    got = _wire_verify(cases).tolist()
+    want = _oracle(cases)
+    assert got == want
+    assert want[0] is True and not any(want[1:])
+
+
+def test_decompress_device_matches_oracle(ring, rng):
+    # Valid compressed points (both parities), the edge encodings above,
+    # and random byte strings: decompress_device must agree with
+    # point_decompress on validity AND on the recovered x.
+    encs = []
+    for i in range(12):
+        kp = ring[i % len(ring)]
+        pt = host_ed.point_decompress(kp.public)
+        x, y = pt[0], pt[1]
+        encs.append(int.to_bytes(y | ((x & 1) << 255), 32, "little"))
+        encs.append(int.to_bytes(y | (((x & 1) ^ 1) << 255), 32, "little"))
+    encs += [int.to_bytes(1, 32, "little"), int.to_bytes(0, 32, "little")]
+    encs += [rng.randbytes(32) for _ in range(24)]
+    # Filter to canonical y (the packer's precondition — non-canonical
+    # encodings never reach the device).
+    encs = [
+        e
+        for e in encs
+        if (int.from_bytes(e, "little") & ((1 << 255) - 1)) < P
+    ]
+    rows = jnp.asarray(
+        np.frombuffer(b"".join(encs), dtype=np.uint8).reshape(len(encs), 32)
+    )
+    y_limbs, sign = limbs_from_rows(rows)
+    x_dev, ok_dev = decompress_device(y_limbs, sign)
+    x_dev = np.asarray(fe.canonical(x_dev))
+    ok_dev = np.asarray(ok_dev)
+    for i, e in enumerate(encs):
+        want = host_ed.point_decompress(e)
+        assert bool(ok_dev[i]) == (want is not None), e.hex()
+        if want is not None:
+            assert fe.from_limbs(x_dev[i]) == want[0], e.hex()
+
+
+def test_pack_wire_native_matches_python(ring, rng):
+    from hyperdrive_tpu import native
+
+    if native.instance() is None:
+        pytest.skip("native runtime unavailable")
+    items = []
+    for i in range(40):
+        kp = ring[i % len(ring)]
+        msg = rng.randbytes(rng.randint(0, 48))
+        sig = host_ed.sign(kp.seed, msg)
+        roll = rng.random()
+        if roll < 0.2:
+            sig = b"\xff" * 64  # non-canonical R (and s >= L)
+        elif roll < 0.3:
+            sig = sig[:32] + int.to_bytes(
+                int.from_bytes(sig[32:], "little") + host_ed.L,
+                32,
+                "little",
+            )
+        elif roll < 0.4:
+            items.append((kp.public[:16], msg, sig))  # bad length
+            continue
+        items.append((kp.public, msg, sig))
+    nat = Ed25519WireHost(buckets=(64,))
+    assert nat._native is not None
+    py = Ed25519WireHost(buckets=(64,), use_native=False)
+    rows_n, pv_n, n_n = nat.pack_wire(items)
+    rows_p, pv_p, n_p = py.pack_wire(items)
+    assert n_n == n_p
+    assert (pv_n == pv_p).all()
+    for a, b in zip(rows_n, rows_p):
+        assert (a == b).all()
+
+
+def test_wire_pallas_matches_xla_and_oracle(ring, rng):
+    from hyperdrive_tpu.ops.ed25519_pallas import wire_verify_pallas
+
+    items = []
+    for i in range(64):
+        kp = ring[i % len(ring)]
+        msg = rng.randbytes(32)
+        sig = host_ed.sign(kp.seed, msg)
+        kind = i % 4
+        if kind == 1:
+            sig = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
+        elif kind == 2:
+            msg = rng.randbytes(32)
+            items.append((kp.public, msg, host_ed.sign(kp.seed, rng.randbytes(32))))
+            continue
+        elif kind == 3 and i % 8 == 3:
+            sig = b"\xff" * 64
+        items.append((kp.public, msg, sig))
+    host = Ed25519WireHost(buckets=(64,))
+    rows, prevalid, n = host.pack_wire(items)
+    dev_in = tuple(jnp.asarray(r) for r in rows)
+    xla = np.asarray(make_wire_verify_fn()(*dev_in)) & prevalid
+    pl = (
+        np.asarray(wire_verify_pallas(*dev_in, block=64, interpret=True))
+        & prevalid
+    )
+    assert (pl == xla).all()
+    assert xla[:n].tolist() == _oracle(items)
+
+
+def test_semiwire_indexed_matches_oracle(ring, rng):
+    from hyperdrive_tpu.ops.ed25519_wire import (
+        ValidatorTable,
+        make_semiwire_verify_fn,
+    )
+
+    # Table includes one pubkey that is NOT a valid curve point: the
+    # oracle rejects anything "signed" by it, and the table's valid mask
+    # must do the same.
+    bogus = b"\xff" * 32
+    pubs = [kp.public for kp in (ring[i] for i in range(len(ring)))] + [bogus]
+    table = ValidatorTable(pubs)
+    host = Ed25519WireHost(buckets=(64,))
+    items = []
+    for i in range(30):
+        kp = ring[i % len(ring)]
+        msg = rng.randbytes(32)
+        sig = host_ed.sign(kp.seed, msg)
+        if i % 5 == 1:
+            sig = bytearray(sig)
+            sig[rng.randrange(64)] ^= 1 << rng.randrange(8)
+            sig = bytes(sig)
+        elif i % 5 == 2:
+            items.append((bogus, msg, sig))
+            continue
+        items.append((kp.public, msg, sig))
+    rows, prevalid, n = host.pack_wire_indexed(items, table)
+    ok = np.asarray(
+        make_semiwire_verify_fn()(
+            *(jnp.asarray(r) for r in rows), *table.arrays()
+        )
+    )
+    got = (ok & prevalid)[:n].tolist()
+    assert got == _oracle(items)
+
+
+def test_semiwire_pallas_matches_xla(ring, rng):
+    from hyperdrive_tpu.ops.ed25519_pallas import semiwire_verify_pallas
+    from hyperdrive_tpu.ops.ed25519_wire import (
+        ValidatorTable,
+        make_semiwire_verify_fn,
+    )
+
+    table = ValidatorTable([ring[i].public for i in range(len(ring))])
+    host = Ed25519WireHost(buckets=(64,))
+    items = []
+    for i in range(64):
+        kp = ring[i % len(ring)]
+        msg = rng.randbytes(32)
+        sig = host_ed.sign(kp.seed, msg)
+        if i % 3 == 1:
+            sig = sig[:33] + bytes([sig[33] ^ 4]) + sig[34:]
+        elif i % 7 == 2:
+            sig = b"\xff" * 64  # prevalid False (bad R, s >= L)
+        items.append((kp.public, msg, sig))
+    rows, prevalid, n = host.pack_wire_indexed(items, table)
+    dev_in = tuple(jnp.asarray(r) for r in rows)
+    xla = np.asarray(make_semiwire_verify_fn()(*dev_in, *table.arrays()))
+    pl = np.asarray(
+        semiwire_verify_pallas(
+            *dev_in, *table.arrays(), block=64, interpret=True
+        )
+    )
+    assert (pl == xla).all()
+    assert (xla & prevalid)[:n].tolist() == _oracle(items)
+
+
+def test_table_verifier_falls_back_on_unknown_pub(ring):
+    from hyperdrive_tpu.ops.ed25519_wire import ValidatorTable
+
+    # ring[7] is NOT in the table: the chunk must route through the full
+    # wire path and still match the oracle (verdicts independent of the
+    # table).
+    table = ValidatorTable([ring[i].public for i in range(4)])
+    wv = TpuWireVerifier(buckets=(16,), table=table)
+    items = []
+    for i in (0, 1, 2, 3, 7):
+        kp = ring[i]
+        msg = bytes([i]) * 20
+        items.append((kp.public, msg, host_ed.sign(kp.seed, msg)))
+    assert wv.verify_signatures(items).tolist() == _oracle(items)
+    # All-known chunk rides the indexed path, same verdicts.
+    known = items[:4]
+    assert wv.verify_signatures(known).tolist() == _oracle(known)
+
+
+def test_wire_verifier_protocol_matches_host(ring):
+    hv = HostVerifier()
+    wv = TpuWireVerifier(buckets=(16, 64))
+    msgs = []
+    for i in range(6):
+        kp = ring[i]
+        pv = Prevote(height=1, round=0, value=bytes([i]) * 32, sender=kp.public)
+        if i % 3 == 0:
+            msgs.append(kp.sign_message(pv))
+        elif i % 3 == 1:
+            msgs.append(pv)  # unsigned
+        else:
+            msgs.append(pv.with_signature(b"\x02" * 64))
+    assert wv.verify_batch(msgs) == hv.verify_batch(msgs)
+    assert wv.verify_signatures([]).tolist() == []
+
+
+def test_wire_chunking_across_buckets(ring):
+    # 5 items in a 4-bucket verifier: two launches, one concatenated
+    # fetch; verdicts must still line up with the oracle.
+    wv = TpuWireVerifier(buckets=(2, 4))
+    items = []
+    for i in range(5):
+        kp = ring[i % len(ring)]
+        msg = bytes([i]) * 16
+        sig = host_ed.sign(kp.seed, msg)
+        if i == 2:
+            sig = b"\x00" * 64
+        items.append((kp.public, msg, sig))
+    assert wv.verify_signatures(items).tolist() == _oracle(items)

@@ -550,6 +550,36 @@ def test_burst_signed_with_tpu_batch_verifier():
         assert set(range(1, 4)) <= set(c.keys())
 
 
+def test_burst_signed_device_verify_forced_for_small_windows():
+    # At miniature scale (n=4) every non-fused settle window is under the
+    # 64-item host-routing threshold, so the auto small-window routing
+    # would send ALL of them to HostVerifier and the device verify path
+    # would go unexercised end to end. small_window_host=False pins the
+    # device backend for every window, however small, and the run must be
+    # trajectory-identical to the auto-routed one (verdicts are
+    # differentially equal by construction).
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+    kw = dict(n=4, target_height=3, seed=79, sign=True, burst=True)
+    forced_verifier = TpuBatchVerifier(buckets=(16, 64))
+    forced = Simulation(
+        batch_verifier=forced_verifier,
+        small_window_host=False,
+        **kw,
+    )
+    assert forced._small_win_host is None
+    fres = forced.run()
+    assert fres.completed, f"stalled at {fres.heights}"
+    fres.assert_safety()
+    auto = Simulation(
+        batch_verifier=TpuBatchVerifier(buckets=(16, 64)), **kw
+    )
+    assert auto._small_win_host is not None
+    ares = auto.run()
+    assert fres.commits == ares.commits
+    assert fres.steps == ares.steps
+
+
 # ------------------------------------------------------- MPC payloads
 #
 # BASELINE config 5's capability: proposals carry (2f+1)-of-n Shamir share
