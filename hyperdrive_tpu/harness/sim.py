@@ -26,6 +26,7 @@ from __future__ import annotations
 import hashlib
 import heapq
 import random
+import time
 from itertools import repeat
 
 import numpy as np
@@ -343,6 +344,7 @@ class Simulation:
         record: bool = True,
         shared_superstep: Optional[bool] = None,
         small_window_host: Optional[bool] = None,
+        fused_min_window: int = 0,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -493,6 +495,19 @@ class Simulation:
         #: Optional callable (view, proc) -> view, used by tests to wrap
         #: every TallyView in a host-vs-device equality checker.
         self._tally_check = tally_check
+        #: Per-settle crossover routing for the fused device path: a
+        #: vote-bearing settle whose shared window holds fewer than this
+        #: many messages is handled entirely on the host (aggregated host
+        #: verification + host-counter cascade) instead of paying the
+        #: fused launch's device sync. On a tunnel-attached chip the sync
+        #: floor is ~100 ms — the host verifies ~1000 signatures in that
+        #: time — so sub-crossover settles are faster on host by
+        #: construction; the device grid is poisoned for the affected
+        #: heights (counts would be incomplete) and re-engages at the
+        #: next height. 0 = always fuse (the round-3 behavior). This is
+        #: AdaptiveVerifier's measured-crossover insight applied to the
+        #: whole settle, not just the verify leg.
+        self._fused_min_window = int(fused_min_window)
         if device_tally and not (burst and self.batch_ingest):
             raise ValueError(
                 "device_tally requires burst=True with batched ingestion"
@@ -1076,6 +1091,14 @@ class Simulation:
                     for i, _ in windows
                 )
             ):
+                if len(shared_window) < self._fused_min_window:
+                    # Sub-crossover settle: the host finishes verify +
+                    # cascade before one device round trip would return.
+                    # Handle it fully on host and poison the grid for the
+                    # affected heights (its counts would be missing these
+                    # votes).
+                    self._route_settle_to_host(windows, shared_window)
+                    continue
                 if self._dispatch_fused(shared_window, windows):
                     continue
                 # Vote-free window (the propose settle): verification is
@@ -1185,7 +1208,52 @@ class Simulation:
                 windows.append((i, w))
         return shared, windows
 
-    def _verify_windows(self, windows, shared_window=None) -> list:
+    def _route_settle_to_host(self, windows, shared_window) -> None:
+        """Handle one sub-crossover settle fully on host: aggregated host
+        verification, plain window dispatch (host-counter cascade), and
+        grid poisoning — the device grid is now missing this settle's
+        votes for the affected heights, so exactly the (plane, round)
+        slots this window's votes would have occupied are marked dirty
+        until the height advances (TallyView declines dirty rounds and
+        the cascade falls back to its host counters, which are always
+        complete; untouched rounds stay live on the grid). A vote-free
+        window poisons nothing — there is nothing the grid could miss
+        (mirroring _dispatch_fused's vote-free skip)."""
+        grid_r = self.vote_grid.R
+        touched = set()
+        for m in shared_window:
+            t = type(m)
+            if t is Prevote or t is Precommit:
+                rnd = m.round
+                if 0 <= rnd < grid_r:
+                    # (Out-of-window rounds never scatter and TallyView
+                    # never serves them — no poison needed.)
+                    touched.add((1 if t is Precommit else 0, rnd))
+        if touched:
+            all_pairs = [(p, r) for p in (0, 1) for r in range(grid_r)]
+            for i, _ in windows:
+                h = self.replicas[i].current_height()
+                if self._grid_height[i] != h:
+                    # The grid was never reset for this height: its rows
+                    # are stale for EVERY round, and claiming the height
+                    # here (so the next fused settle does not reset-and-
+                    # clear the poison) means no zeroing will happen —
+                    # poison the whole height.
+                    self._grid_height[i] = h
+                    self._grid_dirty[i] = set(all_pairs)
+                else:
+                    # Grid live at this height: only the slots this
+                    # window's votes would have filled are now missing;
+                    # untouched rounds' counts remain complete and
+                    # servable.
+                    self._grid_dirty[i].update(touched)
+        self.tracer.observe("sim.settle.host_routed", len(shared_window))
+        keeps = self._verify_windows(windows, shared_window, force_host=True)
+        for (i, w), keep in zip(windows, keeps):
+            self.replicas[i].dispatch_window(w, keep)
+
+    def _verify_windows(self, windows, shared_window=None,
+                        force_host: bool = False) -> list:
         """One aggregated verification launch for a settle pass's windows;
         returns the per-window keep masks (None entries = no verifier)."""
         keeps: list = [None] * len(windows)
@@ -1225,7 +1293,7 @@ class Simulation:
                     row.append(j)
                 slots.append(row)
             self.tracer.observe("sim.verify.launch", len(items))
-            mask = self._verify_items(items)
+            mask = self._verify_items(items, force_host)
             shared_keep = (
                 mask if shared_len == len(mask) else mask[:shared_len]
             )
@@ -1239,16 +1307,22 @@ class Simulation:
                 items.extend((m.sender, m.digest(), m.signature) for m in w)
                 bounds.append((start, len(items)))
             self.tracer.observe("sim.verify.launch", len(items))
-            mask = self._verify_items(items)
+            mask = self._verify_items(items, force_host)
             keeps = [mask[a:b] for a, b in bounds]
         return keeps
 
-    def _verify_items(self, items) -> list:
+    def _verify_items(self, items, force_host: bool = False) -> list:
         """One aggregated signature verification, routed: sub-64-item
         windows go to the bit-identical host verifier (a device sync for
         two signatures costs three orders of magnitude more than
-        computing them), everything else to the installed backend."""
-        if self._small_win_host is not None and len(items) < 64:
+        computing them), everything else to the installed backend.
+        ``force_host``: a settle the crossover router already decided to
+        keep on host (fused_min_window) verifies there too — unless the
+        small_window_host knob disabled the host verifier, in which case
+        the device backend still answers (correctly, just slower)."""
+        if self._small_win_host is not None and (
+            force_host or len(items) < 64
+        ):
             mask = self._small_win_host.verify_signatures(items)
         else:
             mask = self.batch_verifier.verify_signatures(items)
@@ -1543,10 +1617,18 @@ class Simulation:
             targets, tvalid, l28_slot, l28_target, fs,
         )
         # The settle's ONE blocking sync: mask and packed counts arrive in
-        # the same transfer.
+        # the same transfer. Wall-clock it (histogram value in seconds):
+        # the insert + cascade below are data-dependent on this mask and
+        # these counts, so this sync is the settle's un-hideable device
+        # cost — the telemetry BENCH.md's settle-pipeline analysis reads.
+        t_sync = time.perf_counter()
         keep = (fused_out.mask() & prevalid)[:nitems].tolist()
         counts = fused_out.counts()
+        self.tracer.observe(
+            "sim.fused.sync_s", time.perf_counter() - t_sync
+        )
 
+        t_host = time.perf_counter()
         plans = []
         for i, w in windows:
             plans.append(
@@ -1566,6 +1648,12 @@ class Simulation:
             if self._tally_check is not None:
                 view = self._tally_check(view, self.replicas[i].proc)
             self.replicas[i].ingest_cascade_window(plan, view)
+        # Host insert+cascade wall time, the companion to sim.fused.sync_s:
+        # when cascade_s < sync_s, even a perfectly overlapped pipeline
+        # cannot hide the sync behind host work — the settle is RTT-bound.
+        self.tracer.observe(
+            "sim.fused.cascade_s", time.perf_counter() - t_host
+        )
         return True
 
     # -------------------------------------------------------------- replay

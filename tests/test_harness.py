@@ -529,6 +529,79 @@ def test_device_tally_fused_single_launch_pipeline():
     assert fres.record.messages == unfused.record.messages
 
 
+def test_fused_min_window_routes_every_settle_to_host():
+    # Crossover routing, threshold above any window: no fused launch ever
+    # fires, every settle is handled on host — and the run is trajectory-
+    # identical to both the plain host run and the always-fused run.
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+    kw = dict(n=4, target_height=3, seed=83, sign=True, burst=True)
+    routed = Simulation(
+        **kw,
+        batch_verifier=TpuBatchVerifier(buckets=(16, 64)),
+        dedup_verify=True,
+        device_tally=True,
+        fused_min_window=10_000,
+    )
+    rres = routed.run()
+    assert rres.completed, f"stalled at {rres.heights}"
+    rres.assert_safety()
+    hists = routed.tracer.snapshot()["histograms"]
+    assert "sim.fused.sync_s" not in hists, "a fused launch still fired"
+    assert hists["sim.settle.host_routed"]["count"] > 0
+    host = Simulation(**kw).run()
+    fused = Simulation(
+        **kw,
+        batch_verifier=TpuBatchVerifier(buckets=(16, 64)),
+        dedup_verify=True,
+        device_tally=True,
+    ).run()
+    assert rres.commits == host.commits == fused.commits
+    assert rres.steps == host.steps == fused.steps
+
+
+def test_fused_min_window_partial_grid_poison_is_sound():
+    # A mid threshold leaves SOME settles fused and SOME host-routed: the
+    # grid is then missing the routed settles' votes, and the poison
+    # (whole-height dirty marks) must keep the cascade off those counts.
+    # CheckedTallyView raises on any device/host count divergence, and
+    # the run must still commit identically to the host run.
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+    from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+
+    views = []
+
+    def checked(view, proc):
+        v = CheckedTallyView(view, proc)
+        views.append(v)
+        return v
+
+    kw = dict(n=4, target_height=4, seed=83, sign=True, burst=True)
+    host = Simulation(**kw).run()
+    for threshold in (3, 5, 7):
+        views.clear()
+        sim = Simulation(
+            **kw,
+            batch_verifier=TpuBatchVerifier(buckets=(16, 64)),
+            dedup_verify=True,
+            device_tally=True,
+            fused_min_window=threshold,
+            tally_check=checked,
+        )
+        res = sim.run()
+        assert res.completed, f"threshold {threshold}: {res.heights}"
+        res.assert_safety()
+        assert res.commits == host.commits, f"threshold {threshold}"
+        assert res.steps == host.steps, f"threshold {threshold}"
+        hists = sim.tracer.snapshot()["histograms"]
+        assert hists["sim.settle.host_routed"]["count"] > 0, threshold
+        if threshold == 3:
+            # At this seed/size, threshold 3 leaves a genuine MIX: some
+            # settles fused (grid engaged), some routed (grid poisoned) —
+            # the combination the poison logic exists for.
+            assert hists["sim.fused.sync_s"]["count"] > 0
+
+
 def test_burst_signed_with_tpu_batch_verifier():
     # The full BASELINE config-4 pipeline at miniature scale: a signed
     # burst-mode network whose aggregated windows are verified by the
