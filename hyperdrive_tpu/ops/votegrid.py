@@ -407,13 +407,41 @@ class VoteGrid:
                 )
             spec_v = P(None, None, None, val_axis, None)
             spec_p = P(None, None, None, val_axis)
-            self._values = jax.device_put(
-                jnp.zeros(shape_v, dtype=jnp.int32),
-                NamedSharding(mesh, spec_v),
+            # Multi-process mesh (a real jax.distributed pod): host numpy
+            # inputs cannot be committed to non-addressable devices by
+            # plain device_put/jnp.asarray — every input is assembled as
+            # a GLOBAL array from each process's (identical) local copy.
+            # Each process runs the same deterministic automaton, so the
+            # replicated values agree by construction.
+            self._multiproc = (
+                len({d.process_index for d in mesh.devices.flat}) > 1
             )
-            self._present = jax.device_put(
-                jnp.zeros(shape_p, dtype=bool), NamedSharding(mesh, spec_p)
-            )
+            self._rep_sharding = NamedSharding(mesh, P())
+
+            def _global_zeros(shape, dtype, spec):
+                if not self._multiproc:
+                    return jax.device_put(
+                        jnp.zeros(shape, dtype=dtype),
+                        NamedSharding(mesh, spec),
+                    )
+                # Allocate only each shard (zeros are position-
+                # independent; materializing the full global array once
+                # per local device would cost n_local x full-grid host
+                # RAM).
+                return jax.make_array_from_callback(
+                    shape,
+                    NamedSharding(mesh, spec),
+                    lambda idx: np.zeros(
+                        tuple(
+                            len(range(*s.indices(dim)))
+                            for s, dim in zip(idx, shape)
+                        ),
+                        dtype=dtype,
+                    ),
+                )
+
+            self._values = _global_zeros(shape_v, jnp.int32, spec_v)
+            self._present = _global_zeros(shape_p, bool, spec_p)
             rep = P()
             sharded = jax.shard_map(
                 partial(_kernel, axis_name=val_axis),
@@ -427,6 +455,29 @@ class VoteGrid:
 
     def bucket_for(self, k: int) -> int:
         return bucketing.bucket_for(k, self.buckets)
+
+    def _rep(self, x):
+        """A replicated device input: plain ``jnp.asarray`` single-process,
+        a process-local-fed global array on a multi-process mesh.
+
+        DELIBERATE deviation from
+        :func:`hyperdrive_tpu.parallel.replicate_to_all_hosts` (which
+        broadcasts process 0's bytes precisely because local assembly is
+        undefined if hosts disagree): these inputs arrive once per settle
+        on the hot path — a broadcast collective per input per settle
+        would devour the budget — and divergence between the processes'
+        deterministic automata is not silently absorbed here but CAUGHT
+        downstream: device counts are cross-checked against each
+        process's own host counters (CheckedTallyView) and the harness
+        all-gathers commit-map hashes across processes. A deployment
+        feeding non-deterministic inputs must use the broadcast helper.
+        """
+        if self._mesh is None or not self._multiproc:
+            return jnp.asarray(x)
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, self._rep_sharding, lambda idx: x[idx]
+        )
 
     # ------------------------------------------------------------ fused path
 
@@ -523,15 +574,15 @@ class VoteGrid:
         self._values, self._present, packed = self._fn(
             self._values,
             self._present,
-            jnp.asarray(reset),
-            jnp.asarray(pad_idx),
-            jnp.asarray(pad_words),
-            jnp.asarray(valid),
-            jnp.asarray(targets),
-            jnp.asarray(target_valid),
-            jnp.asarray(l28_slot),
-            jnp.asarray(l28_target),
-            jnp.asarray(f),
+            self._rep(reset),
+            self._rep(pad_idx),
+            self._rep(pad_words),
+            self._rep(valid),
+            self._rep(targets),
+            self._rep(target_valid),
+            self._rep(l28_slot),
+            self._rep(l28_target),
+            self._rep(f),
         )
         # One DEFERRED host fetch for everything (see the packing note in
         # _kernel): the counts stay on device until a rule actually reads

@@ -1,0 +1,278 @@
+"""Loopback-TCP binding of the Broadcaster seam.
+
+The reference leaves networking entirely to the embedding application —
+the ``Broadcaster`` DI interface IS the whole communication backend
+contract (broadcast to all incl. self, eventual delivery, no ordering;
+reference: process/process.go:47-60), and its tests wire it to an
+in-memory queue (replica/replica_test.go:174-208). This module turns that
+seam into a PROOF over real sockets: a full-mesh, length-framed TCP
+transport driving threaded replicas with real wall-clock
+:class:`~hyperdrive_tpu.timer.LinearTimer` timeouts — consensus across OS
+process boundaries with no shared memory.
+
+Scope (deliberate): the control plane for small messages. Bulk tensor
+traffic (vote batches, signature limbs) belongs on ICI/DCN device
+collectives (:mod:`hyperdrive_tpu.parallel`); this transport carries the
+consensus envelopes a deployment would gossip over its host network.
+
+Wire format: 4-byte little-endian length + the signed message envelope
+(:func:`hyperdrive_tpu.messages.marshal_message`). Malformed frames from
+a peer are dropped (DoS-safe: the codec never raises past the budget, and
+a framing error closes only that peer's connection).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+
+from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.messages import (
+    Precommit,
+    Propose,
+    Prevote,
+    marshal_message,
+    unmarshal_message,
+)
+
+__all__ = ["TcpBroadcaster", "TcpNode", "encode_frame"]
+
+_LEN = struct.Struct("<I")
+_MAX_FRAME = 1 << 20  # 1 MiB: far above any consensus envelope
+#: Per-peer outbound buffer (frames). A peer that stays unreachable longer
+#: than this many broadcasts sees the oldest frames dropped — best-effort,
+#: matching the reference's trust model where eventual delivery is the
+#: embedding network's promise, not the library's
+#: (process/process.go:47-60).
+_PEER_QUEUE = 4096
+
+
+def encode_frame(msg) -> bytes:
+    w = Writer()
+    marshal_message(msg, w)
+    payload = w.data()
+    return _LEN.pack(len(payload)) + payload
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class TcpNode:
+    """One process's endpoint of the full-mesh broadcast transport.
+
+    Hosts any number of local replicas. ``broadcast`` serializes once,
+    delivers to every LOCAL replica directly (the Broadcaster contract
+    includes the sender), and writes the frame to every remote peer's
+    connection. Inbound frames are decoded and delivered to every local
+    replica. Peer connections are dialed lazily with retries, so nodes
+    may start in any order.
+    """
+
+    def __init__(self, listen_port: int = 0, host: str = "127.0.0.1"):
+        self._host = host
+        self._replicas: list = []
+        #: peer key -> outbound frame queue, drained by a dedicated sender
+        #: thread per peer — a dead or slow peer can never stall the
+        #: broadcasting replica threads or the other peers.
+        self._peer_queues: dict[tuple[str, int], queue.Queue] = {}
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._accepted: list[socket.socket] = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, listen_port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._threads = [
+            threading.Thread(target=self._accept_loop, daemon=True)
+        ]
+
+    # ------------------------------------------------------------ lifecycle
+
+    def add_replica(self, replica) -> None:
+        """Register a local threaded replica (its async ``propose``/
+        ``prevote``/``precommit`` inbox methods receive every delivered
+        message)."""
+        self._replicas.append(replica)
+
+    def add_peer(self, host: str, port: int) -> None:
+        key = (host, port)
+        if key in self._peer_queues:
+            return
+        q: queue.Queue = queue.Queue(maxsize=_PEER_QUEUE)
+        self._peer_queues[key] = q
+        self._threads.append(
+            threading.Thread(
+                target=self._send_loop, args=(key, q), daemon=True
+            )
+        )
+
+    def start(self) -> None:
+        for t in self._threads:
+            if not t.is_alive():
+                try:
+                    t.start()
+                except RuntimeError:
+                    pass  # already started (idempotent start)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for q in self._peer_queues.values():
+            try:
+                q.put_nowait(None)  # wake the sender thread
+            except queue.Full:
+                pass
+        with self._lock:
+            for sock in self._accepted:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._accepted.clear()
+
+    # ------------------------------------------------------------- inbound
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            with self._lock:
+                if self._stop.is_set():
+                    conn.close()
+                    continue
+                self._accepted.append(conn)
+            t = threading.Thread(
+                target=self._read_loop, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        with conn:
+            while not self._stop.is_set():
+                try:
+                    head = _recv_exact(conn, _LEN.size)
+                    if head is None:
+                        return
+                    (length,) = _LEN.unpack(head)
+                    if length > _MAX_FRAME:
+                        return  # framing attack: drop the connection
+                    payload = _recv_exact(conn, length)
+                    if payload is None:
+                        return
+                except OSError:
+                    return
+                try:
+                    msg = unmarshal_message(Reader(payload))
+                except SerdeError:
+                    continue  # malformed envelope: drop the frame
+                if self._stop.is_set():
+                    return
+                self._deliver(msg)
+
+    def _deliver(self, msg) -> None:
+        # Timeouts are LOCAL, unauthenticated events (each replica's own
+        # LinearTimer enqueues them directly); a Timeout arriving off the
+        # wire is a forgery attempt — any peer could otherwise drive
+        # honest replicas into premature round changes. Deliver only the
+        # three signed consensus message types.
+        t = type(msg)
+        for r in self._replicas:
+            if t is Propose:
+                r.propose(msg, self._stop)
+            elif t is Prevote:
+                r.prevote(msg, self._stop)
+            elif t is Precommit:
+                r.precommit(msg, self._stop)
+
+    # ------------------------------------------------------------- outbound
+
+    def _send_loop(self, key, q: "queue.Queue") -> None:
+        """One peer's sender: connect (retrying with backoff — peers start
+        in any order and may crash), then drain the frame queue. A dead
+        peer costs nothing to anyone else: broadcasts just enqueue."""
+        sock: socket.socket | None = None
+        while not self._stop.is_set():
+            frame = q.get()
+            if frame is None or self._stop.is_set():
+                break
+            while not self._stop.is_set():
+                if sock is None:
+                    try:
+                        sock = socket.create_connection(key, timeout=5.0)
+                        sock.setsockopt(
+                            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                        )
+                    except OSError:
+                        time.sleep(0.1)
+                        continue
+                try:
+                    sock.sendall(frame)
+                    break
+                except OSError:
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def broadcast(self, msg) -> None:
+        """Fan out to all: local replicas directly, remote peers via their
+        sender queues (never blocks on a slow or dead peer; a full queue
+        drops the oldest frame — see _PEER_QUEUE)."""
+        self._deliver(msg)
+        frame = encode_frame(msg)
+        for q in self._peer_queues.values():
+            while True:
+                try:
+                    q.put_nowait(frame)
+                    break
+                except queue.Full:
+                    try:
+                        q.get_nowait()  # shed the oldest frame
+                    except queue.Empty:
+                        pass
+
+
+class TcpBroadcaster:
+    """Per-replica Broadcaster facade over a shared :class:`TcpNode`,
+    signing each outbound message when a keypair is supplied (the wire
+    envelope carries the detached signature)."""
+
+    def __init__(self, node: TcpNode, keypair=None):
+        self._node = node
+        self._kp = keypair
+
+    def _send(self, msg) -> None:
+        if self._kp is not None:
+            msg = self._kp.sign_message(msg)
+        self._node.broadcast(msg)
+
+    def broadcast_propose(self, propose: Propose) -> None:
+        self._send(propose)
+
+    def broadcast_prevote(self, prevote: Prevote) -> None:
+        self._send(prevote)
+
+    def broadcast_precommit(self, precommit: Precommit) -> None:
+        self._send(precommit)

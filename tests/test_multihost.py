@@ -101,13 +101,17 @@ def test_sharded_step_on_hybrid_mesh_matches_plain_mesh():
     assert int(counts_a["matching"][1]) == V - 1
 
 
-def test_two_process_distributed_step():
+def test_two_process_distributed_step_and_consensus():
     # The REAL multi-process branches — jax.distributed rendezvous, hybrid
     # DCN mesh construction, host_local_array_to_global_array,
     # broadcast_one_to_all — executed by two actual processes (2 CPU
-    # devices each = a 2x2 pod) driving the sharded verify+tally step.
-    # Each worker checks its own round's psum'd counts and prints
-    # MULTIHOST_OK; any assertion exits nonzero.
+    # devices each = a 2x2 pod) driving (1) the sharded verify+tally step
+    # and (2) a FULL sharded-grid consensus run: 3 heights committed
+    # through a vote grid whose validator axis spans the process boundary
+    # (every settle's psum is a cross-process collective), device counts
+    # checked equal to host counters, commit maps all-gather-verified
+    # identical across processes. Each worker prints MULTIHOST_OK and
+    # MULTIHOST_CONSENSUS_OK; any assertion exits nonzero.
     import os
     import socket
     import subprocess
@@ -141,13 +145,14 @@ def test_two_process_distributed_step():
     ]
     for rank, p in enumerate(procs):
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=420)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
         assert p.returncode == 0, f"worker {rank} failed:\n{out}"
         assert f"MULTIHOST_OK rank={rank} procs=2 devices=4" in out, out
+        assert f"MULTIHOST_CONSENSUS_OK rank={rank} heights=3" in out, out
 
 
 def test_global_window_accepts_custom_spec():

@@ -1,0 +1,142 @@
+"""Worker + shared builders for the loopback-TCP Broadcaster demo.
+
+``python tests/transport_worker.py <portA> <portB> <rank> <target>``
+hosts replicas {0,1} (rank 0) or {2,3} (rank 1) of a 4-validator network
+on a :class:`hyperdrive_tpu.transport.TcpNode`, with real wall-clock
+LinearTimer timeouts and signed messages verified per replica — consensus
+across an OS process boundary with no shared memory. Prints
+``TRANSPORT_OK rank=<r> heights=<target> digest=<sha256>`` where the
+digest covers the (identical) commit chains of both local replicas; the
+parent test asserts the digests agree ACROSS processes.
+
+The builders are imported by tests/test_transport.py for the in-process
+4-node variant; this module must not import jax (the transport layer is
+pure host code, and worker startup stays fast).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hyperdrive_tpu.crypto.keys import KeyRing
+from hyperdrive_tpu.replica import Replica, ReplicaOptions
+from hyperdrive_tpu.testutil import (
+    CommitterCallback,
+    MockProposer,
+    MockValidator,
+)
+from hyperdrive_tpu.timer import LinearTimer
+from hyperdrive_tpu.transport import TcpBroadcaster, TcpNode
+from hyperdrive_tpu.verifier import HostVerifier
+
+
+def deterministic_value(height, round_):
+    return hashlib.sha256(b"txval-%d-%d" % (height, round_)).digest()
+
+
+def build_replica(node: TcpNode, ring: KeyRing, i: int, target: int,
+                  commits: dict, done: threading.Event,
+                  timeout_s: float = 5.0) -> Replica:
+    """One threaded replica wired to the node: TcpBroadcaster (signing),
+    LinearTimer (real wall-clock timeout threads), HostVerifier (every
+    delivered message's signature checked), commit hook recording into
+    ``commits`` and firing ``done`` at the target height."""
+    cell: dict = {}
+    timer = LinearTimer(
+        handle_timeout_propose=lambda t: cell["r"].timeout(t),
+        handle_timeout_prevote=lambda t: cell["r"].timeout(t),
+        handle_timeout_precommit=lambda t: cell["r"].timeout(t),
+        timeout=timeout_s,
+    )
+
+    def on_commit(height, value):
+        commits[height] = value
+        if len(commits) >= target:
+            done.set()
+        return 0, None
+
+    rep = Replica(
+        ReplicaOptions(),
+        whoami=ring[i].public,
+        signatories=list(ring.signatories),
+        timer=timer,
+        proposer=MockProposer(fn=deterministic_value),
+        validator=MockValidator(ok=True),
+        committer=CommitterCallback(on_commit=on_commit),
+        catcher=None,
+        broadcaster=TcpBroadcaster(node, keypair=ring[i]),
+        verifier=HostVerifier(),
+    )
+    cell["r"] = rep
+    node.add_replica(rep)
+    return rep
+
+
+def run_local_replicas(node: TcpNode, ring: KeyRing, indices, target: int,
+                       deadline_s: float = 120.0):
+    """Run the given replica indices on ``node`` until every one commits
+    ``target`` heights (or the deadline passes). Returns {index: commits}.
+    """
+    commits = {i: {} for i in indices}
+    dones = {i: threading.Event() for i in indices}
+    reps = [
+        build_replica(node, ring, i, target, commits[i], dones[i])
+        for i in indices
+    ]
+    stop = threading.Event()
+    threads = [
+        threading.Thread(target=r.run, args=(stop,), daemon=True)
+        for r in reps
+    ]
+    node.start()
+    for t in threads:
+        t.start()
+    ok = all(d.wait(timeout=deadline_s) for d in dones.values())
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    node.stop()
+    if not ok:
+        raise RuntimeError(
+            f"stalled: heights {[len(c) for c in commits.values()]}"
+            f" of {target}"
+        )
+    return commits
+
+
+def commits_digest(commits_by_index: dict) -> str:
+    """One digest over all local chains — the worker asserts local chains
+    identical first, so the digest describes THE chain."""
+    chains = [
+        tuple(sorted(c.items())) for c in commits_by_index.values()
+    ]
+    assert all(c == chains[0] for c in chains), "local replicas diverged"
+    return hashlib.sha256(repr(chains[0]).encode()).hexdigest()
+
+
+def main() -> None:
+    port_a, port_b, rank, target = (
+        int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+        int(sys.argv[4]),
+    )
+    my_port = (port_a, port_b)[rank]
+    peer_port = (port_a, port_b)[1 - rank]
+    ring = KeyRing.deterministic(4, namespace=b"tcp-demo")
+    node = TcpNode(listen_port=my_port)
+    node.add_peer("127.0.0.1", peer_port)
+    indices = (0, 1) if rank == 0 else (2, 3)
+    commits = run_local_replicas(node, ring, indices, target)
+    digest = commits_digest(commits)
+    print(
+        f"TRANSPORT_OK rank={rank} heights={target} digest={digest}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
