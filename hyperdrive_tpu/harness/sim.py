@@ -341,6 +341,7 @@ class Simulation:
         tally_check=None,
         payload_bytes: int = 0,
         dedup_reconstruct: bool = True,
+        reconstructor=None,
         record: bool = True,
         shared_superstep: Optional[bool] = None,
         small_window_host: Optional[bool] = None,
@@ -588,10 +589,23 @@ class Simulation:
         self._bundle_cache: dict[Value, bytes] = {}
         self._recon_cache: dict[Value, bytes] = {}
         if payload_bytes:
-            from hyperdrive_tpu.ops.shamir import BatchReconstructor
+            from hyperdrive_tpu.ops.shamir import AdaptiveReconstructor
 
             self.k = 2 * self.f + 1
-            self.reconstructor = BatchReconstructor()
+            #: Commit-path reconstruction routes host/device by block
+            #: count. In-harness, commit batches (~16 blocks) sit far
+            #: below the provisional crossover AND below calibrate_at, so
+            #: every sim commit rides the cached-weight host leg on the
+            #: provisional threshold — the measured calibration only
+            #: triggers on wide batches (benches, bulk resync). Pass
+            #: ``reconstructor=`` to pin a specific backend — e.g.
+            #: BatchReconstructor() to force every commit through the
+            #: device kernel (the pinned e2e test does).
+            self.reconstructor = (
+                reconstructor
+                if reconstructor is not None
+                else AdaptiveReconstructor()
+            )
             #: Per-replica height -> reconstructed payload bytes.
             self.reconstructed: list[dict[Height, bytes]] = [
                 dict() for _ in range(n)

@@ -11,6 +11,7 @@ GF(2^255-19) limb kernels as signature verification (SURVEY.md 7.1(3)).
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 
@@ -20,7 +21,11 @@ import jax.numpy as jnp
 from hyperdrive_tpu.crypto import shamir as host_shamir
 from hyperdrive_tpu.ops import fe25519 as fe
 
-__all__ = ["reconstruct_kernel", "BatchReconstructor"]
+__all__ = [
+    "reconstruct_kernel",
+    "BatchReconstructor",
+    "AdaptiveReconstructor",
+]
 
 
 @functools.lru_cache(maxsize=None)
@@ -52,6 +57,33 @@ def reconstruct_kernel(y_shares: jnp.ndarray, lams: jnp.ndarray) -> jnp.ndarray:
     return fe.canonical(acc)
 
 
+def _sorted_validated(per_block_shares):
+    """Sort each block's shares by x and demand ONE contributor set across
+    all blocks (one set of Lagrange weights covers the whole batch —
+    mismatched sets raise instead of corrupting). Returns
+    (sorted_blocks, xs tuple). Shared by the device and host legs so the
+    validation can never diverge."""
+    sorted_blocks = [sorted(shares) for shares in per_block_shares]
+    xs = tuple(x for x, _ in sorted_blocks[0])
+    for i, shares in enumerate(sorted_blocks):
+        if tuple(x for x, _ in shares) != xs:
+            raise ValueError(
+                f"block {i} has share x-coordinates "
+                f"{[x for x, _ in shares]} != {list(xs)}; all blocks "
+                "must come from the same contributor set"
+            )
+    return sorted_blocks, xs
+
+
+def _cache_put(cache: dict, key, value, bound: int = 64):
+    """Bounded FIFO insert (churning contributor sets must not pin
+    weights forever)."""
+    if len(cache) >= bound:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
+    return value
+
+
 class BatchReconstructor:
     """Host wrapper: packs shares, runs the jitted kernel, unpacks bytes."""
 
@@ -76,13 +108,13 @@ class BatchReconstructor:
         key = tuple(xs)
         lams = self._lam_cache.get(key)
         if lams is None:
-            lams = jnp.asarray(
-                fe.to_limbs(host_shamir.lagrange_coeffs_at_zero(xs))
+            lams = _cache_put(
+                self._lam_cache,
+                key,
+                jnp.asarray(
+                    fe.to_limbs(host_shamir.lagrange_coeffs_at_zero(xs))
+                ),
             )
-            if len(self._lam_cache) >= 64:  # bound: churning contributor
-                # sets must not pin device buffers forever (FIFO evict)
-                self._lam_cache.pop(next(iter(self._lam_cache)))
-            self._lam_cache[key] = lams
         y = jnp.asarray(fe.to_limbs(y_blocks))  # [k, B, 20]
         out = np.asarray(self._fn(y, lams))
         return [fe.from_limbs(row) for row in out]
@@ -98,21 +130,139 @@ class BatchReconstructor:
         """
         if not per_block_shares:
             return b""
-        sorted_blocks = [sorted(shares) for shares in per_block_shares]
-        xs = [x for x, _ in sorted_blocks[0]]
-        for i, shares in enumerate(sorted_blocks):
-            if [x for x, _ in shares] != xs:
-                raise ValueError(
-                    f"block {i} has share x-coordinates "
-                    f"{[x for x, _ in shares]} != {xs}; all blocks must "
-                    "come from the same contributor set"
-                )
+        sorted_blocks, xs = _sorted_validated(per_block_shares)
         y_blocks = [
             [shares[i][1] for shares in sorted_blocks]
             for i in range(len(xs))
         ]
-        secrets = self.reconstruct_blocks(xs, y_blocks)
+        secrets = self.reconstruct_blocks(list(xs), y_blocks)
         out = b"".join(
             s.to_bytes(host_shamir.BLOCK_BYTES, "little") for s in secrets
         )
         return host_shamir.unpad_payload(out)
+
+
+class AdaptiveReconstructor:
+    """Routes each reconstruction to the host or the device by block
+    count — :class:`hyperdrive_tpu.verifier.AdaptiveVerifier`'s
+    measured-crossover insight applied to the commit path.
+
+    A commit-sized payload (BASELINE config 5: 16 blocks, 496 bytes) is
+    a few hundred host modular multiplies — microseconds — while any
+    device launch pays the dispatch+transfer floor (~100 ms on a
+    tunnel-attached chip). Wide batches (bulk re-reconstruction, state
+    sync) belong on the device. The break-even is measured, not guessed:
+    the first batch at least ``calibrate_at`` blocks wide is timed
+    through BOTH paths (outputs also cross-checked), and the solved
+    crossover routes everything after. Until calibration, the
+    provisional ``crossover_blocks`` routes.
+
+    Both paths implement ``reconstruct_payload_shares`` with identical
+    outputs (the device path is differentially tested against the host
+    oracle), so routing is a pure performance decision.
+    """
+
+    def __init__(self, device: "BatchReconstructor | None" = None,
+                 crossover_blocks: int = 512, calibrate_at: int = 512):
+        self.device = device if device is not None else BatchReconstructor()
+        self.crossover_blocks = int(crossover_blocks)
+        self.calibrate_at = int(calibrate_at)
+        self.calibrated = False
+        #: Self-describing calibration record once measured — keys
+        #: ``host_blocks_per_s``, ``device_blocks_per_s``,
+        #: ``device_overhead_s`` (single-launch time in seconds).
+        self.rates = None
+        # Host-side Lagrange weight cache, mirroring the device's: the
+        # naive per-block reconstruct_payload recomputes the weights — k
+        # modular INVERSES — for every block, which at k = 171 costs
+        # ~30 ms/block and inverts the whole host-vs-device comparison
+        # (measured: naive host 0.49 s vs device 0.12 s on a 16-block
+        # commit; cached host ~1 ms). Weights depend only on the
+        # contributor set, stable across commits in steady state.
+        self._host_lams: dict[tuple, list] = {}
+
+    def warmup(self, k: int, blocks: int) -> None:
+        self.device.warmup(k, blocks)
+
+    def host_reconstruct(self, per_block_shares) -> bytes:
+        """The cached-weight host leg (public: benchmarks time it)."""
+        sorted_blocks, xs = _sorted_validated(per_block_shares)
+        lams = self._host_lams.get(xs)
+        if lams is None:
+            lams = _cache_put(
+                self._host_lams,
+                xs,
+                host_shamir.lagrange_coeffs_at_zero(list(xs)),
+            )
+        p = host_shamir.P
+        out = b"".join(
+            (
+                sum(lam * y for lam, (_, y) in zip(lams, shares)) % p
+            ).to_bytes(host_shamir.BLOCK_BYTES, "little")
+            for shares in sorted_blocks
+        )
+        return host_shamir.unpad_payload(out)
+
+    @staticmethod
+    def _median_time(fn, reps: int = 3):
+        out = None
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        return ts[len(ts) // 2], out
+
+    def recalibrate(self) -> None:
+        self.calibrated = False
+
+    def _calibrate(self, per_block_shares) -> bytes:
+        # The single-block overhead probe must be a decodable payload on
+        # its own: only the LAST block carries the 0x80 padding.
+        one = per_block_shares[-1:]
+        self.device.reconstruct_payload_shares(per_block_shares)  # compile
+        self.device.reconstruct_payload_shares(one)
+        t_dev_full, out_dev = self._median_time(
+            lambda: self.device.reconstruct_payload_shares(per_block_shares)
+        )
+        t_dev_one, _ = self._median_time(
+            lambda: self.device.reconstruct_payload_shares(one)
+        )
+        t_host, out_host = self._median_time(
+            lambda: self.host_reconstruct(per_block_shares)
+        )
+        if out_dev != out_host:
+            raise RuntimeError(
+                "host and device reconstruction disagree during "
+                "calibration — refusing to route on performance while "
+                "correctness differs"
+            )
+        b = len(per_block_shares)
+        host_rate = b / t_host if t_host > 0 else float("inf")
+        dev_per_block = max(t_dev_full - t_dev_one, 0.0) / max(b - 1, 1)
+        dev_rate = b / t_dev_full if t_dev_full > 0 else float("inf")
+        denom = 1.0 / host_rate - dev_per_block
+        self.crossover_blocks = (
+            int(t_dev_one / denom) + 1 if denom > 0 else 1 << 30
+        )
+        self.rates = {
+            "host_blocks_per_s": host_rate,
+            "device_blocks_per_s": dev_rate,
+            "device_overhead_s": t_dev_one,
+        }
+        self.calibrated = True
+        return out_dev
+
+    def reconstruct_payload_shares(self, per_block_shares) -> bytes:
+        per_block_shares = list(per_block_shares)
+        if not per_block_shares:
+            return b""
+        if (
+            not self.calibrated
+            and len(per_block_shares) >= self.calibrate_at
+        ):
+            return self._calibrate(per_block_shares)
+        if len(per_block_shares) >= self.crossover_blocks:
+            return self.device.reconstruct_payload_shares(per_block_shares)
+        return self.host_reconstruct(per_block_shares)

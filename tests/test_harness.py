@@ -674,6 +674,28 @@ def test_payload_commit_reconstructs_on_all_replicas():
             assert len(payload) == 62
 
 
+def test_payload_pinned_device_reconstruction():
+    # Commit payloads route to the host by default (AdaptiveReconstructor
+    # — commit batches sit far below any device launch's worth), so pin
+    # one e2e run to the device kernel to keep that path exercised end to
+    # end.
+    from hyperdrive_tpu.ops.shamir import BatchReconstructor
+
+    rec = BatchReconstructor()
+    sim = Simulation(
+        n=4, target_height=3, seed=97, payload_bytes=62, reconstructor=rec
+    )
+    res = sim.run()
+    assert res.completed, f"stalled at {res.heights}"
+    assert rec._lam_cache, "device kernel never launched"
+    host_sim = Simulation(n=4, target_height=3, seed=97, payload_bytes=62)
+    hres = host_sim.run()
+    assert hres.completed
+    assert not host_sim.reconstructor.device._lam_cache  # host-routed
+    for i in range(4):
+        assert sim.reconstructed[i] == host_sim.reconstructed[i]
+
+
 def test_payload_burst_per_replica_reconstruction():
     # No dedup: every replica reconstructs every commit itself.
     sim = Simulation(
