@@ -379,9 +379,13 @@ class Simulation:
         config 5): every proposed value carries a (2f+1)-of-n Shamir share
         bundle for a payload of that many bytes, validators accept only
         proposals whose bundle matches the value commitment, and on every
-        commit the committer reconstructs the payload from k shares on the
-        device (:class:`~hyperdrive_tpu.ops.shamir.BatchReconstructor`),
-        recording it in ``self.reconstructed[replica][height]``.
+        commit the committer reconstructs the payload from k shares via
+        the adaptive router
+        (:class:`~hyperdrive_tpu.ops.shamir.AdaptiveReconstructor` —
+        commit-sized batches ride its cached-weight host leg; pass
+        ``reconstructor=`` to pin a backend, e.g. BatchReconstructor for
+        the device kernel), recording it in
+        ``self.reconstructed[replica][height]``.
         ``dedup_reconstruct`` mirrors dedup_verify: reconstruct each
         distinct committed value once per chip (the per-replica load of a
         real deployment) instead of once per simulated replica."""

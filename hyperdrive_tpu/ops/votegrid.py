@@ -373,6 +373,28 @@ class VoteGrid:
     one chip's replica set). Call :meth:`update_and_tally` once per settle
     pass; it returns a :class:`LazyCounts` mapping of per-(replica, plane,
     slot) counts whose single host fetch is deferred to first value access.
+
+    Memory budget. The grid holds ``values [n, 2, R, V, 8] int32`` +
+    ``present [n, 2, R, V] bool`` = ``n * 2 * R * V * 33`` bytes. The
+    n × V product is a SIMULATION artifact — one process carrying every
+    replica's grid; a deployed chip hosts one replica (n = 1). At R = 4:
+
+    ====================  ==========  ============  =================
+    configuration          n = V       total bytes   per device (d=8,
+                                                     validator-sharded)
+    ====================  ==========  ============  =================
+    sim, 256 validators   256          17.3 MB       2.2 MB
+    sim, 512 validators   512          69.2 MB       8.7 MB
+    sim, 1024 validators  1024         276.8 MB      34.6 MB
+    deployment (n = 1)    V = 1024     270 KB        34 KB
+    ====================  ==========  ============  =================
+
+    Past one chip's HBM, ``mesh=`` shards the VALIDATOR axis (SURVEY §5's
+    scaling story — scatter rows route by global index, counts psum over
+    the mesh); the 512-validator sharded consensus is exercised on the
+    8-device CPU mesh in tests and benchmarked in BENCH.md config 7.
+    Compacting round slots (R) scales the budget linearly when deep
+    round-skipping windows are not needed.
     """
 
     def __init__(self, n_replicas: int, n_validators: int, r_slots: int = 8,
