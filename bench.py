@@ -6,9 +6,9 @@ in wide batches fused with the quorum tally — target >= 50k votes/sec on
 one v5e chip.
 
 Round-4 headline: the sustained UNIQUE-signature pipeline. Every timed
-launch consumes a fresh batch of 65,536 distinct signatures; the host
-packs batch k+1 while the device verifies batch k. No input reuse — this
-is the rate a deployment's mq drain loop could sustain (reference hot
+launch consumes a fresh batch of distinct signatures; the host packs
+batch k+1 while the device verifies batch k. No input reuse — this is
+the rate a deployment's mq drain loop could sustain (reference hot
 path: /root/reference/process/process.go:574-579), not a kernel ceiling
 fed by a pre-packed buffer.
 
@@ -16,11 +16,15 @@ Data path (ops/ed25519_wire.py): point decompression runs ON DEVICE; the
 host does SHA-512 challenges + range checks only. The consensus validator
 set is known, so A ships as a 4-byte index into a device-resident
 decompressed-pubkey table — 100 B/lane over the link (R 32 + s 32 + k 32
-+ idx 4). On this tunnel-attached chip (~8 MB/s H2D, BENCH.md) the
-pipeline is TRANSFER-bound, so bytes/lane — not kernel speed and not host
-speed — set the sustained rate; the full-wire (128 B/lane) rate, the
-device-only ceiling, and the host pack rate are reported alongside so the
-bottleneck is visible.
++ idx 4). On this tunnel-attached chip (~4-13 MB/s H2D across sessions,
+BENCH.md) the pipeline is TRANSFER-bound, so bytes/lane — not kernel
+speed and not host speed — set the sustained rate; the full-wire
+(128 B/lane) rate, the device-only ceiling, and the host pack rate are
+reported alongside so the bottleneck is visible.
+
+:func:`run_sustained` is the ONE harness: bench.py's 256-validator
+headline and BENCH.md config 7's 512-validator operating point both call
+it, so the methodology cannot drift between them.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
@@ -54,7 +58,6 @@ N_VALIDATORS = 256
 # 256 rounds gains flatten under 3%/doubling while launch latency
 # doubles).
 ROUNDS = 256
-BATCH = N_VALIDATORS * ROUNDS
 TARGET_VOTES_PER_SEC = 50_000.0
 
 #: Timed launches per trial. Every launch gets its own fresh signature
@@ -64,11 +67,9 @@ TARGET_VOTES_PER_SEC = 50_000.0
 ITERS = 4
 TRIALS = 3
 
-BACKEND = resolve_backend(sys.argv[1] if len(sys.argv) > 1 else None)
 
-
-def _verify_fns():
-    if BACKEND == "pallas":
+def _verify_fns(backend: str):
+    if backend == "pallas":
         from hyperdrive_tpu.ops.ed25519_pallas import (
             make_pallas_semiwire_verify_fn,
             make_pallas_wire_verify_fn,
@@ -78,45 +79,22 @@ def _verify_fns():
     return make_semiwire_verify_fn(), make_wire_verify_fn()
 
 
-_semi_verify, _full_verify = _verify_fns()
-
-
-@jax.jit
-def step(idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid,
-         vote_vals, target_vals, f):
-    ok = _semi_verify(idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid)
-    counts = tally_counts(
-        vote_vals, ok.reshape(ROUNDS, N_VALIDATORS), target_vals
-    )
-    flags = quorum_flags(counts, f)
-    return ok, counts, flags
-
-
-@jax.jit
-def step_full(a_rows, r_rows, s_rows, k_rows, vote_vals, target_vals, f):
-    ok = _full_verify(a_rows, r_rows, s_rows, k_rows)
-    counts = tally_counts(
-        vote_vals, ok.reshape(ROUNDS, N_VALIDATORS), target_vals
-    )
-    flags = quorum_flags(counts, f)
-    return ok, counts, flags
-
-
-def build_batches(ring):
-    """ITERS batches of 65,536 UNIQUE signatures: 256 validators each
-    sign one prevote per (round, iter) — every digest distinct, so no
-    dedup/caching anywhere in the pipeline can shortcut the work.
-    Signing is the signers' cost, not the verifier's: generated here,
-    untimed, through the native signer."""
+def _build_batches(ring, validators, rounds, iters, namespace: bytes):
+    """``iters`` batches of validators*rounds UNIQUE signatures: every
+    validator signs one prevote per (round, iter) — every digest
+    distinct, so no dedup/caching anywhere in the pipeline can shortcut
+    the work. Signing is the signers' cost, not the verifier's:
+    generated here, untimed, through the native signer."""
     batches = []
     tallies = []
-    for it in range(ITERS):
+    for it in range(iters):
         items = []
         values = []
-        for r in range(ROUNDS):
-            value = bytes([it, r % 256, r // 256]) + b"\x2a" * 29
+        ns_byte = bytes([sum(namespace) % 256])  # actually varies per namespace
+        for r in range(rounds):
+            value = bytes([it, r % 256, r // 256]) + ns_byte + b"\x2a" * 28
             values.append(value)
-            for v in range(N_VALIDATORS):
+            for v in range(validators):
                 pv = Prevote(
                     height=1 + it, round=r, value=value,
                     sender=ring[v].public,
@@ -126,9 +104,7 @@ def build_batches(ring):
                     (ring[v].public, digest, ring[v].sign_digest(digest))
                 )
         vote_vals = jnp.asarray(
-            np.repeat(
-                pack_values(values)[:, None, :], N_VALIDATORS, axis=1
-            )
+            np.repeat(pack_values(values)[:, None, :], validators, axis=1)
         )
         target_vals = jnp.asarray(pack_values(values))
         batches.append(items)
@@ -136,9 +112,9 @@ def build_batches(ring):
     return batches, tallies
 
 
-def _timed_trials(launch_fn):
-    """TRIALS timed pipelines of ITERS launches; returns votes/s rates.
-    The last launch's mask is materialized inside the timed region (the
+def _timed_trials(launch_fn, batch, iters, trials):
+    """Timed pipelines of ``iters`` launches; returns votes/s rates. The
+    last launch's mask is materialized inside the timed region (the
     device executes enqueued programs in order, so that transfer bounds
     the whole pipeline); np.asarray is the completion barrier —
     block_until_ready is unreliable over the axon tunnel. EVERY launch's
@@ -146,47 +122,77 @@ def _timed_trials(launch_fn):
     never cover unverified work, and the post-timing fetches cost the
     trials nothing."""
     rates = []
-    for _ in range(TRIALS):
+    for _ in range(trials):
         t0 = time.perf_counter()
-        oks = [launch_fn(k) for k in range(ITERS)]
+        oks = [launch_fn(k) for k in range(iters)]
         np.asarray(oks[-1])
         dt = time.perf_counter() - t0
         for ok in oks:
             if not bool(np.asarray(ok).all()):
                 raise RuntimeError("pipeline rejected valid signatures")
-        rates.append(BATCH * ITERS / dt)
+        rates.append(batch * iters / dt)
     return rates
 
 
-def main():
-    ring = KeyRing.deterministic(N_VALIDATORS, namespace=b"bench")
-    table = ValidatorTable([ring[v].public for v in range(N_VALIDATORS)])
+def run_sustained(validators: int = N_VALIDATORS, rounds: int = ROUNDS,
+                  iters: int = ITERS, trials: int = TRIALS,
+                  backend: str | None = None,
+                  full_wire: bool = True,
+                  namespace: bytes = b"bench") -> dict:
+    """The sustained unique-signature pipeline measurement (the shared
+    harness — see module doc). Returns the full self-describing record;
+    raises if any launch rejects a valid signature."""
+    backend = resolve_backend(backend)
+    semi_verify, full_verify = _verify_fns(backend)
+    batch = validators * rounds
+
+    @jax.jit
+    def step(idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid,
+             vote_vals, target_vals, f):
+        ok = semi_verify(idx, r_rows, s_rows, k_rows, tnax, tay, tnat,
+                         tvalid)
+        counts = tally_counts(
+            vote_vals, ok.reshape(rounds, validators), target_vals
+        )
+        flags = quorum_flags(counts, f)
+        return ok, counts, flags
+
+    @jax.jit
+    def step_full(a_rows, r_rows, s_rows, k_rows, vote_vals, target_vals,
+                  f):
+        ok = full_verify(a_rows, r_rows, s_rows, k_rows)
+        counts = tally_counts(
+            vote_vals, ok.reshape(rounds, validators), target_vals
+        )
+        flags = quorum_flags(counts, f)
+        return ok, counts, flags
+
+    ring = KeyRing.deterministic(validators, namespace=namespace)
+    table = ValidatorTable([ring[v].public for v in range(validators)])
     tbl = table.arrays()
-    host = Ed25519WireHost(buckets=(BATCH,))
-    f = jnp.int32(N_VALIDATORS // 3)
+    host = Ed25519WireHost(buckets=(batch,))
+    f = jnp.int32(validators // 3)
 
     t0 = time.perf_counter()
-    batches, tallies = build_batches(ring)
+    batches, tallies = _build_batches(
+        ring, validators, rounds, iters, namespace
+    )
     gen_s = time.perf_counter() - t0
 
     # Warmup / compile + correctness gate on batch 0 (both paths).
     rows0, prevalid0, n0 = host.pack_wire_indexed(batches[0], table)
-    assert n0 == BATCH and prevalid0.all()
+    assert n0 == batch and prevalid0.all()
     dev0 = tuple(jnp.asarray(r) for r in rows0)
     ok, counts, flags = step(*dev0, *tbl, *tallies[0], f)
     if not bool(np.asarray(ok).all()):
-        print(json.dumps({
-            "metric": "sustained votes verified/sec/chip @256 validators",
-            "value": 0.0, "unit": "votes/s", "vs_baseline": 0.0,
-            "error": "verification kernel rejected valid signatures",
-        }))
-        sys.exit(1)
+        raise RuntimeError("verification kernel rejected valid signatures")
     assert bool(np.asarray(flags["quorum_matching"]).all())
-    full0, fpv0, _ = host.pack_wire(batches[0])
-    fdev0 = tuple(jnp.asarray(r) for r in full0)
-    assert fpv0.all()
-    ok_f, _, _ = step_full(*fdev0, *tallies[0], f)
-    assert bool(np.asarray(ok_f).all())
+    if full_wire:
+        fw0, fpv0, _ = host.pack_wire(batches[0])
+        fdev0 = tuple(jnp.asarray(r) for r in fw0)
+        assert fpv0.all()
+        ok_f, _, _ = step_full(*fdev0, *tallies[0], f)
+        assert bool(np.asarray(ok_f).all())
 
     # --- Headline: sustained indexed-wire pipeline, fresh signatures
     # every launch (pack -> enqueue -> pack next while device works).
@@ -199,52 +205,75 @@ def main():
         )
         return ok
 
-    sustained = _timed_trials(launch_indexed)
+    sustained = _timed_trials(launch_indexed, batch, iters, trials)
+
+    out = {
+        "backend": backend,
+        "batch": batch,
+        "validators": validators,
+        "iters": iters,
+        "unique_signatures": True,
+        "bytes_per_lane": 100,
+        "sustained_votes_per_s": round(float(np.median(sustained)), 1),
+        "sustained_trials": [round(r, 1) for r in sustained],
+        "siggen_seconds_untimed": round(gen_s, 1),
+        "device": str(jax.devices()[0]),
+    }
 
     # --- Secondary: full-wire path (arbitrary pubkeys, 128 B/lane).
-    def launch_full(k):
-        rows, prevalid, _ = host.pack_wire(batches[k])
-        if not prevalid.all():
-            raise RuntimeError(f"batch {k}: packer rejected lanes")
-        ok, counts, flags = step_full(
-            *(jnp.asarray(r) for r in rows), *tallies[k], f
-        )
-        return ok
+    if full_wire:
+        def launch_full(k):
+            rows, prevalid, _ = host.pack_wire(batches[k])
+            if not prevalid.all():
+                raise RuntimeError(f"batch {k}: packer rejected lanes")
+            ok, counts, flags = step_full(
+                *(jnp.asarray(r) for r in rows), *tallies[k], f
+            )
+            return ok
 
-    sustained_full = _timed_trials(launch_full)
+        full_rates = _timed_trials(launch_full, batch, iters, trials)
+        out["sustained_full_wire_votes_per_s"] = round(
+            float(np.median(full_rates)), 1
+        )
+        out["full_wire_bytes_per_lane"] = 128
 
     # --- Device ceiling: same pipelining, pre-packed device-resident
     # inputs reused (no per-launch transfer).
     device_only = _timed_trials(
-        lambda k: step(*dev0, *tbl, *tallies[0], f)[0]
+        lambda k: step(*dev0, *tbl, *tallies[0], f)[0],
+        batch, iters, trials,
+    )
+    out["device_only_votes_per_s"] = round(
+        float(np.median(device_only)), 1
     )
 
     # --- Pack-only rate (the host leg in isolation).
     t0 = time.perf_counter()
-    host.pack_wire_indexed(batches[1], table)
+    host.pack_wire_indexed(batches[min(1, iters - 1)], table)
     pack_s = time.perf_counter() - t0
+    out["wire_pack_sigs_per_s"] = round(batch / pack_s, 1)
+    out["wire_pack_seconds"] = round(pack_s, 3)
+    return out
 
-    votes_per_sec = float(np.median(sustained))
+
+def main():
+    backend = sys.argv[1] if len(sys.argv) > 1 else None
+    try:
+        r = run_sustained(backend=backend)
+    except RuntimeError as e:
+        print(json.dumps({
+            "metric": "sustained votes verified/sec/chip @256 validators",
+            "value": 0.0, "unit": "votes/s", "vs_baseline": 0.0,
+            "error": str(e),
+        }))
+        sys.exit(1)
+    votes_per_sec = r.pop("sustained_votes_per_s")
     print(json.dumps({
         "metric": "sustained votes verified/sec/chip @256 validators",
-        "value": round(votes_per_sec, 1),
+        "value": votes_per_sec,
         "unit": "votes/s",
         "vs_baseline": round(votes_per_sec / TARGET_VOTES_PER_SEC, 4),
-        "backend": BACKEND,
-        "batch": BATCH,
-        "iters": ITERS,
-        "unique_signatures": True,
-        "bytes_per_lane": 100,
-        "sustained_trials": [round(r, 1) for r in sustained],
-        "sustained_full_wire_votes_per_s": round(
-            float(np.median(sustained_full)), 1
-        ),
-        "full_wire_bytes_per_lane": 128,
-        "device_only_votes_per_s": round(float(np.median(device_only)), 1),
-        "wire_pack_sigs_per_s": round(BATCH / pack_s, 1),
-        "wire_pack_seconds": round(pack_s, 3),
-        "siggen_seconds_untimed": round(gen_s, 1),
-        "device": str(jax.devices()[0]),
+        **r,
     }))
 
 

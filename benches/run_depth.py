@@ -83,13 +83,32 @@ def main() -> None:
         t_lo = height_done[lo - 1] if lo > 0 else 0.0
         return (hi - lo) / (height_done[hi - 1] - t_lo)
 
+    # Windows tile the WHOLE observed range — the last (possibly ragged)
+    # window is included, because a decay confined to the final heights
+    # is exactly what a depth probe must not silently drop.
     win = min(100, max(observed // 3, 1))
-    windows = {
-        f"h{lo + 1}-{lo + win}": round(window_rate(lo, lo + win), 3)
-        for lo in range(0, observed - win + 1, win)
-    }
+    windows = {}
+    prev_lo = 0
+    for lo in range(0, observed, win):
+        hi = min(lo + win, observed)
+        if hi - lo < max(win // 4, 1) and windows:
+            # Merge a tiny tail into the previous window's span.
+            windows.popitem()
+            windows[f"h{prev_lo + 1}-{hi}"] = round(
+                window_rate(prev_lo, hi), 3
+            )
+            break
+        windows[f"h{lo + 1}-{hi}"] = round(window_rate(lo, hi), 3)
+        prev_lo = lo
     rates = list(windows.values())
     spread = (max(rates) - min(rates)) / (sum(rates) / len(rates))
+    # Decay is DIRECTIONAL: later windows slower than earlier ones. The
+    # symmetric spread alone mislabels tunnel drift (a slow first window
+    # with a flat tail) as decay; compare the last third's median rate
+    # against the first third's.
+    third = max(len(rates) // 3, 1)
+    head = sorted(rates[:third])[third // 2]
+    tail = sorted(rates[-third:])[third // 2]
 
     out = {
         "completed": True,
@@ -100,14 +119,17 @@ def main() -> None:
         "msgs_per_s": round(res.steps / wall, 1),
         "window_rates_heights_per_s": windows,
         "window_spread_frac": round(spread, 4),
-        "height_invariant": bool(spread < 0.25),
+        "head_third_median_heights_per_s": round(head, 3),
+        "tail_third_median_heights_per_s": round(tail, 3),
+        "height_invariant": bool(tail >= 0.85 * head),
         "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
         "note": (
             "rate measured per 100-height window INSIDE one run "
-            "(record=False); the spread includes tunnel drift over the "
-            "run, so a small spread certifies height-invariance while a "
-            "large one must be read against the tunnel's known +-15% "
-            "drift before being called decay"
+            "(record=False), tail window included; height_invariant "
+            "compares the last third's median rate against the first "
+            "third's (decay is directional — the symmetric spread also "
+            "reported includes the tunnel's drift, which can make the "
+            "START of a run slow without any depth effect)"
         ),
     }
     print(json.dumps(out))
