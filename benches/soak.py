@@ -62,6 +62,8 @@ while time.time() < DEADLINE:
     # one shared instance so kernels compile once per soak process).
     batch_verifier = None
     dedup_verify = False
+    fused_min_window = 0
+    small_window_host = None
     if sign and burst and rng.random() < 0.5:
         if _DEVICE_VER is None:
             from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
@@ -69,6 +71,25 @@ while time.time() < DEADLINE:
             _DEVICE_VER = TpuBatchVerifier(buckets=(64, 256), backend="xla")
         batch_verifier = _DEVICE_VER
         dedup_verify = True
+        # Crossover settle routing: random thresholds leave a MIX of
+        # fused and host-routed settles (grid poison soundness under
+        # random faults), and occasionally force every tiny window
+        # through the device verifier.
+        if device_tally and rng.random() < 0.5:
+            fused_min_window = rng.choice([3, n, 4 * n, 10_000])
+        if rng.random() < 0.2:
+            small_window_host = False
+    # Payload draws run Shamir share bundles through commits; the
+    # adaptive reconstructor default routes them host-side — pin the
+    # device kernel on a slice so both commit paths soak.
+    payload_bytes = 0
+    reconstructor = None
+    if rng.random() < 0.15 and not byz:
+        payload_bytes = rng.choice([31, 62, 124])
+        if rng.random() < 0.3:
+            from hyperdrive_tpu.ops.shamir import BatchReconstructor
+
+            reconstructor = BatchReconstructor()
     kwargs = dict(
         n=n,
         target_height=rng.randint(3, 12),
@@ -84,6 +105,10 @@ while time.time() < DEADLINE:
         dedup_verify=dedup_verify,
         device_tally=device_tally,
         tally_check=tally_check,
+        fused_min_window=fused_min_window,
+        small_window_host=small_window_host,
+        payload_bytes=payload_bytes,
+        reconstructor=reconstructor,
     )
     try:
         sim = Simulation(**kwargs)
@@ -118,7 +143,16 @@ while time.time() < DEADLINE:
         with tempfile.TemporaryDirectory() as d:
             p = os.path.join(d, "s.dump")
             res.record.dump(p)
-            replayed = Simulation.replay(ScenarioRecord.load(p))
+            # Payload runs replay with the payload path live so the
+            # Propose.payload serde surface stays under soak.
+            replay_kwargs = (
+                dict(payload_bytes=payload_bytes, reconstructor=reconstructor)
+                if payload_bytes
+                else {}
+            )
+            replayed = Simulation.replay(
+                ScenarioRecord.load(p), **replay_kwargs
+            )
             assert replayed.commits == res.commits, (seed, "replay divergence")
     runs += 1
 
