@@ -9,6 +9,12 @@ The batchable numeric work of the consensus framework lives here:
 - :mod:`hyperdrive_tpu.ops.ed25519_pallas` — the same verification as one
   Mosaic kernel in limb-major layout (7.5x the XLA kernel on v5e;
   auto-selected on TPU backends).
+- :mod:`hyperdrive_tpu.ops.ed25519_wire` — verification straight from
+  wire bytes: point decompression (and, via the challenge path, the
+  whole signature hash) on device.
+- :mod:`hyperdrive_tpu.ops.sha512_jax` — batched single-block SHA-512
+  and canonical mod-L scalar reduction as lax.scans, for deriving
+  Ed25519 challenge scalars in-launch (68 B/lane wire format).
 - :mod:`hyperdrive_tpu.ops.tally` — masked quorum-tally reductions over
   vote tensors.
 - :mod:`hyperdrive_tpu.ops.votegrid` — device-resident vote grids: the

@@ -47,6 +47,8 @@ __all__ = [
     "make_wire_verify_fn",
     "semiwire_verify_kernel",
     "make_semiwire_verify_fn",
+    "chalwire_verify_kernel",
+    "make_chalwire_verify_fn",
     "ValidatorTable",
     "Ed25519WireHost",
     "TpuWireVerifier",
@@ -179,9 +181,16 @@ class ValidatorTable:
         ay = np.zeros_like(nax)
         nat = np.zeros_like(nax)
         valid = np.zeros(max(v, 1), dtype=bool)
+        rows = np.zeros((max(v, 1), 32), dtype=np.uint8)
         self.index: dict = {}
         for i, pub in enumerate(pubkeys):
             self.index.setdefault(pub, i)  # first wins on duplicates
+            if len(pub) == 32:
+                # Compressed encoding, resident for the device-side
+                # challenge hash k = SHA-512(R||A||M) — kept even for
+                # pubkeys that fail decompression (their lanes reject via
+                # ``valid`` regardless of what they hash to).
+                rows[i] = np.frombuffer(pub, dtype=np.uint8)
             pt = host_ed.point_decompress(pub)
             if pt is None:
                 continue
@@ -196,9 +205,15 @@ class ValidatorTable:
         self.ay = jnp.asarray(ay)
         self.nat = jnp.asarray(nat)
         self.valid = jnp.asarray(valid)
+        self.rows = jnp.asarray(rows)
 
     def arrays(self):
         return self.nax, self.ay, self.nat, self.valid
+
+    def arrays_chal(self):
+        """The :func:`chalwire_verify_kernel` argument pack: coordinate
+        tensors plus the resident compressed encodings."""
+        return self.nax, self.ay, self.nat, self.valid, self.rows
 
 
 def semiwire_verify_kernel(idx, r_rows, s_rows, k_rows,
@@ -222,6 +237,78 @@ def semiwire_verify_kernel(idx, r_rows, s_rows, k_rows,
 @functools.lru_cache(maxsize=None)
 def make_semiwire_verify_fn(jit: bool = True):
     return jax.jit(semiwire_verify_kernel) if jit else semiwire_verify_kernel
+
+
+# ------------------------------------- challenge-on-device (68 B per lane)
+
+
+def chalwire_verify_kernel(idx, r_rows, s_rows, m_rows,
+                           tnax, tay, tnat, tvalid, trows):
+    """Indexed-A wire verify with the CHALLENGE derived on device:
+    k = SHA-512(R || A || M) mod L computed in-launch
+    (:mod:`hyperdrive_tpu.ops.sha512_jax`), so the wire carries only
+    R (32 B) + s (32 B) + idx (4 B) = 68 B/lane — A's compressed encoding
+    is gathered from the resident table (``trows``, [V, 32] uint8) and
+    ``m_rows`` ([B, 32] uint8 signing digests) is per-round consensus
+    data the caller broadcasts INSIDE its jit (validators voting for the
+    same (round, value) share the digest; the sender is excluded from it
+    — reference: /root/reference/process/message.go:165-186), costing no
+    per-lane transfer. The derived k is canonical, so verdicts are
+    bit-identical to the host-packed semiwire path."""
+    from hyperdrive_tpu.ops.sha512_jax import challenge_scalar_device
+
+    a_rows = jnp.take(trows, idx, axis=0)
+    k_rows = challenge_scalar_device(r_rows, a_rows, m_rows)
+    return semiwire_verify_kernel(
+        idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def make_challenge_fn():
+    """The challenge leg as its own executable: k rows from (idx, R, M)
+    and the resident compressed-pubkey table."""
+    from hyperdrive_tpu.ops.sha512_jax import challenge_scalar_device
+
+    @jax.jit
+    def chal(idx, r_rows, m_rows, trows):
+        return challenge_scalar_device(
+            r_rows, jnp.take(trows, idx, axis=0), m_rows
+        )
+
+    return chal
+
+
+@functools.lru_cache(maxsize=None)
+def make_chalwire_verify_fn(jit: bool = True):
+    """TWO dispatches, not one: the unrolled SHA-512 fused into the
+    ladder graph sends XLA:CPU's optimizer superlinear (>12 min for a
+    batch-64 compile whose two halves compile in ~1 s + ~45 s; TPU
+    compiles the fused form fine, but the CPU test platform must stay
+    usable and two enqueued launches cost no extra sync — k never leaves
+    the device between them)."""
+    if not jit:
+        return chalwire_verify_kernel
+    chal = make_challenge_fn()
+    semi = make_semiwire_verify_fn(jit=True)
+
+    def fn(idx, r_rows, s_rows, m_rows, tnax, tay, tnat, tvalid, trows):
+        k_rows = chal(idx, r_rows, m_rows, trows)
+        return semi(idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid)
+
+    return fn
+
+
+def chalwire_verify_pallas(idx, r_rows, s_rows, m_rows,
+                           tnax, tay, tnat, tvalid, trows, **kw):
+    """Pallas-backed challenge path: the jitted XLA challenge leg, then
+    the Mosaic ladder (same two-dispatch split as the XLA path)."""
+    from hyperdrive_tpu.ops.ed25519_pallas import semiwire_verify_pallas
+
+    k_rows = make_challenge_fn()(idx, r_rows, m_rows, trows)
+    return semiwire_verify_pallas(
+        idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid, **kw
+    )
 
 
 # ------------------------------------------------------------- host packer
@@ -307,6 +394,92 @@ class Ed25519WireHost:
         idx[: len(items)] = np.maximum(lanes, 0)
         return idx, all_known
 
+    @staticmethod
+    def _rows_lt(rows: np.ndarray, bound: int, mask255: bool = False):
+        """Vectorized little-endian 256-bit compare: rows < bound, as
+        four uint64 words most-significant first. ``mask255`` clears bit
+        255 first (the field-encoding convention: the sign bit is not part
+        of y)."""
+        w = np.ascontiguousarray(rows).view(np.uint64)
+        if mask255:
+            w = w.copy()
+            w[:, 3] &= 0x7FFFFFFFFFFFFFFF
+        b = [(bound >> (64 * i)) & 0xFFFFFFFFFFFFFFFF for i in range(4)]
+        lt = np.zeros(len(rows), dtype=bool)
+        eq = np.ones(len(rows), dtype=bool)
+        for i in (3, 2, 1, 0):
+            lt |= eq & (w[:, i] < b[i])
+            eq &= w[:, i] == b[i]
+        return lt
+
+    def pack_wire_challenge(self, items, table: ValidatorTable,
+                            with_m: bool = True, _idx=None):
+        """Challenge-on-device packing: NO hashing on host — the packer
+        only range-checks and marshals, so the host leg of the sustained
+        pipeline is pure byte movement. Returns ((idx, r_rows, s_rows,
+        m_rows), prevalid, n) for :func:`chalwire_verify_kernel`; with
+        ``with_m=False`` the m slot is None (callers whose digests are
+        per-round data ship those separately — 68 B/lane on the wire).
+
+        Host work per item: length checks, canonical-y on R, s < L, and
+        the table lookup. A's canonicity is a TABLE property (invalid
+        entries reject on device via ``tvalid``). Requires every pubkey in
+        the table, like :meth:`pack_wire_indexed` — and every digest to be
+        exactly 32 bytes (the device hash has a fixed 96-byte preimage;
+        consensus digests always are — messages.py::digest — but
+        arbitrary-length digests must ride the host-hashed paths)."""
+        items = list(items)
+        n = len(items)
+        if any(len(d) != 32 for _, d, _ in items):
+            raise ValueError(
+                "pack_wire_challenge requires 32-byte digests"
+            )
+        bsz = self.bucket_for(max(n, 1))
+        r_rows = np.zeros((bsz, 32), dtype=np.uint8)
+        s_rows = np.zeros_like(r_rows)
+        m_rows = np.zeros_like(r_rows) if with_m else None
+        prevalid = np.zeros(bsz, dtype=bool)
+        if _idx is not None:
+            # Caller already ran index_lanes for routing (verify_signatures
+            # does) — don't sweep the lookup dict a second time.
+            idx = _idx
+        else:
+            idx, all_known = self.index_lanes(items, table)
+            if not all_known:
+                raise ValueError(
+                    "pack_wire_challenge requires every pubkey in the table"
+                )
+        if n == 0:
+            return (idx, r_rows, s_rows, m_rows), prevalid, n
+
+        wellformed = np.fromiter(
+            (len(sig) == 64 for _, _, sig in items), dtype=bool, count=n
+        )
+        if wellformed.all():
+            flat = np.frombuffer(
+                b"".join(sig for _, _, sig in items), dtype=np.uint8
+            ).reshape(n, 64)
+            r_rows[:n] = flat[:, :32]
+            s_rows[:n] = flat[:, 32:]
+        else:
+            for i, (_, _, sig) in enumerate(items):
+                if len(sig) != 64:
+                    continue
+                r_rows[i] = np.frombuffer(sig[:32], dtype=np.uint8)
+                s_rows[i] = np.frombuffer(sig[32:], dtype=np.uint8)
+        if with_m:
+            m_rows[:n] = np.frombuffer(
+                b"".join(d for _, d, _ in items), dtype=np.uint8
+            ).reshape(n, 32)
+        prevalid[:n] = (
+            wellformed
+            & self._rows_lt(r_rows[:n], P, mask255=True)
+            & self._rows_lt(s_rows[:n], host_ed.L)
+        )
+        # Malformed lanes carry zero rows; zero R/s happens to reject on
+        # device, but prevalid is the contract (same as pack_wire).
+        return (idx, r_rows, s_rows, m_rows), prevalid, n
+
     def pack_wire_indexed(self, items, table: ValidatorTable):
         """Indexed-A packing: like :meth:`pack_wire`, but A ships as an
         int32 index into ``table`` (4 B/lane instead of 32). Requires
@@ -344,11 +517,14 @@ class TpuWireVerifier:
         self.backend = resolve_backend(backend)
         self._fn = make_wire_verify_fn(jit=True)
         #: Optional resident validator table: chunks whose senders are all
-        #: in the table ride the indexed path (4-byte A per lane); any
-        #: unknown pubkey routes that chunk through the full wire path so
-        #: verdicts never depend on table contents.
+        #: in the table ride the CHALLENGE path — 4-byte A index per lane
+        #: and k = SHA-512(R||A||M) derived on device, so the host does no
+        #: hashing at all (same 100 B/lane as the host-hashed indexed
+        #: path: the 32-byte digest rides where k rode). Any unknown
+        #: pubkey routes that chunk through the full wire path so verdicts
+        #: never depend on table contents.
         self.table = table
-        self._semi_fn = make_semiwire_verify_fn(jit=True)
+        self._chal_fn = make_chalwire_verify_fn(jit=True)
 
     def _device_verify(self, rows):
         dev_in = [jnp.asarray(a) for a in rows]
@@ -358,16 +534,12 @@ class TpuWireVerifier:
             return wire_verify_pallas(*dev_in)
         return self._fn(*dev_in)
 
-    def _device_verify_indexed(self, rows):
+    def _device_verify_chal(self, rows):
         dev_in = [jnp.asarray(a) for a in rows]
-        tbl = self.table.arrays()
+        tbl = self.table.arrays_chal()
         if self.backend == "pallas":
-            from hyperdrive_tpu.ops.ed25519_pallas import (
-                semiwire_verify_pallas,
-            )
-
-            return semiwire_verify_pallas(*dev_in, *tbl)
-        return self._semi_fn(*dev_in, *tbl)
+            return chalwire_verify_pallas(*dev_in, *tbl)
+        return self._chal_fn(*dev_in, *tbl)
 
     def warmup(self) -> None:
         for b in self.host.buckets:
@@ -375,7 +547,7 @@ class TpuWireVerifier:
             np.asarray(self._device_verify((z, z, z, z)))
             if self.table is not None:
                 zi = jnp.zeros(b, dtype=jnp.int32)
-                np.asarray(self._device_verify_indexed((zi, z, z, z)))
+                np.asarray(self._device_verify_chal((zi, z, z, z)))
 
     def verify_signatures(self, items) -> np.ndarray:
         """items: list of (pub, digest, sig); returns bool[n]. Chunks at
@@ -389,19 +561,25 @@ class TpuWireVerifier:
         pending = []
         for lo in range(0, len(items), cap):
             chunk = items[lo : lo + cap]
-            rows, prevalid, n = self.host.pack_wire(chunk)
-            if not prevalid.any():
-                pending.append((None, prevalid, n))
-                continue
-            if self.table is not None:
+            if self.table is not None and all(
+                len(d) == 32 for _, d, _ in chunk
+            ):
                 idx, all_known = self.host.index_lanes(chunk, self.table)
                 if all_known:
+                    rows, prevalid, n = self.host.pack_wire_challenge(
+                        chunk, self.table, _idx=idx
+                    )
                     pending.append((
-                        self._device_verify_indexed((idx, *rows[1:])),
+                        self._device_verify_chal(rows)
+                        if prevalid.any() else None,
                         prevalid,
                         n,
                     ))
                     continue
+            rows, prevalid, n = self.host.pack_wire(chunk)
+            if not prevalid.any():
+                pending.append((None, prevalid, n))
+                continue
             pending.append((self._device_verify(rows), prevalid, n))
         devs = [d for d, _, _ in pending if d is not None]
         big = np.asarray(jnp.concatenate(devs)) if devs else None

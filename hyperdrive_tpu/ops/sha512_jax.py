@@ -1,0 +1,376 @@
+"""Device-side Ed25519 challenge scalars: SHA-512 + mod-L reduction in XLA.
+
+Why this exists: the sustained unique-signature pipeline is TRANSFER-bound
+(BENCH.md config 7 — 100 B/lane over a ~4-13 MB/s tunnel link sets the
+rate, not the kernel and not the host). Of those 100 bytes, 32 are the
+challenge scalar k = SHA-512(R || A || M) mod L, which the host packer
+computes per lane. But every input of that hash is already available on
+device: R ships anyway (32 B), A's compressed encoding lives in the
+resident :class:`~hyperdrive_tpu.ops.ed25519_wire.ValidatorTable`, and
+consensus digests M are shared by every validator voting for the same
+(round, value) — the sender is deliberately excluded from the signing
+digest (reference: /root/reference/process/message.go:165-186), so M is
+per-ROUND data, not per-lane data. Deriving k on device drops the wire to
+R (32) + s (32) + idx (4) = 68 B/lane and removes the SHA-512 from the
+host packing leg entirely.
+
+Contents:
+
+- a batched single-block SHA-512 (messages <= 111 bytes; the challenge
+  preimage R||A||M is exactly 96) over uint32 half-word pairs — TPUs have
+  no 64-bit integer units, so every 64-bit add/rotate is expressed as two
+  32-bit ops with explicit carries, which XLA fuses into the surrounding
+  elementwise work;
+- a base-2^13 limb reduction of the 512-bit digest to the CANONICAL
+  scalar k < L (the fe25519 limb discipline, applied mod L): two
+  delta-folds using 2^252 === -delta (mod L), then three conditional
+  subtracts. Canonical — not merely partially reduced — so the device
+  scalar is bit-identical to the host packer's
+  (:func:`hyperdrive_tpu.crypto.ed25519.challenge_scalar`), which the
+  differential tests assert, and the ladder's documented scalar < 2^253
+  precondition (ops/ed25519_jax.py::verify_kernel) holds by construction.
+
+All functions are jit-traceable and shape-polymorphic over the batch axis.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from hyperdrive_tpu.crypto import ed25519 as host_ed
+
+__all__ = [
+    "sha512_cat",
+    "sc_reduce_limbs",
+    "challenge_scalar_device",
+    "limbs13_from_bytes",
+    "bytes_from_limbs13",
+]
+
+L = host_ed.L
+_LIMB_BITS = 13
+_LIMB_MASK = (1 << _LIMB_BITS) - 1
+#: delta = L - 2^252: the fold constant (2^252 === -delta mod L). 125 bits
+#: -> 10 limbs of 13.
+_DELTA = L - (1 << 252)
+
+
+def _to_limbs13(x: int, n: int) -> list[int]:
+    return [(x >> (_LIMB_BITS * i)) & _LIMB_MASK for i in range(n)]
+
+
+_DELTA_LIMBS = _to_limbs13(_DELTA, 10)
+_L_LIMBS = np.asarray(_to_limbs13(L, 20), dtype=np.int32)
+_2L_LIMBS = np.asarray(_to_limbs13(2 * L, 20), dtype=np.int32)
+
+
+# ------------------------------------------------------------- SHA-512
+
+# FIPS 180-4 round constants (first 64 bits of the fractional parts of the
+# cube roots of the first 80 primes) and initial hash value, as
+# (hi, lo) uint32 pairs.
+_K = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F,
+    0xE9B5DBA58189DBBC, 0x3956C25BF348B538, 0x59F111F1B605D019,
+    0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118, 0xD807AA98A3030242,
+    0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235,
+    0xC19BF174CF692694, 0xE49B69C19EF14AD2, 0xEFBE4786384F25E3,
+    0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65, 0x2DE92C6F592B0275,
+    0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F,
+    0xBF597FC7BEEF0EE4, 0xC6E00BF33DA88FC2, 0xD5A79147930AA725,
+    0x06CA6351E003826F, 0x142929670A0E6E70, 0x27B70A8546D22FFC,
+    0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6,
+    0x92722C851482353B, 0xA2BFE8A14CF10364, 0xA81A664BBC423001,
+    0xC24B8B70D0F89791, 0xC76C51A30654BE30, 0xD192E819D6EF5218,
+    0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99,
+    0x34B0BCB5E19B48A8, 0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB,
+    0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3, 0x748F82EE5DEFB2FC,
+    0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915,
+    0xC67178F2E372532B, 0xCA273ECEEA26619C, 0xD186B8C721C0C207,
+    0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178, 0x06F067AA72176FBA,
+    0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC,
+    0x431D67C49C100D4C, 0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A,
+    0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+_H0 = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B,
+    0xA54FF53A5F1D36F1, 0x510E527FADE682D1, 0x9B05688C2B3E6C1F,
+    0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+
+def _split64(x: int):
+    return np.uint32(x >> 32), np.uint32(x & 0xFFFFFFFF)
+
+
+def _add64(ah, al, bh, bl):
+    lo = al + bl  # uint32 wraps
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + bh + carry, lo
+
+
+def _add64c(ah, al, k: int):
+    kh, kl = _split64(k)
+    lo = al + kl
+    carry = (lo < al).astype(jnp.uint32)
+    return ah + kh + carry, lo
+
+
+def _rotr64(h, l, n: int):  # noqa: E741 - (h, l) mirrors the 64-bit halves
+    if n == 32:
+        return l, h
+    if n < 32:
+        return ((h >> n) | (l << (32 - n)), (l >> n) | (h << (32 - n)))
+    m = n - 32
+    return ((l >> m) | (h << (32 - m)), (h >> m) | (l << (32 - m)))
+
+
+def _shr64(h, l, n: int):  # noqa: E741
+    # n < 32 everywhere below (7 and 6)
+    return h >> n, (l >> n) | (h << (32 - n))
+
+
+def _xor3(a, b, c):
+    return (a[0] ^ b[0] ^ c[0], a[1] ^ b[1] ^ c[1])
+
+
+def sha512_cat(parts) -> jnp.ndarray:
+    """Batched SHA-512 over the concatenation of ``parts`` (each
+    [B, w_i] uint8); total width <= 111 bytes so the padded message is a
+    single 1024-bit block. Returns the digest as [B, 64] uint8."""
+    data = jnp.concatenate([p.astype(jnp.uint32) for p in parts], axis=1)
+    nbytes = data.shape[1]
+    if nbytes > 111:
+        raise ValueError("single-block SHA-512 requires <= 111 bytes")
+
+    # Message words W[0..15]: data big-endian, then 0x80 padding byte,
+    # zeros, and the 128-bit bit-length field (all static for a fixed
+    # width, so padding costs nothing at runtime).
+    def byte(i):
+        if i < nbytes:
+            return data[:, i]
+        if i == nbytes:
+            return jnp.full(data.shape[:1], 0x80, dtype=jnp.uint32)
+        if i >= 120:  # length field, big-endian 128-bit = 8 * nbytes
+            shift = (127 - i) * 8
+            return jnp.full(
+                data.shape[:1], (nbytes * 8 >> shift) & 0xFF,
+                dtype=jnp.uint32,
+            )
+        return jnp.zeros(data.shape[:1], dtype=jnp.uint32)
+
+    w16_hi = []
+    w16_lo = []
+    for t in range(16):
+        b = [byte(8 * t + j) for j in range(8)]
+        w16_hi.append((b[0] << 24) | (b[1] << 16) | (b[2] << 8) | b[3])
+        w16_lo.append((b[4] << 24) | (b[5] << 16) | (b[6] << 8) | b[7])
+    win = (jnp.stack(w16_hi), jnp.stack(w16_lo))  # each [16, B]
+
+    # Both the message schedule and the compression are lax.scans, NOT
+    # unrolled Python loops: the unrolled 80-round graph sends an
+    # XLA:CPU optimizer pass superlinear (minutes-long compiles for a
+    # graph whose scanned form compiles in seconds), and the scan is the
+    # compiler-friendly shape on TPU regardless — 80 cheap elementwise
+    # steps with a 16-entry rolling window, fused by Mosaic/XLA.
+    def sched_step(win, _):
+        whi, wlo = win
+        s0 = _xor3(_rotr64(whi[1], wlo[1], 1), _rotr64(whi[1], wlo[1], 8),
+                   _shr64(whi[1], wlo[1], 7))
+        s1 = _xor3(_rotr64(whi[14], wlo[14], 19),
+                   _rotr64(whi[14], wlo[14], 61),
+                   _shr64(whi[14], wlo[14], 6))
+        acc = _add64(whi[0], wlo[0], *s0)
+        acc = _add64(*acc, whi[9], wlo[9])
+        nh, nl = _add64(*acc, *s1)
+        new_win = (
+            jnp.concatenate([whi[1:], nh[None]], axis=0),
+            jnp.concatenate([wlo[1:], nl[None]], axis=0),
+        )
+        return new_win, (nh, nl)
+
+    _, (ext_hi, ext_lo) = lax.scan(sched_step, win, None, length=64)
+    w_hi = jnp.concatenate([win[0], ext_hi], axis=0)  # [80, B]
+    w_lo = jnp.concatenate([win[1], ext_lo], axis=0)
+
+    k_hi = jnp.asarray([k >> 32 for k in _K], dtype=jnp.uint32)
+    k_lo = jnp.asarray([k & 0xFFFFFFFF for k in _K], dtype=jnp.uint32)
+
+    def comp_step(state, xs):
+        (a, b, c, d, e, f, g, h) = state
+        khi, klo, whi, wlo = xs
+        S1 = _xor3(_rotr64(*e, 14), _rotr64(*e, 18), _rotr64(*e, 41))
+        ch = ((e[0] & f[0]) ^ (~e[0] & g[0]),
+              (e[1] & f[1]) ^ (~e[1] & g[1]))
+        t1 = _add64(*h, *S1)
+        t1 = _add64(*t1, *ch)
+        t1 = _add64(*t1, khi, klo)
+        t1 = _add64(*t1, whi, wlo)
+        S0 = _xor3(_rotr64(*a, 28), _rotr64(*a, 34), _rotr64(*a, 39))
+        maj = ((a[0] & b[0]) ^ (a[0] & c[0]) ^ (b[0] & c[0]),
+               (a[1] & b[1]) ^ (a[1] & c[1]) ^ (b[1] & c[1]))
+        t2 = _add64(*S0, *maj)
+        return ((_add64(*t1, *t2), a, b, c, _add64(*d, *t1), e, f, g),
+                None)
+
+    init = tuple(
+        (jnp.full(data.shape[:1], v >> 32, dtype=jnp.uint32),
+         jnp.full(data.shape[:1], v & 0xFFFFFFFF, dtype=jnp.uint32))
+        for v in _H0
+    )
+    state, _ = lax.scan(comp_step, init, (k_hi, k_lo, w_hi, w_lo))
+
+    out = []
+    for init_v, word in zip(_H0, state):
+        hi, lo = _add64c(*word, init_v)
+        for half in (hi, lo):
+            out.extend(
+                ((half >> s) & 0xFF) for s in (24, 16, 8, 0)
+            )
+    return jnp.stack(out, axis=1).astype(jnp.uint8)
+
+
+# ------------------------------------------------- base-2^13 scalar limbs
+
+
+def limbs13_from_bytes(rows: jnp.ndarray, n_limbs: int) -> jnp.ndarray:
+    """[B, W] uint8 little-endian -> [B, n_limbs] int32 13-bit limbs.
+    The generalization of ed25519_wire.limbs_from_rows to any width, with
+    no bit-255 masking (callers reduce, they don't interpret mod p)."""
+    b = rows.astype(jnp.int32)
+    width = rows.shape[1]
+    limbs = []
+    for i in range(n_limbs):
+        bit = _LIMB_BITS * i
+        byte, off = bit >> 3, bit & 7
+        v = b[:, byte]
+        if byte + 1 < width:
+            v = v | (b[:, byte + 1] << 8)
+        if byte + 2 < width:
+            v = v | (b[:, byte + 2] << 16)
+        limbs.append((v >> off) & _LIMB_MASK)
+    return jnp.stack(limbs, axis=-1)
+
+
+def bytes_from_limbs13(limbs: jnp.ndarray, n_bytes: int = 32) -> jnp.ndarray:
+    """[B, n] int32 13-bit limbs -> [B, n_bytes] uint8 little-endian."""
+    n = limbs.shape[-1]
+    out = []
+    for i in range(n_bytes):
+        bit = 8 * i
+        li, off = bit // _LIMB_BITS, bit % _LIMB_BITS
+        v = limbs[:, li] >> off
+        if off > _LIMB_BITS - 8 and li + 1 < n:
+            v = v | (limbs[:, li + 1] << (_LIMB_BITS - off))
+        out.append(v & 0xFF)
+    return jnp.stack(out, axis=1).astype(jnp.uint8)
+
+
+def _mul_const(x: jnp.ndarray, const: list[int]) -> jnp.ndarray:
+    """Schoolbook [B, n] limbs x m-limb constant -> [B, n+m-1] raw column
+    sums (no carries). Bound: each product < 2^26, <= min(n, m) <= 10
+    terms per column -> columns < 2^30, comfortably int32."""
+    n, m = x.shape[-1], len(const)
+    cols = [None] * (n + m - 1)
+    for j, cj in enumerate(const):
+        if cj == 0:
+            continue
+        for i in range(n):
+            t = x[:, i] * cj
+            k = i + j
+            cols[k] = t if cols[k] is None else cols[k] + t
+    zero = jnp.zeros_like(x[:, 0])
+    return jnp.stack([zero if c is None else c for c in cols], axis=-1)
+
+
+def _carry(cols: jnp.ndarray, n_out: int) -> jnp.ndarray:
+    """Sequential signed carry propagation into ``n_out`` 13-bit limbs.
+    Arithmetic >> floor-divides, so negative columns borrow correctly;
+    the caller guarantees the total value fits n_out limbs and is
+    nonnegative, making the final carry-out zero."""
+    out = []
+    carry = jnp.zeros_like(cols[:, 0])
+    n = cols.shape[-1]
+    for i in range(n_out):
+        v = (cols[:, i] if i < n else jnp.zeros_like(carry)) + carry
+        out.append(v & _LIMB_MASK)
+        carry = v >> _LIMB_BITS
+    return jnp.stack(out, axis=-1)
+
+
+def _split252(limbs: jnp.ndarray, n_high: int):
+    """Split value = low + 2^252 * high on limb tensors. Bit 252 sits at
+    limb 19, offset 5 (19*13 = 247), so the split is elementwise shifts.
+    Returns (low [B, 20] < 2^252, high [B, n_high])."""
+    n = limbs.shape[-1]
+    zero = jnp.zeros_like(limbs[:, 0])
+
+    def limb(i):
+        return limbs[:, i] if i < n else zero
+
+    low = jnp.concatenate(
+        [limbs[:, :19], (limb(19) & 0x1F)[:, None]], axis=-1
+    )
+    high = [
+        ((limb(19 + j) >> 5) | ((limb(20 + j) & 0x1F) << 8)) & _LIMB_MASK
+        for j in range(n_high)
+    ]
+    return low, jnp.stack(high, axis=-1)
+
+
+def _cond_sub(limbs: jnp.ndarray, const: np.ndarray) -> jnp.ndarray:
+    """One vectorized conditional subtract: limbs - const if that does not
+    underflow, else limbs unchanged."""
+    c = jnp.asarray(const, dtype=jnp.int32)
+    out = []
+    borrow = jnp.zeros_like(limbs[:, 0])
+    for i in range(limbs.shape[-1]):
+        v = limbs[:, i] - c[i] - borrow
+        out.append(v & _LIMB_MASK)
+        borrow = -(v >> _LIMB_BITS)  # v >= -2^13, so >>13 is -1 or 0
+    sub = jnp.stack(out, axis=-1)
+    keep = (borrow == 1)[:, None]
+    return jnp.where(keep, limbs, sub)
+
+
+def sc_reduce_limbs(h_limbs: jnp.ndarray) -> jnp.ndarray:
+    """[B, 40] 13-bit limbs of a 512-bit value -> [B, 20] limbs of the
+    CANONICAL residue mod L.
+
+    Two folds of 2^252 === -delta (each fold shrinks the value:
+    2^512 -> delta*2^260 < 2^385 -> delta*2^133 < 2^258 -> delta*2^6 <
+    2^131), recombined as a - c_low + d_low - e + 2L (nonnegative: the
+    subtracted terms total < 2^252 + 2^131 < 2L; below 2^254.1: the added
+    terms total < 2^252 + 2^252 + 2L), then conditional subtracts of
+    [2L, L, L] (value < 4.2 L) land in [0, L)."""
+    a, b = _split252(h_limbs, 21)  # h = a + 2^252 b,  b < 2^260
+    c = _carry(_mul_const(b, _DELTA_LIMBS), 31)  # delta*b < 2^385
+    c_low, c_high = _split252(c, 12)  # c_high < 2^133
+    d = _carry(_mul_const(c_high, _DELTA_LIMBS), 22)  # delta*c_high < 2^258
+    d_low, d_high = _split252(d, 3)  # d_high < 2^6
+    e = _carry(_mul_const(d_high, _DELTA_LIMBS), 20)  # delta*d_high < 2^131
+
+    two_l = jnp.asarray(_2L_LIMBS, dtype=jnp.int32)
+    k = _carry(a - c_low + d_low - e + two_l[None, :], 20)
+    k = _cond_sub(k, _2L_LIMBS)
+    k = _cond_sub(k, _L_LIMBS)
+    k = _cond_sub(k, _L_LIMBS)
+    return k
+
+
+def challenge_scalar_device(r_rows, a_rows, m_rows) -> jnp.ndarray:
+    """k = SHA-512(R || A || M) mod L, entirely on device. Inputs are
+    [B, 32] uint8 wire encodings; returns [B, 32] uint8 little-endian
+    canonical k — bit-identical to the host packer's
+    (crypto/ed25519.py::challenge_scalar), by the differential tests."""
+    digest = sha512_cat((r_rows, a_rows, m_rows))
+    return bytes_from_limbs13(sc_reduce_limbs(limbs13_from_bytes(digest, 40)))

