@@ -49,10 +49,10 @@ from hyperdrive_tpu.ops.ed25519_pallas import resolve_backend
 from hyperdrive_tpu.ops.ed25519_wire import (
     Ed25519WireHost,
     ValidatorTable,
+    make_challenge_round_fn,
     make_semiwire_verify_fn,
     make_wire_verify_fn,
 )
-from hyperdrive_tpu.ops.sha512_jax import challenge_scalar_device
 from hyperdrive_tpu.ops.tally import pack_values, tally_counts, quorum_flags
 
 N_VALIDATORS = 256
@@ -165,18 +165,12 @@ def run_sustained(validators: int = N_VALIDATORS, rounds: int = ROUNDS,
         flags = quorum_flags(counts, f)
         return ok, counts, flags
 
-    @jax.jit
-    def chal_leg(idx, r_rows, m_round, trows):
-        # 68 B/lane challenge leg: digests broadcast round->lanes on
-        # device, A gathered from the resident table, k = SHA-512(R||A||M)
-        # mod L in-launch (ops/sha512_jax.py). A separate executable from
-        # the ladder — fusing the unrolled hash into the ladder graph
-        # sends XLA:CPU's optimizer superlinear (see
-        # ed25519_wire.make_chalwire_verify_fn); k stays device-resident
-        # between the two enqueued launches, so the split costs nothing.
-        m_rows = jnp.repeat(m_round, validators, axis=0)
-        a_rows = jnp.take(trows, idx, axis=0)
-        return challenge_scalar_device(r_rows, a_rows, m_rows)
+    # 68 B/lane challenge leg: digests broadcast round->lanes on device,
+    # A gathered from the resident table, k = SHA-512(R||A||M) mod L
+    # in-launch (ops/sha512_jax.py). A separate executable from the
+    # ladder (see ed25519_wire.make_chalwire_verify_fn for why); k stays
+    # device-resident between the two enqueued launches.
+    chal_leg = make_challenge_round_fn(validators)
 
     def step_chal(idx, r_rows, s_rows, m_round, tnax, tay, tnat, tvalid,
                   trows, vote_vals, target_vals, f):
