@@ -19,17 +19,43 @@ import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The container's sitecustomize force-registers the TPU plugin, so the env
+# var alone doesn't stick — pin the platform through jax.config (same as
+# tests/conftest.py), and reuse the persistent compile cache so the device
+# verifier draws don't pay the ladder compile on every soak process.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get(
+        "HD_JAX_CACHE",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".jax_cache"),
+    ),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
 from hyperdrive_tpu.harness import Simulation  # noqa: E402
 
 DEADLINE = time.time() + (float(sys.argv[1]) if len(sys.argv) > 1 else 1200.0)
 master = random.Random(os.getpid() ^ int(time.time()))
+
+N_CHOICES = [4, 5, 7, 10, 16]
+#: Validator-table slots for the challenge-path draws: padding every
+#: scenario's table to the largest replica count keeps the chalwire
+#: kernel shapes identical across scenarios (one ladder compile per
+#: bucket for the whole soak). Derived, so growing N_CHOICES cannot
+#: silently stop the padding.
+PAD_SLOTS = max(N_CHOICES)
 
 runs = 0
 _DEVICE_VER = None
 while time.time() < DEADLINE:
     seed = master.randrange(1 << 30)
     rng = random.Random(seed)
-    n = rng.choice([4, 5, 7, 10, 16])
+    n = rng.choice(N_CHOICES)
     f = (n - 1) // 3
     kills = {}
     if rng.random() < 0.3 and f:
@@ -65,11 +91,33 @@ while time.time() < DEADLINE:
     fused_min_window = 0
     small_window_host = None
     if sign and burst and rng.random() < 0.5:
-        if _DEVICE_VER is None:
-            from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+        if rng.random() < 0.3:
+            # Challenge-path draw: the wire verifier with the scenario's
+            # validator set resident (every settle window rides the
+            # chalwire kernels — device SHA-512 + mod-L + ladder). The
+            # table is PADDED to PAD_SLOTS so kernel shapes are stable
+            # across scenarios and the ladder compiles once per bucket
+            # for the whole soak (pad slots are never indexed).
+            from hyperdrive_tpu.crypto.keys import KeyRing
+            from hyperdrive_tpu.ops.ed25519_wire import (
+                TpuWireVerifier,
+                ValidatorTable,
+            )
 
-            _DEVICE_VER = TpuBatchVerifier(buckets=(64, 256), backend="xla")
-        batch_verifier = _DEVICE_VER
+            ring = KeyRing.deterministic(n, namespace=b"sim-%d" % seed)
+            pubs = [ring[i].public for i in range(n)]
+            table = ValidatorTable(pubs + [bytes(32)] * (PAD_SLOTS - n))
+            batch_verifier = TpuWireVerifier(
+                buckets=(64, 256), table=table, backend="xla"
+            )
+        else:
+            if _DEVICE_VER is None:
+                from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+                _DEVICE_VER = TpuBatchVerifier(
+                    buckets=(64, 256), backend="xla"
+                )
+            batch_verifier = _DEVICE_VER
         dedup_verify = True
         # Crossover settle routing: random thresholds leave a MIX of
         # fused and host-routed settles (grid poison soundness under
