@@ -90,6 +90,7 @@ while time.time() < DEADLINE:
     dedup_verify = False
     fused_min_window = 0
     small_window_host = None
+    chal_table_pubs = None
     if sign and burst and rng.random() < 0.5:
         if rng.random() < 0.3:
             # Challenge-path draw: the wire verifier with the scenario's
@@ -110,6 +111,7 @@ while time.time() < DEADLINE:
             batch_verifier = TpuWireVerifier(
                 buckets=(64, 256), table=table, backend="xla"
             )
+            chal_table_pubs = pubs  # checked against sim.ring below
         else:
             if _DEVICE_VER is None:
                 from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
@@ -160,6 +162,15 @@ while time.time() < DEADLINE:
     )
     try:
         sim = Simulation(**kwargs)
+        if chal_table_pubs is not None:
+            # The chal draw rebuilds the sim's keyring from the shared
+            # namespace convention (harness/sim.py derivation). If that
+            # convention ever drifts, the verifier would silently route
+            # every chunk through the full wire path and the chalwire
+            # coverage this draw exists for would vanish — fail loudly
+            # instead.
+            assert [sim.ring[i].public for i in range(n)] == \
+                chal_table_pubs, "soak table no longer matches sim ring"
         res = sim.run(max_steps=400_000)
         res.assert_safety()  # safety must hold, completed or stalled
         # Shared-superstep differential: when the fast path was eligible,

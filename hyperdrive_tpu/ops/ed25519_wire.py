@@ -217,11 +217,15 @@ class ValidatorTable:
 
 
 def semiwire_verify_kernel(idx, r_rows, s_rows, k_rows,
-                           tnax, tay, tnat, tvalid):
+                           tnax, tay, tnat, tvalid, *,
+                           kernel=verify_kernel):
     """Indexed-A wire verify: gather the pre-decompressed, pre-negated A
     coordinates from the resident validator table ([V, 20] each), then
     decompress R on device and run the ladder. ``idx``: [B] int32 into
-    the table (prevalid lanes only — the packer rejects unknown pubs)."""
+    the table (prevalid lanes only — the packer rejects unknown pubs).
+    ``kernel``: the ladder implementation (the XLA verify_kernel by
+    default; the sharded mesh step passes its mesh-resolved pick) — one
+    definition of the gather/decompress/mask rule for every path."""
     nax = jnp.take(tnax, idx, axis=0)
     ay = jnp.take(tay, idx, axis=0)
     nat = jnp.take(tnat, idx, axis=0)
@@ -230,7 +234,7 @@ def semiwire_verify_kernel(idx, r_rows, s_rows, k_rows,
     rx, ok_r = decompress_device(ry, r_sign)
     s_nib = nibbles_from_rows(s_rows)
     k_nib = nibbles_from_rows(k_rows)
-    ok = verify_kernel(nax, ay, nat, rx, ry, s_nib, k_nib)
+    ok = kernel(nax, ay, nat, rx, ry, s_nib, k_nib)
     return ok & ok_r & ok_t
 
 

@@ -11,8 +11,10 @@ reference assumes an external network.
 
 from hyperdrive_tpu.parallel.mesh import (
     grid_pack,
+    grid_pack_wire,
     make_mesh,
     make_sharded_step,
+    sharded_chalwire_tally,
     sharded_verify_tally,
 )
 from hyperdrive_tpu.parallel.multihost import (
@@ -24,8 +26,10 @@ from hyperdrive_tpu.parallel.multihost import (
 
 __all__ = [
     "grid_pack",
+    "grid_pack_wire",
     "make_mesh",
     "make_sharded_step",
+    "sharded_chalwire_tally",
     "sharded_verify_tally",
     "global_window_from_local",
     "init_distributed",

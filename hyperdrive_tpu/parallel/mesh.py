@@ -34,7 +34,14 @@ from hyperdrive_tpu.ops import fe25519 as fe
 from hyperdrive_tpu.ops import tally as tally_ops
 from hyperdrive_tpu.ops.ed25519_jax import verify_kernel
 
-__all__ = ["make_mesh", "sharded_verify_tally", "make_sharded_step", "grid_pack"]
+__all__ = [
+    "make_mesh",
+    "sharded_verify_tally",
+    "sharded_chalwire_tally",
+    "make_sharded_step",
+    "grid_pack",
+    "grid_pack_wire",
+]
 
 
 def make_mesh(devices=None, hr: int = 1, val: int | None = None) -> Mesh:
@@ -75,6 +82,15 @@ def _pick_kernel(backend: str | None, mesh: Mesh):
     return verify_kernel
 
 
+def _tally_psum(ok, vote_vals, target_vals, f):
+    """Local masked tallies, then one collective over the validator
+    axis — the one definition every sharded step's tail shares."""
+    counts = tally_ops.tally_counts(vote_vals, ok, target_vals)
+    counts = {k: lax.psum(v, axis_name="val") for k, v in counts.items()}
+    flags = tally_ops.quorum_flags(counts, f)
+    return counts, flags, ok
+
+
 def _local_step(ax, ay, at, rx, ry, s_nib, k_nib, vote_vals, target_vals, f,
                 *, kernel=verify_kernel):
     """Per-shard work: verify local signatures, tally locally, psum.
@@ -91,12 +107,7 @@ def _local_step(ax, ay, at, rx, ry, s_nib, k_nib, vote_vals, target_vals, f,
         flat(ax), flat(ay), flat(at), flat(rx), flat(ry),
         flat(s_nib), flat(k_nib),
     ).reshape(r_l, v_l)
-
-    # Local masked tallies, then one collective over the validator axis.
-    counts = tally_ops.tally_counts(vote_vals, ok, target_vals)
-    counts = {k: lax.psum(v, axis_name="val") for k, v in counts.items()}
-    flags = tally_ops.quorum_flags(counts, f)
-    return counts, flags, ok
+    return _tally_psum(ok, vote_vals, target_vals, f)
 
 
 def sharded_verify_tally(mesh: Mesh, backend: str | None = None):
@@ -141,6 +152,94 @@ def sharded_verify_tally(mesh: Mesh, backend: str | None = None):
     return jax.jit(shard_fn)
 
 
+def sharded_chalwire_tally(mesh: Mesh, backend: str | None = None):
+    """The round-4 wire format, multi-chip: the 68 B/lane challenge-on-
+    device pipeline sharded over ('hr', 'val').
+
+    Lanes land sharded by (round, validator); the validator table
+    (decompressed coords + compressed encodings, ~73 KB at 256
+    validators) is REPLICATED — it is consensus configuration, not data.
+    Each shard gathers its pubkeys by global index, derives
+    k = SHA-512(R||A||M) mod L locally (per-round digests broadcast to
+    the shard's lanes — zero per-lane transfer), decompresses R, runs
+    the ladder, tallies locally, and one psum over 'val' combines the
+    quorum counts. Two sharded executables with k staying device-
+    resident and sharded between them — the same hash/ladder split as
+    the single-chip path (see ed25519_wire.make_chalwire_verify_fn for
+    why they must not fuse).
+
+    Input global shapes: idx [R, V] int32, r_rows/s_rows [R, V, 32]
+    uint8 sharded (hr, val); m_round [R, 32] uint8 sharded (hr,); the
+    five ValidatorTable.arrays_chal() tensors replicated; vote_vals
+    [R, V, 8] (hr, val); target_vals [R, 8] (hr,); f replicated.
+    Outputs match :func:`sharded_verify_tally`.
+    """
+    from hyperdrive_tpu.ops.ed25519_wire import semiwire_verify_kernel
+    from hyperdrive_tpu.ops.sha512_jax import challenge_scalar_device
+
+    spec_rv = P("hr", "val")
+    spec_r = P("hr")
+    kernel = _pick_kernel(backend, mesh)
+
+    def chal_local(idx, r_rows, m_round, trows):
+        r_l, v_l = idx.shape
+        rr = r_rows.reshape(r_l * v_l, 32)
+        m = jnp.repeat(m_round, v_l, axis=0)
+        a_rows = jnp.take(trows, idx.reshape(-1), axis=0)
+        k = challenge_scalar_device(rr, a_rows, m)
+        return k.reshape(r_l, v_l, 32)
+
+    chal_fn = jax.jit(jax.shard_map(
+        chal_local,
+        mesh=mesh,
+        in_specs=(spec_rv, spec_rv, spec_r, P()),
+        out_specs=spec_rv,
+        check_vma=False,
+    ))
+
+    def ladder_local(idx, r_rows, s_rows, k_rows, tnax, tay, tnat, tvalid,
+                     vote_vals, target_vals, f):
+        r_l, v_l = idx.shape
+        ok = semiwire_verify_kernel(
+            idx.reshape(-1),
+            r_rows.reshape(r_l * v_l, 32),
+            s_rows.reshape(r_l * v_l, 32),
+            k_rows.reshape(r_l * v_l, 32),
+            tnax, tay, tnat, tvalid,
+            kernel=kernel,
+        ).reshape(r_l, v_l)
+        return _tally_psum(ok, vote_vals, target_vals, f)
+
+    ladder_fn = jax.jit(jax.shard_map(
+        ladder_local,
+        mesh=mesh,
+        in_specs=(
+            spec_rv, spec_rv, spec_rv, spec_rv,  # idx, r, s, k
+            P(), P(), P(), P(),  # table coords + valid (replicated)
+            spec_rv, spec_r, P(),  # votes, targets, f
+        ),
+        out_specs=(
+            {"matching": spec_r, "nil": spec_r, "total": spec_r},
+            {
+                "quorum_matching": spec_r,
+                "quorum_nil": spec_r,
+                "quorum_any": spec_r,
+                "skip_eligible": spec_r,
+            },
+            spec_rv,
+        ),
+        check_vma=False,
+    ))
+
+    def step(idx, r_rows, s_rows, m_round, tnax, tay, tnat, tvalid, trows,
+             vote_vals, target_vals, f):
+        k_rows = chal_fn(idx, r_rows, m_round, trows)
+        return ladder_fn(idx, r_rows, s_rows, k_rows, tnax, tay, tnat,
+                         tvalid, vote_vals, target_vals, f)
+
+    return step
+
+
 def grid_pack(ring, rounds: int, validators: int, values, corrupt=()):
     """Sign one vote per (round, validator) and pack to [R, V, ...] arrays
     ready for :func:`sharded_verify_tally`.
@@ -171,6 +270,48 @@ def grid_pack(ring, rounds: int, validators: int, values, corrupt=()):
         jnp.asarray(a).reshape(rounds, validators, *a.shape[1:]) for a in arrays
     )
     return shaped, prevalid.reshape(rounds, validators)
+
+
+def grid_pack_wire(ring, rounds: int, validators: int, values, corrupt=()):
+    """Sign one vote per (round, validator) and marshal to the sharded
+    CHALLENGE wire format for :func:`sharded_chalwire_tally`.
+
+    ``values``: one 32-byte value per round; the signing digest is the
+    32-byte ``bytes([r]) + values[r][1:]`` (distinct per round, shared by
+    the round's validators — the consensus digest shape). ``corrupt``:
+    (r, v) pairs whose signature scalar gets one bit flipped (still
+    parses; rejection exercises the device kernels). Returns
+    ((idx [R,V], r_rows [R,V,32], s_rows [R,V,32], m_round [R,32]),
+    table, prevalid [R,V])."""
+    from hyperdrive_tpu.crypto import ed25519 as host_ed
+    from hyperdrive_tpu.ops.ed25519_wire import (
+        Ed25519WireHost,
+        ValidatorTable,
+    )
+
+    table = ValidatorTable([ring[v].public for v in range(validators)])
+    host = Ed25519WireHost(buckets=(rounds * validators,))
+    m_round = np.zeros((rounds, 32), dtype=np.uint8)
+    items = []
+    for r in range(rounds):
+        digest = bytes([r]) + values[r][1:]
+        m_round[r] = np.frombuffer(digest, dtype=np.uint8)
+        for v in range(validators):
+            sig = host_ed.sign(ring[v].seed, digest)
+            if (r, v) in corrupt:
+                sig = sig[:40] + bytes([sig[40] ^ 1]) + sig[41:]
+            items.append((ring[v].public, digest, sig))
+    (idx, r_rows, s_rows, _), prevalid, n = host.pack_wire_challenge(
+        items, table, with_m=False
+    )
+    assert n == rounds * validators
+    shaped = (
+        jnp.asarray(idx.reshape(rounds, validators)),
+        jnp.asarray(r_rows.reshape(rounds, validators, 32)),
+        jnp.asarray(s_rows.reshape(rounds, validators, 32)),
+        jnp.asarray(m_round),
+    )
+    return shaped, table, prevalid.reshape(rounds, validators)
 
 
 def make_sharded_step(mesh: Mesh):
