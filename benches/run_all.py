@@ -781,6 +781,30 @@ def config_7() -> dict:
         namespace=b"bench7",
     )
 
+    # (a') a 1024-validator probe through the same harness: the wire
+    # cost per lane is validator-count-invariant (the table is resident;
+    # idx stays 4 bytes), so the sustained rate should hold as the set
+    # doubles again — this records that it does. Shorter (2 launches x 2
+    # trials): it is a scale point, not the headline.
+    probe_1024 = run_sustained(
+        validators=1024, rounds=64, iters=2, trials=2, full_wire=False,
+        namespace=b"bench7x1024",
+    )
+    pipe["sustained_1024v_votes_per_s"] = probe_1024["sustained_votes_per_s"]
+    pipe["sustained_1024v_trials"] = probe_1024["sustained_trials"]
+    # Measured from a live table (coords + encodings + valid mask), not
+    # hand-derived — layout changes keep the artifact true.
+    from hyperdrive_tpu.crypto.keys import KeyRing as _KR
+    from hyperdrive_tpu.ops.ed25519_wire import ValidatorTable as _VT
+
+    _ring1k = _KR.deterministic(1024, namespace=b"bench7x1024")
+    pipe["table_bytes_1024v"] = int(sum(
+        np.asarray(a).nbytes
+        for a in _VT(
+            [_ring1k[v].public for v in range(1024)]
+        ).arrays_chal()
+    ))
+
     # (b) paired e2e at n=512: dedup vs crossover-routed device tally.
     from hyperdrive_tpu.crypto.keys import KeyRing
     from hyperdrive_tpu.messages import Prevote

@@ -283,30 +283,34 @@ def make_challenge_fn():
     return chal
 
 
+def challenge_from_round(idx, r_rows, m_round, trows, lanes_per_round: int):
+    """Traceable core of the 68 B/lane challenge leg: per-ROUND digests
+    broadcast to round-major lanes (lane = round * lanes_per_round +
+    validator — the dense consensus grid order) on device, A gathered by
+    index, k derived in-launch. Lanes beyond rounds*lanes_per_round
+    (bucket padding) hash a zero digest and are masked by the caller's
+    prevalid. The ONE definition of the round->lane rule: the jitted
+    single-chip wrapper below and the sharded mesh step
+    (parallel/mesh.py::sharded_chalwire_tally) both call it."""
+    from hyperdrive_tpu.ops.sha512_jax import challenge_scalar_device
+
+    m = jnp.repeat(m_round, lanes_per_round, axis=0)
+    pad = idx.shape[0] - m.shape[0]
+    if pad:
+        m = jnp.concatenate([m, jnp.zeros((pad, 32), dtype=jnp.uint8)])
+    return challenge_scalar_device(
+        r_rows, jnp.take(trows, idx, axis=0), m
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def make_challenge_round_fn(validators: int):
-    """The 68 B/lane deployment leg: challenge scalars from PER-ROUND
-    digests — ``m_round`` is [rounds, 32] and lanes are round-major
-    (lane = round * validators + validator), the dense consensus grid
-    order. The broadcast happens on device, so per-lane wire traffic is
-    R + s + idx only; lanes beyond rounds*validators (bucket padding)
-    hash a zero digest and are masked by the caller's prevalid. One
-    cached executable per validator count — bench.py's sustained
-    headline and the tests share it, so the benchmarked shape has one
-    implementation."""
-    from hyperdrive_tpu.ops.sha512_jax import challenge_scalar_device
+    """Cached jitted :func:`challenge_from_round` at a fixed validator
+    count — bench.py's sustained headline and the tests share it."""
 
     @jax.jit
     def chal(idx, r_rows, m_round, trows):
-        m = jnp.repeat(m_round, validators, axis=0)
-        pad = idx.shape[0] - m.shape[0]
-        if pad:
-            m = jnp.concatenate(
-                [m, jnp.zeros((pad, 32), dtype=jnp.uint8)]
-            )
-        return challenge_scalar_device(
-            r_rows, jnp.take(trows, idx, axis=0), m
-        )
+        return challenge_from_round(idx, r_rows, m_round, trows, validators)
 
     return chal
 

@@ -174,8 +174,10 @@ def sharded_chalwire_tally(mesh: Mesh, backend: str | None = None):
     [R, V, 8] (hr, val); target_vals [R, 8] (hr,); f replicated.
     Outputs match :func:`sharded_verify_tally`.
     """
-    from hyperdrive_tpu.ops.ed25519_wire import semiwire_verify_kernel
-    from hyperdrive_tpu.ops.sha512_jax import challenge_scalar_device
+    from hyperdrive_tpu.ops.ed25519_wire import (
+        challenge_from_round,
+        semiwire_verify_kernel,
+    )
 
     spec_rv = P("hr", "val")
     spec_r = P("hr")
@@ -183,10 +185,10 @@ def sharded_chalwire_tally(mesh: Mesh, backend: str | None = None):
 
     def chal_local(idx, r_rows, m_round, trows):
         r_l, v_l = idx.shape
-        rr = r_rows.reshape(r_l * v_l, 32)
-        m = jnp.repeat(m_round, v_l, axis=0)
-        a_rows = jnp.take(trows, idx.reshape(-1), axis=0)
-        k = challenge_scalar_device(rr, a_rows, m)
+        k = challenge_from_round(
+            idx.reshape(-1), r_rows.reshape(r_l * v_l, 32), m_round,
+            trows, v_l,
+        )
         return k.reshape(r_l, v_l, 32)
 
     chal_fn = jax.jit(jax.shard_map(
