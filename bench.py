@@ -255,6 +255,11 @@ def run_sustained(validators: int = N_VALIDATORS, rounds: int = ROUNDS,
         "sustained_trials": [round(r, 1) for r in sustained],
         "siggen_seconds_untimed": round(gen_s, 1),
         "device": str(jax.devices()[0]),
+        # Resident-table footprint, summed from the live arrays so layout
+        # changes keep the record true.
+        "table_bytes": int(sum(
+            np.asarray(a).nbytes for a in table.arrays_chal()
+        )),
     }
 
     # --- Secondary: host-hashed indexed path (k packed on host,

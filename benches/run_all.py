@@ -792,18 +792,9 @@ def config_7() -> dict:
     )
     pipe["sustained_1024v_votes_per_s"] = probe_1024["sustained_votes_per_s"]
     pipe["sustained_1024v_trials"] = probe_1024["sustained_trials"]
-    # Measured from a live table (coords + encodings + valid mask), not
-    # hand-derived — layout changes keep the artifact true.
-    from hyperdrive_tpu.crypto.keys import KeyRing as _KR
-    from hyperdrive_tpu.ops.ed25519_wire import ValidatorTable as _VT
-
-    _ring1k = _KR.deterministic(1024, namespace=b"bench7x1024")
-    pipe["table_bytes_1024v"] = int(sum(
-        np.asarray(a).nbytes
-        for a in _VT(
-            [_ring1k[v].public for v in range(1024)]
-        ).arrays_chal()
-    ))
+    # Measured by run_sustained from its live table (coords + encodings
+    # + valid mask) — layout changes keep the artifact true.
+    pipe["table_bytes_1024v"] = probe_1024["table_bytes"]
 
     # (b) paired e2e at n=512: dedup vs crossover-routed device tally.
     from hyperdrive_tpu.crypto.keys import KeyRing

@@ -235,6 +235,31 @@ def test_chalwire_requires_32_byte_digests(ring_table):
                                            items[0][2])] == [True]
 
 
+def test_chalwire_pallas_interpret_matches_xla(ring_table):
+    """chalwire_verify_pallas (the path the TPU engine verifier takes:
+    XLA challenge leg + Mosaic ladder) in interpret mode, against the
+    all-XLA path — identical verdicts lane for lane, tampered lanes
+    included."""
+    from hyperdrive_tpu.ops.ed25519_wire import chalwire_verify_pallas
+
+    ring, table = ring_table
+    host = Ed25519WireHost(buckets=(64,))
+    items = _signed_items(ring, 12, seed=31)
+    items[2] = (items[2][0], items[2][1],
+                items[2][2][:63] + bytes([items[2][2][63] ^ 1]))
+    items[9] = (ring[3].public, items[9][1], items[9][2])  # wrong sender
+    (idx, r, s, m), prevalid, n = host.pack_wire_challenge(items, table)
+    args = (jnp.asarray(idx), jnp.asarray(r), jnp.asarray(s),
+            jnp.asarray(m), *table.arrays_chal())
+    ok_pallas = (np.asarray(
+        chalwire_verify_pallas(*args, block=64, interpret=True)
+    ) & prevalid)[:n]
+    ok_xla = (np.asarray(make_chalwire_verify_fn()(*args)) & prevalid)[:n]
+    np.testing.assert_array_equal(ok_pallas, ok_xla)
+    assert not ok_pallas[2] and not ok_pallas[9]
+    assert ok_pallas.sum() == n - 2
+
+
 def test_chalwire_empty_batch(ring_table):
     _, table = ring_table
     host = Ed25519WireHost(buckets=(64,))
