@@ -107,7 +107,14 @@ while time.time() < DEADLINE:
 
             ring = KeyRing.deterministic(n, namespace=b"sim-%d" % seed)
             pubs = [ring[i].public for i in range(n)]
-            table = ValidatorTable(pubs + [bytes(32)] * (PAD_SLOTS - n))
+            # Pad slots use a non-canonical y (the encoding of p itself),
+            # which always fails decompression — bytes(32) would NOT do:
+            # y=0 decompresses to a valid curve point, so zero-padded
+            # slots would be live table entries.
+            from hyperdrive_tpu.crypto.ed25519 import P as _P
+
+            pad = _P.to_bytes(32, "little")
+            table = ValidatorTable(pubs + [pad] * (PAD_SLOTS - n))
             batch_verifier = TpuWireVerifier(
                 buckets=(64, 256), table=table, backend="xla"
             )

@@ -49,6 +49,7 @@ __all__ = [
     "make_semiwire_verify_fn",
     "chalwire_verify_kernel",
     "make_chalwire_verify_fn",
+    "make_challenge_grouped_fn",
     "ValidatorTable",
     "Ed25519WireHost",
     "TpuWireVerifier",
@@ -172,7 +173,13 @@ class ValidatorTable:
     [V, 20] coordinate tensors once, and the indexed verify path then
     ships a 4-byte validator index per lane (100 B/lane total vs the
     full wire path's 128). Pubkeys that fail decompression occupy an
-    invalid slot — their signatures reject, matching the oracle."""
+    invalid slot — their signatures reject, matching the oracle.
+
+    Padding caution: ``bytes(32)`` is NOT an invalid encoding — y = 0
+    decompresses to a real curve point, so zero-padded slots are live
+    table entries registered under the all-zero pubkey. Pad with a
+    non-canonical encoding instead (e.g. ``P.to_bytes(32, "little")``,
+    which always fails decompression)."""
 
     def __init__(self, pubkeys):
         pubkeys = list(pubkeys)
@@ -316,6 +323,32 @@ def make_challenge_round_fn(validators: int):
 
 
 @functools.lru_cache(maxsize=None)
+def make_challenge_grouped_fn():
+    """Chal leg for the GROUPED engine wire format: digests arrive as a
+    deduped table plus a one-byte per-lane index, and M is gathered on
+    device. The wire then carries R (32) + s (32) + validator idx (4) +
+    digest idx (1) = 69 B/lane, plus U * 32 B of unique digests amortized
+    over the chunk. Consensus windows hold only a handful of distinct
+    digests — one per (type, height, round, value) claim, value + nil per
+    round, because the sender is excluded from the signing digest
+    (reference: /root/reference/process/message.go:165-186) — so U stays
+    single-digit while lanes number thousands. This is the round-4
+    68 B/lane bench format generalized from round-major lanes to an
+    arbitrary lane->digest index, which is what the ENGINE's verify path
+    (TpuWireVerifier.verify_signatures) can actually ship."""
+    from hyperdrive_tpu.ops.sha512_jax import challenge_scalar_device
+
+    @jax.jit
+    def chal(idx, r_rows, m_idx, m_uniq, trows):
+        m_rows = jnp.take(m_uniq, m_idx.astype(jnp.int32), axis=0)
+        return challenge_scalar_device(
+            r_rows, jnp.take(trows, idx, axis=0), m_rows
+        )
+
+    return chal
+
+
+@functools.lru_cache(maxsize=None)
 def make_chalwire_verify_fn(jit: bool = True):
     """TWO dispatches, not one: the unrolled SHA-512 fused into the
     ladder graph sends XLA:CPU's optimizer superlinear (>12 min for a
@@ -435,8 +468,10 @@ class Ed25519WireHost:
         """Vectorized little-endian 256-bit compare: rows < bound, as
         four uint64 words most-significant first. ``mask255`` clears bit
         255 first (the field-encoding convention: the sign bit is not part
-        of y)."""
-        w = np.ascontiguousarray(rows).view(np.uint64)
+        of y). The word view is byte-order-explicit ('<u8'): on a
+        big-endian host a native-endian view would invert the comparison
+        and let a malleable s >= L signature through prevalid."""
+        w = np.ascontiguousarray(rows).view(np.dtype("<u8"))
         if mask255:
             w = w.copy()
             w[:, 3] &= 0x7FFFFFFFFFFFFFFF
@@ -516,6 +551,43 @@ class Ed25519WireHost:
         # device, but prevalid is the contract (same as pack_wire).
         return (idx, r_rows, s_rows, m_rows), prevalid, n
 
+    #: Unique-digest capacity of the grouped challenge format — the
+    #: per-lane digest index is one byte. Chunks exceeding it (only
+    #: adversarial or benchmark-synthetic: a consensus window has a
+    #: handful of distinct claims) fall back to per-lane digest rows.
+    M_GROUP_CAP = 256
+    #: Bucket ladder for the unique-digest table (its own jit shapes).
+    M_BUCKETS = (16, 256)
+
+    def group_digests(self, items, bucket: int):
+        """Dedup the items' digests for the grouped challenge format.
+
+        Returns ``(m_idx, m_uniq, u)`` — ``m_idx`` [bucket] uint8 lane ->
+        digest-slot indices, ``m_uniq`` [m_bucket, 32] uint8 unique digest
+        rows (first ``u`` live), — or None when the chunk has more than
+        :data:`M_GROUP_CAP` distinct digests and must ride the per-lane
+        path. First-seen order assigns slots, so packing is deterministic.
+        """
+        cap = min(self.M_GROUP_CAP, 256)  # m_idx is uint8: hard ceiling
+        slots: dict = {}
+        m_idx = np.zeros(bucket, dtype=np.uint8)
+        for i, (_, d, _) in enumerate(items):
+            s = slots.get(d)
+            if s is None:
+                s = len(slots)
+                if s >= cap:
+                    return None
+                slots[d] = s
+            m_idx[i] = s
+        u = len(slots)
+        mb = bucketing.bucket_for(max(u, 1), self.M_BUCKETS)
+        m_uniq = np.zeros((mb, 32), dtype=np.uint8)
+        if u:
+            m_uniq[:u] = np.frombuffer(
+                b"".join(slots), dtype=np.uint8
+            ).reshape(u, 32)
+        return m_idx, m_uniq, u
+
     def pack_wire_indexed(self, items, table: ValidatorTable):
         """Indexed-A packing: like :meth:`pack_wire`, but A ships as an
         int32 index into ``table`` (4 B/lane instead of 32). Requires
@@ -555,12 +627,18 @@ class TpuWireVerifier:
         #: Optional resident validator table: chunks whose senders are all
         #: in the table ride the CHALLENGE path — 4-byte A index per lane
         #: and k = SHA-512(R||A||M) derived on device, so the host does no
-        #: hashing at all (same 100 B/lane as the host-hashed indexed
-        #: path: the 32-byte digest rides where k rode). Any unknown
-        #: pubkey routes that chunk through the full wire path so verdicts
-        #: never depend on table contents. Unconditional by measurement:
-        #: the chal leg's extra dispatch costs +9 ms p50 at window 64 and
-        #: is paired-noise by 1024 (vs a ~120-130 ms per-call sync floor
+        #: hashing at all. When the chunk's digests dedup to <=256 unique
+        #: values (every consensus window: digests are per-(type, h, r,
+        #: value) claims, sender excluded — reference:
+        #: /root/reference/process/message.go:165-186) the GROUPED format
+        #: ships a one-byte digest index per lane + the unique digest
+        #: table: 69 B/lane, the round-4 bench format as the product
+        #: format. Chunks with more distinct digests ride per-lane digest
+        #: rows (100 B/lane). Any unknown pubkey routes the whole chunk
+        #: through the full 128 B/lane wire path so verdicts never depend
+        #: on table contents. Unconditional by measurement: the chal
+        #: leg's extra dispatch costs +9 ms p50 at window 64 and is
+        #: paired-noise by 1024 (vs a ~120-130 ms per-call sync floor
         #: either way, 2026-07-31 tunnel session) — and windows that
         #: small are the ones the engine's small_window_host /
         #: AdaptiveVerifier routing keeps on host to begin with, so a
@@ -568,6 +646,32 @@ class TpuWireVerifier:
         #: layer up.
         self.table = table
         self._chal_fn = make_chalwire_verify_fn(jit=True)
+        self._chal_grouped = make_challenge_grouped_fn()
+        self._semi_fn = make_semiwire_verify_fn(jit=True)
+        #: Wire-format accounting, reset with :meth:`reset_stats`:
+        #: ``lanes`` = real (unpadded) signatures routed per path,
+        #: ``format_bytes`` = the per-lane field bytes those lanes cost
+        #: on the wire (grouped: 69*n + 32*U; chal per-lane: 100*n;
+        #: full wire: 128*n) — the engine bytes/lane BENCH.md reports.
+        self.stats = {
+            "lanes_grouped": 0,
+            "lanes_chal": 0,
+            "lanes_wire": 0,
+            "format_bytes": 0,
+        }
+
+    def reset_stats(self) -> None:
+        self.stats = {k: 0 for k in self.stats}
+
+    def bytes_per_lane(self) -> float:
+        """Mean engine wire-format bytes per real lane since the last
+        reset (0.0 when nothing was verified)."""
+        lanes = (
+            self.stats["lanes_grouped"]
+            + self.stats["lanes_chal"]
+            + self.stats["lanes_wire"]
+        )
+        return self.stats["format_bytes"] / lanes if lanes else 0.0
 
     def _device_verify(self, rows):
         dev_in = [jnp.asarray(a) for a in rows]
@@ -584,6 +688,25 @@ class TpuWireVerifier:
             return chalwire_verify_pallas(*dev_in, *tbl)
         return self._chal_fn(*dev_in, *tbl)
 
+    def _device_verify_chal_grouped(self, rows):
+        """Grouped challenge launch: derive k from the deduped digest
+        table (69 B/lane on the wire), then the ladder — the same
+        two-dispatch split as the per-lane chal path."""
+        idx, r_rows, s_rows, m_idx, m_uniq = (jnp.asarray(a) for a in rows)
+        k_rows = self._chal_grouped(
+            idx, r_rows, m_idx, m_uniq, self.table.rows
+        )
+        if self.backend == "pallas":
+            from hyperdrive_tpu.ops.ed25519_pallas import (
+                semiwire_verify_pallas,
+            )
+
+            return semiwire_verify_pallas(
+                idx, r_rows, s_rows, k_rows, *self.table.arrays()
+            )
+        return self._semi_fn(idx, r_rows, s_rows, k_rows,
+                             *self.table.arrays())
+
     def warmup(self) -> None:
         for b in self.host.buckets:
             z = jnp.zeros((b, 32), dtype=jnp.uint8)
@@ -591,6 +714,14 @@ class TpuWireVerifier:
             if self.table is not None:
                 zi = jnp.zeros(b, dtype=jnp.int32)
                 np.asarray(self._device_verify_chal((zi, z, z, z)))
+                zm = jnp.zeros(b, dtype=jnp.uint8)
+                for mb in self.host.M_BUCKETS:
+                    zu = jnp.zeros((mb, 32), dtype=jnp.uint8)
+                    np.asarray(
+                        self._device_verify_chal_grouped(
+                            (zi, z, z, zm, zu)
+                        )
+                    )
 
     def verify_signatures(self, items) -> np.ndarray:
         """items: list of (pub, digest, sig); returns bool[n]. Chunks at
@@ -601,6 +732,7 @@ class TpuWireVerifier:
         if not items:
             return np.zeros(0, dtype=bool)
         cap = self.host.buckets[-1]
+        stats = self.stats
         pending = []
         for lo in range(0, len(items), cap):
             chunk = items[lo : lo + cap]
@@ -609,17 +741,37 @@ class TpuWireVerifier:
             ):
                 idx, all_known = self.host.index_lanes(chunk, self.table)
                 if all_known:
+                    grouped = self.host.group_digests(chunk, len(idx))
                     rows, prevalid, n = self.host.pack_wire_challenge(
-                        chunk, self.table, _idx=idx
+                        chunk, self.table, with_m=grouped is None,
+                        _idx=idx,
                     )
-                    pending.append((
-                        self._device_verify_chal(rows)
-                        if prevalid.any() else None,
-                        prevalid,
-                        n,
-                    ))
+                    idx, r_rows, s_rows, m_rows = rows
+                    if grouped is not None:
+                        m_idx, m_uniq, u = grouped
+                        stats["lanes_grouped"] += n
+                        stats["format_bytes"] += 69 * n + 32 * u
+                        dev = (
+                            self._device_verify_chal_grouped(
+                                (idx, r_rows, s_rows, m_idx, m_uniq)
+                            )
+                            if prevalid.any() else None
+                        )
+                    else:
+                        # > M_GROUP_CAP distinct digests: per-lane rows.
+                        stats["lanes_chal"] += n
+                        stats["format_bytes"] += 100 * n
+                        dev = (
+                            self._device_verify_chal(
+                                (idx, r_rows, s_rows, m_rows)
+                            )
+                            if prevalid.any() else None
+                        )
+                    pending.append((dev, prevalid, n))
                     continue
             rows, prevalid, n = self.host.pack_wire(chunk)
+            stats["lanes_wire"] += n
+            stats["format_bytes"] += 128 * n
             if not prevalid.any():
                 pending.append((None, prevalid, n))
                 continue

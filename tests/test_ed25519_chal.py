@@ -330,3 +330,87 @@ def test_chalwire_per_round_digest_broadcast(ring_table):
     ok_ref = _chal_verify(host, table, items)
     np.testing.assert_array_equal(ok, ok_ref)
     assert not ok[5] and ok.sum() == n - 1
+
+
+# --------------------------------------------- grouped engine wire format
+
+
+def test_grouped_chal_matches_per_lane_and_oracle(ring_table):
+    """The 69 B/lane grouped engine format (deduped digest table + a
+    one-byte lane index, M gathered on device) must agree bit-for-bit
+    with the per-lane chal path and the host oracle — tampered lanes
+    included."""
+    from hyperdrive_tpu.ops.ed25519_wire import make_challenge_grouped_fn
+
+    ring, table = ring_table
+    host = Ed25519WireHost(buckets=(64,))
+    rng = np.random.default_rng(41)
+    uniq = [bytes(rng.integers(0, 256, 32, dtype=np.uint8))
+            for _ in range(3)]
+    items = []
+    for i in range(20):
+        v, d = i % 8, uniq[i % 3]
+        items.append((ring[v].public, d, ring[v].sign_digest(d)))
+    items[4] = (items[4][0], items[4][1],
+                items[4][2][:63] + bytes([items[4][2][63] ^ 1]))
+    items[5] = (ring[(5 + 1) % 8].public, items[5][1], items[5][2])
+
+    (idx, r, s, _), prevalid, n = host.pack_wire_challenge(
+        items, table, with_m=False)
+    m_idx, m_uniq, u = host.group_digests(items, len(prevalid))
+    assert u == 3 and m_uniq.shape == (host.M_BUCKETS[0], 32)
+    k = make_challenge_grouped_fn()(
+        jnp.asarray(idx), jnp.asarray(r), jnp.asarray(m_idx),
+        jnp.asarray(m_uniq), table.rows)
+    semi = make_semiwire_verify_fn()
+    ok = (np.asarray(semi(
+        jnp.asarray(idx), jnp.asarray(r), jnp.asarray(s), k,
+        *table.arrays())) & prevalid)[:n]
+    want = np.array([host_ed.verify(p, d, sig) for p, d, sig in items])
+    np.testing.assert_array_equal(ok, want)
+    ok_perlane = _chal_verify(host, table, items)
+    np.testing.assert_array_equal(ok, ok_perlane)
+    assert not want[4] and not want[5] and want.sum() == n - 2
+
+
+def test_verifier_routes_grouped_and_counts_bytes(ring_table):
+    """TpuWireVerifier ships consensus-shaped chunks (few distinct
+    digests) in the grouped 69 B/lane format and accounts engine
+    bytes/lane."""
+    from hyperdrive_tpu.ops.ed25519_wire import TpuWireVerifier
+
+    ring, table = ring_table
+    wv = TpuWireVerifier(buckets=(64,), table=table, backend="xla")
+    uniq = [bytes([7]) * 32, bytes([9]) * 32]
+    items = []
+    for v in range(24):
+        d = uniq[v % 2]
+        items.append((ring[v % 8].public, d, ring[v % 8].sign_digest(d)))
+    items[3] = (items[3][0], items[3][1], items[3][2][:32] + bytes(32))
+    got = wv.verify_signatures(items)
+    want = [host_ed.verify(p, d, s) for p, d, s in items]
+    assert got.tolist() == want and not want[3]
+    assert wv.stats["lanes_grouped"] == 24
+    assert wv.stats["lanes_chal"] == 0 and wv.stats["lanes_wire"] == 0
+    assert wv.stats["format_bytes"] == 69 * 24 + 32 * 2
+    assert abs(wv.bytes_per_lane() - (69 * 24 + 64) / 24) < 1e-9
+    wv.reset_stats()
+    assert wv.bytes_per_lane() == 0.0
+
+
+def test_verifier_falls_back_per_lane_above_group_cap(ring_table):
+    """A chunk with more distinct digests than the one-byte index can
+    address rides per-lane digest rows (100 B/lane), verdicts unchanged.
+    The cap is shrunk so the fallback triggers at test-size chunks."""
+    from hyperdrive_tpu.ops.ed25519_wire import TpuWireVerifier
+
+    ring, table = ring_table
+    wv = TpuWireVerifier(buckets=(64,), table=table, backend="xla")
+    wv.host.M_GROUP_CAP = 4  # instance override: force the fallback
+    items = _signed_items(ring, 24, seed=53)  # 24 distinct digests > 4
+    assert wv.host.group_digests(items, 64) is None
+    got = wv.verify_signatures(items)
+    assert got.all()
+    assert wv.stats["lanes_chal"] == 24
+    assert wv.stats["lanes_grouped"] == 0 and wv.stats["lanes_wire"] == 0
+    assert wv.stats["format_bytes"] == 100 * 24
