@@ -16,6 +16,10 @@ arXiv:1807.04938; reference layout surveyed in SURVEY.md):
   a ``jax.sharding.Mesh`` (ICI/DCN collectives).
 - ``hyperdrive_tpu.harness``   — deterministic in-process network simulator
   with seeded record/replay and fault/Byzantine injection.
+- ``hyperdrive_tpu.transport`` — loopback-TCP binding of the Broadcaster
+  seam (full-mesh, length-framed signed envelopes).
+- ``hyperdrive_tpu.tallyflush``— per-replica device-tally flushing: the
+  deployment (n = 1) shape of the vote grid behind a threaded replica.
 - ``hyperdrive_tpu.native``    — C++ host runtime (batch signature packing:
   point decompression, SHA-512 challenges, limb packing) via ctypes.
 - ``hyperdrive_tpu.utils``     — tracing/metrics, structured logging, and

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 
 import numpy as np
 
@@ -653,15 +654,24 @@ class TpuWireVerifier:
         #: ``format_bytes`` = the per-lane field bytes those lanes cost
         #: on the wire (grouped: 69*n + 32*U; chal per-lane: 100*n;
         #: full wire: 128*n) — the engine bytes/lane BENCH.md reports.
+        #: Lock-guarded: deployments share one verifier across replica
+        #: threads (tallyflush), and unguarded += would lose counts.
         self.stats = {
             "lanes_grouped": 0,
             "lanes_chal": 0,
             "lanes_wire": 0,
             "format_bytes": 0,
         }
+        self._stats_lock = threading.Lock()
 
     def reset_stats(self) -> None:
-        self.stats = {k: 0 for k in self.stats}
+        with self._stats_lock:
+            self.stats = {k: 0 for k in self.stats}
+
+    def _count(self, lane_key: str, lanes: int, fbytes: int) -> None:
+        with self._stats_lock:
+            self.stats[lane_key] += lanes
+            self.stats["format_bytes"] += fbytes
 
     def bytes_per_lane(self) -> float:
         """Mean engine wire-format bytes per real lane since the last
@@ -732,7 +742,6 @@ class TpuWireVerifier:
         if not items:
             return np.zeros(0, dtype=bool)
         cap = self.host.buckets[-1]
-        stats = self.stats
         pending = []
         for lo in range(0, len(items), cap):
             chunk = items[lo : lo + cap]
@@ -749,8 +758,7 @@ class TpuWireVerifier:
                     idx, r_rows, s_rows, m_rows = rows
                     if grouped is not None:
                         m_idx, m_uniq, u = grouped
-                        stats["lanes_grouped"] += n
-                        stats["format_bytes"] += 69 * n + 32 * u
+                        self._count("lanes_grouped", n, 69 * n + 32 * u)
                         dev = (
                             self._device_verify_chal_grouped(
                                 (idx, r_rows, s_rows, m_idx, m_uniq)
@@ -759,8 +767,7 @@ class TpuWireVerifier:
                         )
                     else:
                         # > M_GROUP_CAP distinct digests: per-lane rows.
-                        stats["lanes_chal"] += n
-                        stats["format_bytes"] += 100 * n
+                        self._count("lanes_chal", n, 100 * n)
                         dev = (
                             self._device_verify_chal(
                                 (idx, r_rows, s_rows, m_rows)
@@ -770,8 +777,7 @@ class TpuWireVerifier:
                     pending.append((dev, prevalid, n))
                     continue
             rows, prevalid, n = self.host.pack_wire(chunk)
-            stats["lanes_wire"] += n
-            stats["format_bytes"] += 128 * n
+            self._count("lanes_wire", n, 128 * n)
             if not prevalid.any():
                 pending.append((None, prevalid, n))
                 continue

@@ -168,6 +168,8 @@ class Replica:
         broadcaster: Optional[Broadcaster],
         did_handle_message: Optional[Callable[[], None]] = None,
         verifier=None,
+        flusher=None,
+        recorder=None,
     ):
         f = len(signatories) // 3
         self.opts = opts
@@ -197,6 +199,22 @@ class Replica:
             self.mq.order_of(s)
         self.did_handle_message = did_handle_message
         self.verifier = verifier
+        #: Optional flush delegate (``flush(replica) -> None`` drains the
+        #: queue to quiescence): the seam a deployment uses to put a
+        #: device vote grid behind this replica's own event loop — see
+        #: :class:`hyperdrive_tpu.tallyflush.DeviceTallyFlusher`. The
+        #: sim's settle layer aggregates MANY lockstep replicas into one
+        #: launch instead (harness/sim.py), so it does not use this.
+        self.flusher = flusher
+        #: Optional consumption log (``record(msg)``): every input this
+        #: replica consumes — votes, timeouts, resets — in the exact
+        #: order consumed. This is the deployment path's record/replay
+        #: seam (:class:`hyperdrive_tpu.transport.FlightRecorder`): the
+        #: replica IS the serialization point (one event loop), so its
+        #: consumption order is the whole behavior — the sim's
+        #: failure.dump workflow (reference:
+        #: replica/replica_test.go:850-928) extended to socket runs.
+        self.recorder = recorder
         self._inbox: _queue.Queue = _queue.Queue(maxsize=opts.max_capacity)
         # Synchronous-mode reentrancy guard: a broadcaster wired straight
         # back into handle() (loopback) must enqueue, not recurse — the
@@ -368,6 +386,8 @@ class Replica:
                 self.tracer.count("replica.msg.propose", n_pp)
 
     def _handle_one(self, msg) -> None:
+        if self.recorder is not None:
+            self.recorder.record(msg)
         if self.tracer is not NULL_TRACER:
             self.tracer.count(
                 _MSG_METRIC.get(type(msg), "replica.msg.other")
@@ -383,22 +403,7 @@ class Replica:
                 else:
                     return
             elif isinstance(msg, (Propose, Prevote, Precommit)):
-                h = msg.height
-                cur = self.proc.current_height
-                if h < cur:
-                    return
-                if h == cur and self.opts.external_flush:
-                    c = self._lane_counts.get(msg.sender, 0)
-                    if c < self.opts.max_capacity:
-                        self._lane_counts[msg.sender] = c + 1
-                        self._lane.append(msg)
-                    return
-                if isinstance(msg, Propose):
-                    self.mq.insert_propose(msg)
-                elif isinstance(msg, Prevote):
-                    self.mq.insert_prevote(msg)
-                else:
-                    self.mq.insert_precommit(msg)
+                self._buffer_vote(msg)
             elif isinstance(msg, ResetHeight):
                 self.logger.info(
                     "reset height %s",
@@ -428,6 +433,31 @@ class Replica:
             if self.did_handle_message is not None:
                 self.did_handle_message()
 
+    def _buffer_vote(self, msg) -> None:
+        """Height-filter + buffer one vote: below-height drops, the
+        current-height fast lane in ``external_flush`` mode, the sorted
+        queue otherwise. The ONE copy of the vote admission rule shared
+        by the per-message (:meth:`_handle_one`) and coalesced
+        (:meth:`handle_coalesced`) paths — :meth:`handle_burst` inlines
+        the same rule with hoisted locals for the sim's hot loop; change
+        both together."""
+        h = msg.height
+        cur = self.proc.current_height
+        if h < cur:
+            return
+        if h == cur and self.opts.external_flush:
+            c = self._lane_counts.get(msg.sender, 0)
+            if c < self.opts.max_capacity:
+                self._lane_counts[msg.sender] = c + 1
+                self._lane.append(msg)
+            return
+        if isinstance(msg, Propose):
+            self.mq.insert_propose(msg)
+        elif isinstance(msg, Prevote):
+            self.mq.insert_prevote(msg)
+        else:
+            self.mq.insert_precommit(msg)
+
     def _flush(self) -> None:
         """Drain the queue into the Process until quiescent
         (reference: replica/replica.go:251-264).
@@ -436,6 +466,9 @@ class Replica:
         batch-verified before dispatch; without one, this is the reference's
         synchronous consume loop.
         """
+        if self.flusher is not None:
+            self.flusher.flush(self)
+            return
         if self.verifier is None:
             while True:
                 n = self.mq.consume(
@@ -560,20 +593,70 @@ class Replica:
 
     # -------------------------------------------------------- threaded driving
 
-    def run(self, stop: threading.Event) -> None:
+    def run(self, stop: threading.Event, coalesce: bool = False) -> None:
         """Drain the inbox until ``stop`` fires (the reference's Run loop,
-        replica/replica.go:88-151). Call from a dedicated thread."""
+        replica/replica.go:88-151). Call from a dedicated thread.
+
+        ``coalesce=True`` drains every message already waiting in the
+        inbox before flushing once, instead of flushing after each — the
+        threaded analogue of the harness burst mode, and what makes a
+        device-verified deployment replica pay one launch per burst
+        rather than one per vote. Under per-message flushing the two
+        schedules are equivalent (the batched cascade's outcome
+        corresponds to a legal delivery order — see Process.ingest);
+        backpressure still fires ``did_handle_message`` per message.
+        """
         self.proc.start()
+        cap = max(self.opts.verify_window, 1)
         while not stop.is_set():
             try:
                 msg = self._inbox.get(timeout=0.05)
             except _queue.Empty:
                 continue
-            self.handle(msg)
+            if not coalesce:
+                self.handle(msg)
+                continue
+            batch = [msg]
+            while len(batch) < cap:
+                try:
+                    batch.append(self._inbox.get_nowait())
+                except _queue.Empty:
+                    break
+            self.handle_coalesced(batch)
         # Match the reference: the callback also fires when the context is
         # cancelled (replica/replica.go:16-18).
         if self.did_handle_message is not None:
             self.did_handle_message()
+
+    def handle_coalesced(self, msgs) -> None:
+        """Buffer a burst of inbox messages, then flush ONCE.
+
+        Votes height-filter and insert into the queue without the
+        per-message flush-until-quiescent pass; timeouts and resets take
+        the full :meth:`handle` path (they can move the height). The
+        single flush at the end restores the quiescence contract for the
+        whole burst. Not meaningful with ``external_flush`` (an external
+        driver owns settling there) — :meth:`handle_burst` is that mode's
+        batch entry."""
+        if self.opts.external_flush:
+            raise RuntimeError(
+                "handle_coalesced is the self-flushing batch entry; "
+                "external_flush drivers use handle_burst"
+            )
+        dh = self.did_handle_message
+        for msg in msgs:
+            t = type(msg)
+            if t is Propose or t is Prevote or t is Precommit:
+                if self.recorder is not None:
+                    self.recorder.record(msg)
+                if self.tracer is not NULL_TRACER:
+                    self.tracer.count(_MSG_METRIC[t])
+                self._buffer_vote(msg)
+                if dh is not None:
+                    dh()
+            else:
+                self.handle(msg)
+        self._flush()
 
     def _enqueue(self, msg, stop: Optional[threading.Event] = None) -> None:
         while True:

@@ -1,0 +1,184 @@
+"""Per-replica device-tally flushing: the DEPLOYMENT shape of the vote
+grid.
+
+The harness settles a whole lockstep network in one aggregated launch
+(:mod:`hyperdrive_tpu.harness.sim` — a simulation artifact: one process
+owns every replica). A deployed replica instead owns its own n=1 grid
+row (the "deployment (n = 1)" row of :class:`~hyperdrive_tpu.ops.votegrid.
+VoteGrid`'s memory-budget table) and flushes at its own pace, driven by
+its own event loop. This module is that composition: a
+:class:`DeviceTallyFlusher` plugs into :class:`hyperdrive_tpu.replica.
+Replica`'s ``flusher`` seam and, per flush pass,
+
+1. drains the replica's eligible window from the sorted queue,
+2. batch-verifies it through the injected Verifier (in the capstone
+   deployment: :class:`~hyperdrive_tpu.ops.ed25519_wire.TpuWireVerifier`
+   with a resident ValidatorTable — the grouped 69 B/lane challenge
+   format, SHA-512 + mod-L + decompression + ladder on device),
+3. inserts the survivors into the host automaton
+   (:meth:`~hyperdrive_tpu.replica.Replica.ingest_insert_window`),
+   scattering each *accepted* vote into the device grid,
+4. runs ONE fused tally launch and hands the counts to the rule cascade
+   (:meth:`~hyperdrive_tpu.replica.Replica.ingest_cascade_window`).
+
+The rule cascade reads device counts where the grid covers the query and
+falls back to the host counters elsewhere — bit-equal by contract,
+enforceable per query with ``tally_check=CheckedTallyView``. The
+reference has no analogue of any of this: its hot loops rescan Go maps
+per message (/root/reference/process/process.go:574-579); this is the
+north star's masked-reduction tally behind the replica's own inbox.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["DeviceTallyFlusher"]
+
+
+class DeviceTallyFlusher:
+    """Owns one replica's device vote grid + batched verification flush.
+
+    Single-writer: all methods must run on the owning replica's event
+    loop thread (the same discipline as the Process itself — reference:
+    process/process.go:100-101). Multiple local replicas each get their
+    own flusher; they may share one Verifier (its launches are
+    independent).
+
+    ``validators``: the signatory list in whitelist order — defines the
+    grid's validator axis. ``tally_check``: optional ``(view, proc) ->
+    view`` wrapper (e.g. :class:`~hyperdrive_tpu.ops.votegrid.
+    CheckedTallyView`) installed over every launch's TallyView.
+    """
+
+    def __init__(self, verifier, validators, r_slots: int = 8,
+                 buckets: tuple = (256, 1024, 4096), tally_check=None):
+        from hyperdrive_tpu.ops.votegrid import VoteGrid
+
+        self.verifier = verifier
+        self.grid = VoteGrid(
+            1, len(validators), r_slots=r_slots, buckets=buckets
+        )
+        self._pos = {s: i for i, s in enumerate(validators)}
+        self.tally_check = tally_check
+        self._height = None
+        self._dirty: set = set()
+        #: Flush passes that ran a tally launch (observability).
+        self.launches = 0
+
+    def warmup(self) -> None:
+        """Compile the grid kernel (one empty scatter) before the replica
+        starts — a deployment pays XLA compiles at boot, not inside its
+        first consensus round where they would masquerade as network
+        stalls and fire timeouts."""
+        R = self.grid.R
+        self.grid.update_and_tally(
+            np.zeros((0, 4), dtype=np.int32),
+            np.zeros((0, 8), dtype=np.int32),
+            np.zeros(1, dtype=bool),
+            np.zeros((1, R, 8), dtype=np.int32),
+            np.zeros((1, R), dtype=bool),
+            np.full(1, -1, dtype=np.int32),
+            np.zeros((1, 8), dtype=np.int32),
+            np.zeros(1, dtype=np.int32),
+        )
+        if hasattr(self.verifier, "warmup"):
+            self.verifier.warmup()
+
+    def flush(self, replica) -> None:
+        """Drain the replica's queue to quiescence (the reference flush
+        contract, replica/replica.go:251-264), one verified + tallied
+        window per pass."""
+        while True:
+            window = replica.mq.drain_window(
+                replica.proc.current_height, replica.opts.verify_window
+            )
+            if not window:
+                return
+            keep = self.verifier.verify_batch(window)
+            self._settle(replica, window, keep)
+
+    def _settle(self, replica, window, keep) -> None:
+        from hyperdrive_tpu.batch import MessageBlock
+        from hyperdrive_tpu.ops.tally import pack_value
+        from hyperdrive_tpu.ops.votegrid import TallyView
+
+        grid = self.grid
+        R = grid.R
+        proc = replica.proc
+
+        # Reset the plane when the height moved since the grid was last
+        # valid — computed BEFORE the insert phase so the hook's dirty
+        # marks for the new height survive (inserts never move heights).
+        reset = np.zeros(1, dtype=bool)
+        h = proc.current_height
+        if self._height != h:
+            reset[0] = True
+            self._height = h
+            self._dirty = set()
+
+        accepted: list = []
+        dirty = self._dirty
+
+        def on_accepted(msg, is_precommit):
+            rnd = msg.round
+            plane = 1 if is_precommit else 0
+            if rnd < 0 or rnd >= R:
+                # Outside the slot window: the view declines these rounds.
+                return
+            v = self._pos.get(msg.sender)
+            if v is None:
+                # Whitelisted sender beyond the grid's validator axis
+                # (post-rotation): poison the round for this height.
+                dirty.add((plane, rnd))
+                return
+            accepted.append((plane, msg))
+
+        plan = replica.ingest_insert_window(window, keep, on_accepted)
+
+        # Launch inputs (n = 1): per-round matching targets are this
+        # replica's proposal values post-insert; the L28 lane carries the
+        # cross-round (valid_round, current proposal value) query.
+        st = proc.state
+        targets = np.zeros((1, R, 8), dtype=np.int32)
+        tvalid = np.zeros((1, R), dtype=bool)
+        l28_slot = np.full(1, -1, dtype=np.int32)
+        l28_target = np.zeros((1, 8), dtype=np.int32)
+        tmap: dict = {}
+        for rnd, p in st.propose_logs.items():
+            if 0 <= rnd < R:
+                targets[0, rnd] = pack_value(p.value)
+                tvalid[0, rnd] = True
+                tmap[rnd] = p.value
+        l28_val = b""
+        cur = st.propose_logs.get(st.current_round)
+        if cur is not None and 0 <= cur.valid_round < R:
+            l28_slot[0] = cur.valid_round
+            l28_target[0] = pack_value(cur.value)
+            l28_val = cur.value
+
+        if accepted:
+            block = MessageBlock.from_messages([m for _, m in accepted])
+            words = np.ascontiguousarray(block.rows["value"]).view("<i4")
+            idx = np.array(
+                [
+                    (0, plane, m.round, self._pos[m.sender])
+                    for plane, m in accepted
+                ],
+                dtype=np.int32,
+            )
+        else:
+            words = np.zeros((0, 8), dtype=np.int32)
+            idx = np.zeros((0, 4), dtype=np.int32)
+        counts = grid.update_and_tally(
+            idx, words, reset, targets, tvalid, l28_slot, l28_target,
+            np.array([proc.f], dtype=np.int32),
+        )
+        self.launches += 1
+        view = TallyView(
+            0, self._height, counts, R, tmap, int(l28_slot[0]), l28_val,
+            dirty=dirty,
+        )
+        if self.tally_check is not None:
+            view = self.tally_check(view, proc)
+        replica.ingest_cascade_window(plan, view)

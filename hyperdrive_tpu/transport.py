@@ -38,7 +38,13 @@ from hyperdrive_tpu.messages import (
     unmarshal_message,
 )
 
-__all__ = ["TcpBroadcaster", "TcpNode", "encode_frame"]
+__all__ = [
+    "TcpBroadcaster",
+    "TcpNode",
+    "encode_frame",
+    "FlightRecorder",
+    "replay_flight",
+]
 
 _LEN = struct.Struct("<I")
 _MAX_FRAME = 1 << 20  # 1 MiB: far above any consensus envelope
@@ -252,6 +258,117 @@ class TcpNode:
                         q.get_nowait()  # shed the oldest frame
                     except queue.Empty:
                         pass
+
+
+class FlightRecorder:
+    """One replica's consumption log: every input the replica's event
+    loop consumed — votes, local timeouts, resets — in consumption order.
+
+    This extends the sim's seeded record/replay (the reference's
+    failure.dump workflow, replica/replica_test.go:850-928) to the
+    DEPLOYMENT path, where inputs arrive over sockets and wall-clock
+    timers and are otherwise unreproducible. The replica is the
+    serialization point (one event loop consumes everything), so its log
+    is a complete causal record: replaying it into a fresh in-process
+    replica with the same deterministic DI set reproduces the replica's
+    whole trajectory — no sockets, no timers, no other processes.
+
+    Thread-safety: ``record`` runs on the owning replica's event-loop
+    thread only (the single-writer discipline every replica component
+    shares); ``dump`` may run on any thread after the loop stops.
+
+    Format: per record, a one-byte kind tag — 0 = message envelope
+    (:func:`hyperdrive_tpu.messages.marshal_message`, signatures
+    included), 1 = height reset (height + signatory list) — then the
+    4-byte-length-framed body.
+    """
+
+    KIND_MSG = 0
+    KIND_RESET = 1
+
+    def __init__(self):
+        self.frames: list[bytes] = []
+
+    def record(self, msg) -> None:
+        from hyperdrive_tpu.replica import ResetHeight
+
+        if isinstance(msg, ResetHeight):
+            w = Writer()
+            w.i64(msg.height)
+            w.u32(len(msg.signatories))
+            for s in msg.signatories:
+                w.raw(s)
+            self.frames.append(
+                bytes([self.KIND_RESET]) + _LEN.pack(len(w.data()))
+                + w.data()
+            )
+            return
+        w = Writer()
+        marshal_message(msg, w)
+        self.frames.append(
+            bytes([self.KIND_MSG]) + _LEN.pack(len(w.data())) + w.data()
+        )
+
+    def dump(self, path) -> None:
+        with open(path, "wb") as f:
+            for frame in self.frames:
+                f.write(frame)
+
+    @staticmethod
+    def load(path) -> list:
+        """Decode a dumped flight log back into input objects (messages
+        and :class:`~hyperdrive_tpu.replica.ResetHeight`), in recorded
+        order.
+
+        A partial trailing frame — the expected shape when the recording
+        process was killed mid-write, which is precisely the run worth
+        replaying — ends the log cleanly: the intact prefix is returned.
+        A corrupt frame BODY (unknown kind, malformed envelope) still
+        raises SerdeError; truncation is survivable, corruption is not.
+        """
+        from hyperdrive_tpu.replica import ResetHeight
+
+        out = []
+        with open(path, "rb") as f:
+            data = f.read()
+        off = 0
+        n = len(data)
+        while off < n:
+            if n - off < 5:
+                break  # partial header: killed mid-write
+            kind = data[off]
+            (length,) = _LEN.unpack(data[off + 1 : off + 5])
+            body = data[off + 5 : off + 5 + length]
+            if len(body) != length:
+                break  # partial body: killed mid-write
+            off += 5 + length
+            if kind == FlightRecorder.KIND_MSG:
+                out.append(unmarshal_message(Reader(body)))
+            elif kind == FlightRecorder.KIND_RESET:
+                r = Reader(body)
+                height = r.i64()
+                sigs = tuple(r.raw() for _ in range(r.u32()))
+                out.append(ResetHeight(height, sigs))
+            else:
+                raise SerdeError(f"unknown flight record kind {kind}")
+        return out
+
+
+def replay_flight(path, replica) -> None:
+    """Re-drive a fresh replica through a dumped flight log, offline.
+
+    ``replica`` must be built with the same deterministic DI set the
+    recorded run used (proposer, validator, committer semantics, same
+    signatory whitelist and, for signed runs, an equivalent verifier —
+    the log holds raw pre-verification inputs, signatures included).
+    Broadcasts during replay go wherever the fresh replica's broadcaster
+    points (a no-op or a sink: every self-delivered broadcast the live
+    run consumed is already IN the log); timers may be None — recorded
+    Timeout events stand in for the wall clock.
+    """
+    replica.start()
+    for msg in FlightRecorder.load(path):
+        replica.handle(msg)
 
 
 class TcpBroadcaster:

@@ -1,0 +1,196 @@
+"""DeviceTallyFlusher: the deployment (n=1) vote-grid flush behind a
+replica's own event loop (hyperdrive_tpu/tallyflush.py).
+
+The sim certifies aggregated multi-replica settles; these tests certify
+the per-replica composition a deployment runs: drain -> verify -> insert
+(+ grid scatter) -> ONE tally launch -> cascade on device counts, with
+every device-sourced count cross-checked against the host counters.
+Reference integration shape: /root/reference/replica/replica_test.go.
+"""
+
+import hashlib
+
+import pytest
+
+from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+from hyperdrive_tpu.replica import Replica, ReplicaOptions
+from hyperdrive_tpu.tallyflush import DeviceTallyFlusher
+from hyperdrive_tpu.testutil import (
+    CommitterCallback,
+    MockProposer,
+    MockValidator,
+)
+from hyperdrive_tpu.types import INVALID_ROUND
+from hyperdrive_tpu.verifier import NullVerifier
+
+N = 4
+SIGS = [bytes([i + 1]) * 32 for i in range(N)]
+
+
+def _value(height, round_):
+    return hashlib.sha256(b"flushval-%d-%d" % (height, round_)).digest()
+
+
+class _Loopback:
+    """Broadcaster wired straight back into the replica — the Broadcaster
+    contract includes self-delivery, and handle()'s reentrancy buffer
+    serializes it (the moral inbox hop)."""
+
+    def __init__(self):
+        self.rep = None
+
+    def broadcast_propose(self, m):
+        self.rep.handle(m)
+
+    broadcast_prevote = broadcast_precommit = broadcast_propose
+
+
+def _build(flusher=None, commits=None):
+    lb = _Loopback()
+    rep = Replica(
+        ReplicaOptions(),
+        whoami=SIGS[0],
+        signatories=list(SIGS),
+        timer=None,
+        proposer=MockProposer(fn=_value),
+        validator=MockValidator(ok=True),
+        committer=CommitterCallback(
+            on_commit=lambda h, v: (commits.__setitem__(h, v), (0, None))[1]
+        ),
+        catcher=None,
+        broadcaster=lb,
+        verifier=NullVerifier() if flusher is None else None,
+        flusher=flusher,
+    )
+    lb.rep = rep
+    return rep
+
+
+def _script(heights):
+    """The other three validators' messages for a clean run of
+    ``heights`` heights, round 0 each: proposer is (h+0) % N, replica 0's
+    own votes self-deliver via the loopback."""
+    msgs = []
+    for h in range(1, heights + 1):
+        proposer = SIGS[h % N]
+        v = _value(h, 0)
+        if proposer != SIGS[0]:
+            msgs.append(Propose(height=h, round=0,
+                                valid_round=INVALID_ROUND, value=v,
+                                sender=proposer))
+        for s in SIGS[1:]:
+            msgs.append(Prevote(height=h, round=0, value=v, sender=s))
+        for s in SIGS[1:]:
+            msgs.append(Precommit(height=h, round=0, value=v, sender=s))
+    return msgs
+
+
+def test_flusher_drives_commits_counts_checked():
+    """Three heights through the flusher seam: device tally counts are
+    consulted (hits > 0), every one equals the host counters
+    (CheckedTallyView raises otherwise), and the committed chain equals a
+    plain host replica fed the identical script."""
+    views = []
+
+    def check(view, proc):
+        cv = CheckedTallyView(view, proc)
+        views.append(cv)
+        return cv
+
+    commits_dev: dict = {}
+    fl = DeviceTallyFlusher(NullVerifier(), SIGS, tally_check=check)
+    fl.warmup()
+    rep_dev = _build(flusher=fl, commits=commits_dev)
+    commits_host: dict = {}
+    rep_host = _build(commits=commits_host)
+
+    rep_dev.start()
+    rep_host.start()
+    for m in _script(3):
+        rep_dev.handle(m)
+        rep_host.handle(m)
+
+    assert set(commits_dev) == {1, 2, 3}
+    assert commits_dev == commits_host
+    assert commits_dev[2] == _value(2, 0)
+    assert fl.launches > 0
+    assert sum(v.hits for v in views) > 0
+
+
+def test_flusher_resets_grid_across_heights():
+    """The grid plane resets when the height moves: votes for height 2
+    tally from a clean plane (stale height-1 rows would otherwise
+    inflate counts — CheckedTallyView would catch the divergence)."""
+    commits: dict = {}
+    fl = DeviceTallyFlusher(
+        NullVerifier(), SIGS,
+        tally_check=lambda view, proc: CheckedTallyView(view, proc),
+    )
+    rep = _build(flusher=fl, commits=commits)
+    rep.start()
+    for m in _script(2):
+        rep.handle(m)
+    assert set(commits) == {1, 2}
+
+
+def test_flusher_rejected_votes_never_reach_grid():
+    """A verifier rejecting one sender's votes: the automaton never sees
+    them, the grid never scatters them, quorum still reached via the
+    other 2f+1 — and counts still host-equal."""
+
+    class _RejectOne:
+        def verify_batch(self, window):
+            return [m.sender != SIGS[3] for m in window]
+
+    commits: dict = {}
+    fl = DeviceTallyFlusher(
+        _RejectOne(), SIGS,
+        tally_check=lambda view, proc: CheckedTallyView(view, proc),
+    )
+    rep = _build(flusher=fl, commits=commits)
+    rep.start()
+    for m in _script(2):
+        rep.handle(m)
+    assert set(commits) == {1, 2}
+    # The rejected sender's votes are absent from the host logs too.
+    assert SIGS[3] not in rep.proc.state.prevote_logs.get(0, {})
+
+
+def test_flusher_unknown_sender_poisons_round():
+    """A whitelisted sender missing from the grid's validator axis
+    (post-rotation shape): its rounds go dirty, the view declines them,
+    the host counters stay authoritative, consensus still commits."""
+    stranger = bytes([9]) * 32
+    commits: dict = {}
+    fl = DeviceTallyFlusher(
+        NullVerifier(), SIGS,
+        tally_check=lambda view, proc: CheckedTallyView(view, proc),
+    )
+    rep = _build(flusher=fl, commits=commits)
+    rep.procs_allowed.add(stranger)
+    rep.start()
+    v = _value(1, 0)
+    rep.handle(Prevote(height=1, round=0, value=v, sender=stranger))
+    assert (0, 0) in fl._dirty
+    for m in _script(1):
+        rep.handle(m)
+    assert set(commits) == {1}
+
+
+@pytest.mark.parametrize("heights", [2])
+def test_coalesced_threaded_drive_matches_sync(heights):
+    """handle_coalesced (the burst inbox drive run() uses under
+    coalesce=True) commits the same chain as per-message handle()."""
+    commits_a: dict = {}
+    rep_a = _build(commits=commits_a)
+    rep_a.start()
+    script = _script(heights)
+    for m in script:
+        rep_a.handle(m)
+
+    commits_b: dict = {}
+    rep_b = _build(commits=commits_b)
+    rep_b.start()
+    rep_b.handle_coalesced(script)
+    assert commits_a == commits_b and set(commits_a) == {1, 2}

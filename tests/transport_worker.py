@@ -1,17 +1,36 @@
 """Worker + shared builders for the loopback-TCP Broadcaster demo.
 
-``python tests/transport_worker.py <portA> <portB> <rank> <target>``
-hosts replicas {0,1} (rank 0) or {2,3} (rank 1) of a 4-validator network
-on a :class:`hyperdrive_tpu.transport.TcpNode`, with real wall-clock
-LinearTimer timeouts and signed messages verified per replica — consensus
-across an OS process boundary with no shared memory. Prints
-``TRANSPORT_OK rank=<r> heights=<target> digest=<sha256>`` where the
-digest covers the (identical) commit chains of both local replicas; the
-parent test asserts the digests agree ACROSS processes.
+``python tests/transport_worker.py <portA> <portB> <rank> <target>
+[mode]`` hosts replicas {0,1} (rank 0) or {2,3} (rank 1) of a
+4-validator network on a :class:`hyperdrive_tpu.transport.TcpNode`, with
+real wall-clock LinearTimer timeouts and signed messages verified per
+replica — consensus across an OS process boundary with no shared memory.
+Prints ``TRANSPORT_OK rank=<r> heights=<target> digest=<sha256>`` where
+the digest covers the (identical) commit chains of both local replicas;
+the parent test asserts the digests agree ACROSS processes.
+
+``mode`` selects the verification stack:
+
+- ``host`` (default): :class:`~hyperdrive_tpu.verifier.HostVerifier`
+  per replica, no device involvement — pure host-code worker.
+- ``tpu``: the deployment capstone. Every delivered envelope is
+  verified through :class:`~hyperdrive_tpu.ops.ed25519_wire.
+  TpuWireVerifier` with a resident ValidatorTable (the grouped
+  69 B/lane challenge format: device SHA-512 + mod-L + decompression +
+  ladder), and every replica's quorum counts come from its own n=1
+  device vote grid (:class:`~hyperdrive_tpu.tallyflush.
+  DeviceTallyFlusher`) with each device-sourced count cross-checked
+  against the host counters (CheckedTallyView). The output line gains
+  ``consulted=<device counts read> grouped=<69B-format lanes>``. This
+  composes automaton + LinearTimer + TCP Broadcaster + TPU wire
+  verifier + device vote grids in ONE multi-process run — the
+  reference's full-network integration shape
+  (/root/reference/replica/replica_test.go:372-430) on this
+  framework's deployment stack.
 
 The builders are imported by tests/test_transport.py for the in-process
-4-node variant; this module must not import jax (the transport layer is
-pure host code, and worker startup stays fast).
+4-node variant; in host mode this module must not import jax (the
+transport layer is pure host code, and worker startup stays fast).
 """
 
 from __future__ import annotations
@@ -41,11 +60,14 @@ def deterministic_value(height, round_):
 
 def build_replica(node: TcpNode, ring: KeyRing, i: int, target: int,
                   commits: dict, done: threading.Event,
-                  timeout_s: float = 5.0) -> Replica:
+                  timeout_s: float = 5.0, verifier=None,
+                  flusher=None, recorder=None) -> Replica:
     """One threaded replica wired to the node: TcpBroadcaster (signing),
-    LinearTimer (real wall-clock timeout threads), HostVerifier (every
-    delivered message's signature checked), commit hook recording into
-    ``commits`` and firing ``done`` at the target height."""
+    LinearTimer (real wall-clock timeout threads), a Verifier (every
+    delivered message's signature checked; HostVerifier by default),
+    commit hook recording into ``commits`` and firing ``done`` at the
+    target height. ``flusher`` plugs a device-tally flush delegate into
+    the replica's flush seam (tpu mode)."""
     cell: dict = {}
     timer = LinearTimer(
         handle_timeout_propose=lambda t: cell["r"].timeout(t),
@@ -70,7 +92,9 @@ def build_replica(node: TcpNode, ring: KeyRing, i: int, target: int,
         committer=CommitterCallback(on_commit=on_commit),
         catcher=None,
         broadcaster=TcpBroadcaster(node, keypair=ring[i]),
-        verifier=HostVerifier(),
+        verifier=verifier if verifier is not None else HostVerifier(),
+        flusher=flusher,
+        recorder=recorder,
     )
     cell["r"] = rep
     node.add_replica(rep)
@@ -78,19 +102,39 @@ def build_replica(node: TcpNode, ring: KeyRing, i: int, target: int,
 
 
 def run_local_replicas(node: TcpNode, ring: KeyRing, indices, target: int,
-                       deadline_s: float = 120.0):
+                       deadline_s: float = 120.0, timeout_s: float = 5.0,
+                       make_stack=None, coalesce: bool = False,
+                       recorders: dict | None = None):
     """Run the given replica indices on ``node`` until every one commits
     ``target`` heights (or the deadline passes). Returns {index: commits}.
+
+    ``make_stack(i) -> (verifier, flusher)`` supplies each replica's
+    verification stack (tpu mode); ``coalesce`` batches each replica's
+    inbox drains so a device-backed stack pays one launch per burst.
+    ``recorders`` (a dict the caller owns) attaches a FlightRecorder per
+    replica index — the socket run's offline-replay record, populated
+    even when the run stalls (that is when you need it).
     """
     commits = {i: {} for i in indices}
     dones = {i: threading.Event() for i in indices}
-    reps = [
-        build_replica(node, ring, i, target, commits[i], dones[i])
-        for i in indices
-    ]
+    reps = []
+    for i in indices:
+        verifier = flusher = None
+        if make_stack is not None:
+            verifier, flusher = make_stack(i)
+        recorder = None
+        if recorders is not None:
+            from hyperdrive_tpu.transport import FlightRecorder
+
+            recorder = recorders[i] = FlightRecorder()
+        reps.append(
+            build_replica(node, ring, i, target, commits[i], dones[i],
+                          timeout_s=timeout_s, verifier=verifier,
+                          flusher=flusher, recorder=recorder)
+        )
     stop = threading.Event()
     threads = [
-        threading.Thread(target=r.run, args=(stop,), daemon=True)
+        threading.Thread(target=r.run, args=(stop, coalesce), daemon=True)
         for r in reps
     ]
     node.start()
@@ -119,17 +163,89 @@ def commits_digest(commits_by_index: dict) -> str:
     return hashlib.sha256(repr(chains[0]).encode()).hexdigest()
 
 
+def build_tpu_stacks(ring, collector: list):
+    """The tpu-mode verification stack: ONE shared TpuWireVerifier
+    (resident ValidatorTable, grouped challenge format) for the process,
+    one DeviceTallyFlusher (n=1 device vote grid) per replica, every
+    device-sourced count cross-checked via CheckedTallyView instances
+    appended to ``collector``. Imports jax lazily — host mode must not
+    pay for it."""
+    from hyperdrive_tpu.ops.ed25519_wire import (
+        TpuWireVerifier,
+        ValidatorTable,
+    )
+    from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+    from hyperdrive_tpu.tallyflush import DeviceTallyFlusher
+
+    n = len(ring.signatories)
+    table = ValidatorTable([ring[i].public for i in range(n)])
+    # One 64-lane bucket: a 4-validator window never exceeds it, and on
+    # the 1-core CI host every extra bucket is another multi-second
+    # XLA compile (or AOT load) per worker process at warmup.
+    wv = TpuWireVerifier(buckets=(64,), table=table, backend="xla")
+
+    def check(view, proc):
+        v = CheckedTallyView(view, proc)
+        collector.append(v)
+        return v
+
+    def make_stack(i):
+        fl = DeviceTallyFlusher(
+            wv, list(ring.signatories), tally_check=check
+        )
+        # Compiles happen at boot, not inside the first consensus round
+        # where they would read as network stalls and fire timeouts.
+        fl.warmup()
+        return wv, fl
+
+    return wv, make_stack
+
+
 def main() -> None:
     port_a, port_b, rank, target = (
         int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
         int(sys.argv[4]),
     )
+    mode = sys.argv[5] if len(sys.argv) > 5 else "host"
     my_port = (port_a, port_b)[rank]
     peer_port = (port_a, port_b)[1 - rank]
     ring = KeyRing.deterministic(4, namespace=b"tcp-demo")
     node = TcpNode(listen_port=my_port)
     node.add_peer("127.0.0.1", peer_port)
     indices = (0, 1) if rank == 0 else (2, 3)
+    if mode == "tpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import jax
+
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(
+                    os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    ".jax_cache",
+                ),
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 2.0
+            )
+        except Exception:
+            pass  # cache is an optimization, never a requirement
+        views: list = []
+        wv, make_stack = build_tpu_stacks(ring, views)
+        commits = run_local_replicas(
+            node, ring, indices, target, deadline_s=420.0, timeout_s=20.0,
+            make_stack=make_stack, coalesce=True,
+        )
+        digest = commits_digest(commits)
+        consulted = sum(v.hits for v in views)
+        print(
+            f"TRANSPORT_OK rank={rank} heights={target} digest={digest} "
+            f"mode=tpu consulted={consulted} "
+            f"grouped={wv.stats['lanes_grouped']}",
+            flush=True,
+        )
+        return
     commits = run_local_replicas(node, ring, indices, target)
     digest = commits_digest(commits)
     print(
