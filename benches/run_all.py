@@ -762,9 +762,9 @@ def config_7() -> dict:
           crossover-routed device-tally mode, alternating blocks;
       (c) the grid memory budget at this scale (computed from the live
           grid's dtypes, not hand-derived).
-    Sharded-consensus CORRECTNESS at 512 validators runs in the test
-    suite on the 8-device CPU mesh
-    (tests/test_harness.py::test_device_tally_sharded_512_validators).
+    Sharded-consensus CORRECTNESS at 512 and 1024 validators (signed)
+    runs in the test suite on the 8-device CPU mesh
+    (tests/test_harness.py::test_device_tally_sharded_at_scale).
     """
     from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
     from hyperdrive_tpu.verifier import AdaptiveVerifier, HostVerifier

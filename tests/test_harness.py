@@ -487,14 +487,27 @@ def test_device_tally_sharded_mesh_consensus():
     assert sharded.steps == single.steps == host.steps
 
 
-def test_device_tally_sharded_512_validators():
-    # The >256-validator operating point (SURVEY §5's scaling story):
-    # the vote grid's validator axis sharded 8 ways — 64 validator lanes
-    # per device — drives a full 512-replica consensus with every
-    # device-sourced count checked equal to the host counters and the
-    # commit maps identical to a pure host run. Unsigned: the signature
-    # pipeline has its own 512-lane coverage (bench config 7); this test
-    # isolates the sharded-grid correctness at scale.
+@pytest.mark.parametrize(
+    "n,target,seed,sign,max_steps",
+    [
+        # Unsigned point: isolates sharded-grid correctness from the
+        # signature pipeline (so a 512-scale failure is attributable).
+        pytest.param(512, 2, 71, False, 50_000_000, id="512-unsigned"),
+        # Signed points: signature pipeline + sharded grid + automaton
+        # composed at scale (VERDICT r4 #4). At 1024 the grid alone is
+        # ~277 MB at R=4 (4x BENCH.md config 7's grid_bytes_sim_512
+        # row — published there as grid_bytes_sim_1024), so one height
+        # bounds the wall time.
+        pytest.param(512, 2, 71, True, 50_000_000, id="512-signed"),
+        pytest.param(1024, 1, 72, True, 100_000_000, id="1024-signed"),
+    ],
+)
+def test_device_tally_sharded_at_scale(n, target, seed, sign, max_steps):
+    # The >256-validator operating points (SURVEY §5's scaling story):
+    # the vote grid's validator axis sharded 8 ways drives a full
+    # n-replica consensus with every device-sourced count checked equal
+    # to the host counters and the commit maps identical to a pure host
+    # run.
     import jax
 
     from hyperdrive_tpu.ops.votegrid import CheckedTallyView
@@ -503,14 +516,14 @@ def test_device_tally_sharded_512_validators():
     if len(jax.devices()) < 8:
         pytest.skip("needs the 8-device virtual CPU platform")
     mesh = make_mesh(devices=jax.devices()[:8], hr=1)
-    kw = dict(n=512, target_height=2, seed=71, burst=True)
+    kw = dict(n=n, target_height=target, seed=seed, burst=True, sign=sign)
     sharded = Simulation(
         **kw, device_tally=True, tally_mesh=mesh,
         tally_check=CheckedTallyView,
-    ).run(max_steps=50_000_000)
+    ).run(max_steps=max_steps)
     assert sharded.completed, f"stalled at {sharded.heights}"
     sharded.assert_safety()
-    host = Simulation(**kw).run(max_steps=50_000_000)
+    host = Simulation(**kw).run(max_steps=max_steps)
     assert sharded.commits == host.commits
     assert sharded.steps == host.steps
 
