@@ -1128,6 +1128,31 @@ class Simulation:
                 for (i, w), keep in zip(windows, keeps):
                     self.replicas[i].dispatch_window(w, keep)
                 continue
+            if self.device_tally and self._fused_min_window:
+                total = sum(len(w) for _, w in windows)
+                if total < self._fused_min_window:
+                    # Sub-crossover settle on the per-delivery / straggler
+                    # path (adversarial reorder collapses windows to 1-2
+                    # messages — BENCH.md config 8): the host finishes
+                    # verify + cascade before one device round trip would
+                    # return, so verification is forced to host too (the
+                    # shared-lane router's rule) and the grid slots these
+                    # windows' votes would have filled are poisoned.
+                    # Without this, every tiny settle paid an
+                    # update_and_tally launch the fused-path router could
+                    # never see (measured 8.8x the host leg's wall in the
+                    # adversarial regime).
+                    for i, w in windows:
+                        touched = self._touched_slots(w)
+                        if touched:
+                            self._poison_grid(i, touched)
+                    self.tracer.observe("sim.settle.host_routed", total)
+                    keeps = self._verify_windows(
+                        windows, shared_window, force_host=True
+                    )
+                    for (i, w), keep in zip(windows, keeps):
+                        self.replicas[i].dispatch_window(w, keep)
+                    continue
             keeps = self._verify_windows(windows, shared_window)
             if self.device_tally:
                 self._dispatch_tallied(windows, keeps)
@@ -1201,9 +1226,17 @@ class Simulation:
                 w = shared_capped.get(cur)
                 if w is None:
                     d = dropped_at(cur)
-                    w = shared_capped[cur] = [
-                        m for m in shared if id(m) not in d
-                    ]
+                    # When the per-sender cap drops nothing (n senders,
+                    # few messages each — every network above
+                    # max_capacity validators), the capped list IS the
+                    # shared list: reuse it, preserving the identity the
+                    # fused settle's eligibility check reads. A copy here
+                    # silently demoted every >1000-validator lockstep
+                    # settle to the two-launch path.
+                    w = shared_capped[cur] = (
+                        shared if not d
+                        else [m for m in shared if id(m) not in d]
+                    )
                 windows.append((i, w))
                 continue
             d = dropped_at(cur)
@@ -1237,38 +1270,48 @@ class Simulation:
         complete; untouched rounds stay live on the grid). A vote-free
         window poisons nothing — there is nothing the grid could miss
         (mirroring _dispatch_fused's vote-free skip)."""
-        grid_r = self.vote_grid.R
-        touched = set()
-        for m in shared_window:
-            t = type(m)
-            if t is Prevote or t is Precommit:
-                rnd = m.round
-                if 0 <= rnd < grid_r:
-                    # (Out-of-window rounds never scatter and TallyView
-                    # never serves them — no poison needed.)
-                    touched.add((1 if t is Precommit else 0, rnd))
+        touched = self._touched_slots(shared_window)
         if touched:
-            all_pairs = [(p, r) for p in (0, 1) for r in range(grid_r)]
             for i, _ in windows:
-                h = self.replicas[i].current_height()
-                if self._grid_height[i] != h:
-                    # The grid was never reset for this height: its rows
-                    # are stale for EVERY round, and claiming the height
-                    # here (so the next fused settle does not reset-and-
-                    # clear the poison) means no zeroing will happen —
-                    # poison the whole height.
-                    self._grid_height[i] = h
-                    self._grid_dirty[i] = set(all_pairs)
-                else:
-                    # Grid live at this height: only the slots this
-                    # window's votes would have filled are now missing;
-                    # untouched rounds' counts remain complete and
-                    # servable.
-                    self._grid_dirty[i].update(touched)
+                self._poison_grid(i, touched)
         self.tracer.observe("sim.settle.host_routed", len(shared_window))
         keeps = self._verify_windows(windows, shared_window, force_host=True)
         for (i, w), keep in zip(windows, keeps):
             self.replicas[i].dispatch_window(w, keep)
+
+    def _touched_slots(self, msgs) -> set:
+        """The (plane, round) grid slots a window's votes would fill —
+        what a host-routed settle must poison. Out-of-window rounds never
+        scatter and TallyView never serves them, so they need no poison."""
+        grid_r = self.vote_grid.R
+        touched = set()
+        for m in msgs:
+            t = type(m)
+            if t is Prevote or t is Precommit:
+                rnd = m.round
+                if 0 <= rnd < grid_r:
+                    touched.add((1 if t is Precommit else 0, rnd))
+        return touched
+
+    def _poison_grid(self, i, touched) -> None:
+        """Mark replica ``i``'s grid slots missing after a host-routed
+        settle (``touched``: non-empty set of (plane, round) pairs its
+        window's votes would have filled)."""
+        h = self.replicas[i].current_height()
+        if self._grid_height[i] != h:
+            # The grid was never reset for this height: its rows are
+            # stale for EVERY round, and claiming the height here (so
+            # the next fused settle does not reset-and-clear the poison)
+            # means no zeroing will happen — poison the whole height.
+            self._grid_height[i] = h
+            self._grid_dirty[i] = {
+                (p, r) for p in (0, 1) for r in range(self.vote_grid.R)
+            }
+        else:
+            # Grid live at this height: only the slots this window's
+            # votes would have filled are now missing; untouched rounds'
+            # counts remain complete and servable.
+            self._grid_dirty[i].update(touched)
 
     def _verify_windows(self, windows, shared_window=None,
                         force_host: bool = False) -> list:

@@ -570,6 +570,67 @@ def test_device_tally_fused_single_launch_pipeline():
     assert fres.record.messages == unfused.record.messages
 
 
+def test_fused_engages_when_network_exceeds_per_sender_capacity():
+    # Regression (BENCH.md config 8's 1024-storm diagnosis): when the
+    # superstep's shared lane exceeds max_capacity but the PER-SENDER cap
+    # drops nothing (n senders, one broadcast each — every network larger
+    # than max_capacity validators), the capped window must stay the
+    # shared list ITSELF. A copy here broke the fused settle's identity
+    # eligibility and silently demoted >1000-validator lockstep settles
+    # to the two-launch path.
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+    kw = dict(n=16, target_height=2, seed=91, sign=True, burst=True,
+              max_capacity=8)  # shared lane (16 votes) > cap, 0 dropped
+    fused = Simulation(
+        **kw,
+        batch_verifier=TpuBatchVerifier(buckets=(16, 64)),
+        dedup_verify=True,
+        device_tally=True,
+    )
+    fres = fused.run()
+    assert fres.completed, f"stalled at {fres.heights}"
+    fres.assert_safety()
+    hists = fused.tracer.snapshot()["histograms"]
+    assert hists.get("sim.fused.sync_s", {}).get("count", 0) > 0, (
+        "capacity-capped lockstep settle never fused"
+    )
+    host = Simulation(**kw).run()
+    assert fres.commits == host.commits
+    assert fres.steps == host.steps
+
+
+def test_routed_tally_protects_serialized_reorder_settles():
+    # Regression (BENCH.md config 8's adversarial negative): under
+    # adversarial reorder the shared superstep is off and settle windows
+    # collapse to 1-2 messages; the crossover router must protect the
+    # UNFUSED device-tally path too — tiny settles dispatch on host with
+    # the grid poisoned, paying zero grid round trips, trajectory
+    # identical to the host run.
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+    kw = dict(n=7, target_height=2, seed=93, sign=True, burst=True,
+              reorder=True)
+    routed = Simulation(
+        **kw,
+        batch_verifier=TpuBatchVerifier(buckets=(16, 64)),
+        dedup_verify=True,
+        device_tally=True,
+        fused_min_window=10_000,
+    )
+    rres = routed.run(max_steps=2_000_000)
+    assert rres.completed, f"stalled at {rres.heights}"
+    rres.assert_safety()
+    hists = routed.tracer.snapshot()["histograms"]
+    assert hists["sim.settle.host_routed"]["count"] > 0
+    assert "sim.tally.launch" not in hists, (
+        "a sub-crossover reorder settle still paid a grid launch"
+    )
+    host = Simulation(**kw).run(max_steps=2_000_000)
+    assert rres.commits == host.commits
+    assert rres.steps == host.steps
+
+
 def test_fused_min_window_routes_every_settle_to_host():
     # Crossover routing, threshold above any window: no fused launch ever
     # fires, every settle is handled on host — and the run is trajectory-

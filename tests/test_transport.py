@@ -12,8 +12,6 @@ import socket
 import subprocess
 import sys
 
-import pytest
-
 from hyperdrive_tpu.codec import Reader, Writer
 from hyperdrive_tpu.crypto.keys import KeyRing
 from hyperdrive_tpu.messages import Prevote, marshal_message
