@@ -1129,8 +1129,15 @@ class Simulation:
                     self.replicas[i].dispatch_window(w, keep)
                 continue
             if self.device_tally and self._fused_min_window:
-                total = sum(len(w) for _, w in windows)
-                if total < self._fused_min_window:
+                # UNIQUE broadcasts, not per-receiver deliveries: the
+                # crossover floor is calibrated in unique signatures (the
+                # host verify cost under dedup), and the shared-lane
+                # branch compares the same unit (len(shared_window)).
+                # Duplicate-counted totals would stop the route engaging
+                # once n receivers alone exceeded the floor — the exact
+                # pathology this branch removes, one doubling up.
+                uniq = len({id(m) for _, w in windows for m in w})
+                if uniq < self._fused_min_window:
                     # Sub-crossover settle on the per-delivery / straggler
                     # path (adversarial reorder collapses windows to 1-2
                     # messages — BENCH.md config 8): the host finishes
@@ -1146,7 +1153,7 @@ class Simulation:
                         touched = self._touched_slots(w)
                         if touched:
                             self._poison_grid(i, touched)
-                    self.tracer.observe("sim.settle.host_routed", total)
+                    self.tracer.observe("sim.settle.host_routed", uniq)
                     keeps = self._verify_windows(
                         windows, shared_window, force_host=True
                     )
