@@ -1128,7 +1128,13 @@ class Simulation:
                 for (i, w), keep in zip(windows, keeps):
                     self.replicas[i].dispatch_window(w, keep)
                 continue
-            if self.device_tally and self._fused_min_window:
+            if self.device_tally and self._fused_min_window and not (
+                # A single window never holds the same object twice, so
+                # any window at/above the floor proves uniq >= floor
+                # without building the id-set — the common (big-settle)
+                # case stays O(#windows).
+                max(len(w) for _, w in windows) >= self._fused_min_window
+            ):
                 # UNIQUE broadcasts, not per-receiver deliveries: the
                 # crossover floor is calibrated in unique signatures (the
                 # host verify cost under dedup), and the shared-lane

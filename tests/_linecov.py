@@ -1,0 +1,138 @@
+"""Stdlib line-coverage measurement via sys.monitoring (PEP 669).
+
+The build image has no pytest-cov (and installs are off), but the CI
+coverage gate must be pinned at a MEASURED number, not a floor. This
+plugin measures statement coverage of ``hyperdrive_tpu/`` with the
+Python 3.12 monitoring API at near-zero overhead — every line callback
+DISABLEs its own location after the first hit, so steady-state cost is
+one dict probe per never-seen line. Enable with ``HD_LINECOV=1``; the
+report prints one summary line and writes ``linecov.json`` (per-file
+breakdown) at the repo root.
+
+Methodology vs coverage.py: executable lines are the union of
+``co_lines()`` over every code object compiled from each module.
+Docstring/annotation-only lines are attributed slightly differently
+than coverage.py's AST analysis, and subprocess children (the transport
+and multihost workers) are not traced — both hold for a default
+pytest-cov run too, but the absolute number can still differ by a point
+or two, so the CI gate carries a small allowance below the number
+measured here (see .github/workflows/ci.yml).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_TOOL = sys.monitoring.COVERAGE_ID
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_REPO, "hyperdrive_tpu") + os.sep
+_hits: dict[str, set[int]] = {}
+_engaged = False
+
+
+def _on_line(code, line):
+    f = code.co_filename
+    if f.startswith(_PKG):
+        _hits.setdefault(f, set()).add(line)
+    return sys.monitoring.DISABLE
+
+
+def start() -> None:
+    global _engaged
+    try:
+        sys.monitoring.use_tool_id(_TOOL, "hd-linecov")
+    except ValueError:
+        # Another coverage tool owns the slot (e.g. coverage.py with
+        # COVERAGE_CORE=sysmon); defer to it. report() then refuses to
+        # publish — an all-zero artifact would masquerade as a
+        # measurement.
+        return
+    sys.monitoring.register_callback(
+        _TOOL, sys.monitoring.events.LINE, _on_line
+    )
+    sys.monitoring.set_events(_TOOL, sys.monitoring.events.LINE)
+    _engaged = True
+
+
+def _exec_lines(path: str) -> set[int]:
+    """Executable line numbers: co_lines() of every code object the
+    module compiles to (functions, comprehensions, class bodies)."""
+    with open(path, "rb") as f:
+        src = f.read()
+    lines: set[int] = set()
+    code_t = type(_exec_lines.__code__)
+    stack = [compile(src, path, "exec")]
+    while stack:
+        co = stack.pop()
+        for _, _, ln in co.co_lines():
+            if ln:
+                lines.add(ln)
+        for c in co.co_consts:
+            if isinstance(c, code_t):
+                stack.append(c)
+    return lines
+
+
+_report_cache: dict | None = None
+
+
+def report(write=print) -> "dict | None":
+    global _report_cache
+    if not _engaged:
+        write("HD_LINECOV: not engaged (monitoring slot owned by "
+              "another tool) — no measurement published")
+        return None
+    if _report_cache is not None:
+        return _report_cache
+    per_file = {}
+    tot_exec = tot_hit = 0
+    for root, _dirs, files in os.walk(_PKG):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            ex = _exec_lines(path)
+            hit = _hits.get(path, set()) & ex
+            tot_exec += len(ex)
+            tot_hit += len(hit)
+            rel = os.path.relpath(path, _REPO)
+            per_file[rel] = {
+                "exec": len(ex),
+                "hit": len(hit),
+                "pct": round(100 * len(hit) / len(ex), 1) if ex else 100.0,
+                "missing": sorted(ex - hit)[:200],
+            }
+    pct = round(100 * tot_hit / tot_exec, 2) if tot_exec else 100.0
+    out = {"total_pct": pct, "hit": tot_hit, "exec": tot_exec,
+           "files": per_file}
+    with open(os.path.join(_REPO, "linecov.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    write(
+        f"HD_LINECOV total: {pct}% ({tot_hit}/{tot_exec} lines) "
+        f"-> linecov.json"
+    )
+    _report_cache = out
+    return out
+
+
+def gate_ok(write=print) -> bool:
+    """The coverage GATE: measured total vs the HD_LINECOV_MIN env
+    threshold (same tool that produced the published number, so the
+    gate's unit is exactly the measurement's — no cross-tool
+    allowance). True when no threshold is set, measurement never
+    engaged, or the total meets it."""
+    min_pct = float(os.environ.get("HD_LINECOV_MIN", "0") or 0)
+    if not min_pct or not _engaged:
+        return True
+    out = report(write)
+    if out is None:
+        return True
+    ok = out["total_pct"] >= min_pct
+    if not ok:
+        write(
+            f"HD_LINECOV GATE FAILED: {out['total_pct']}% < "
+            f"{min_pct}% (HD_LINECOV_MIN)"
+        )
+    return ok

@@ -40,6 +40,32 @@ jax.config.update(
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 
+def pytest_configure(config):
+    # Stdlib line-coverage measurement (no pytest-cov in the build
+    # image) — see tests/_linecov.py. Opt-in: HD_LINECOV=1.
+    if os.environ.get("HD_LINECOV"):
+        import _linecov
+
+        _linecov.start()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # The coverage gate (HD_LINECOV_MIN): measured by the SAME tool that
+    # produced the published number, so a regression fails the run.
+    if os.environ.get("HD_LINECOV") and exitstatus == 0:
+        import _linecov
+
+        if not _linecov.gate_ok():
+            session.exitstatus = 1
+
+
+def pytest_terminal_summary(terminalreporter):
+    if os.environ.get("HD_LINECOV"):
+        import _linecov
+
+        _linecov.report(terminalreporter.write_line)
+
+
 @pytest.fixture
 def rng() -> random.Random:
     """Seeded RNG; override the seed with HYPERDRIVE_TEST_SEED for replay."""
