@@ -804,10 +804,11 @@ def config_7() -> dict:
     pipe["sustained_votes_per_s_spread"] = spread(pipe["sustained_trials"])
 
     # (a') a 1024-validator probe through the same harness: the wire
-    # cost per lane is validator-count-invariant (the table is resident;
-    # idx stays 4 bytes), so the sustained rate should hold as the set
-    # doubles again — this records that it does. Shorter (2 launches per
-    # trial): it is a scale point, not the headline.
+    # cost per lane is validator-count-invariant (the table AND the
+    # dense-grid index are resident; the launch ships only R + s), so
+    # the sustained rate should hold as the set doubles again — this
+    # records that it does. Shorter (2 launches per trial): it is a
+    # scale point, not the headline.
     probe_1024 = run_sustained(
         validators=1024, rounds=64, iters=2, trials=3, full_wire=False,
         namespace=b"bench7x1024",

@@ -124,11 +124,21 @@ def gate_ok(write=print) -> bool:
     allowance). True when no threshold is set, measurement never
     engaged, or the total meets it."""
     min_pct = float(os.environ.get("HD_LINECOV_MIN", "0") or 0)
-    if not min_pct or not _engaged:
+    if not min_pct:
         return True
+    if not _engaged:
+        # Threshold explicitly set but the measurement never engaged
+        # (another tool owns the monitoring slot): fail LOUDLY — a
+        # silently no-op'd gate would let real regressions merge green.
+        write(
+            "HD_LINECOV GATE FAILED: HD_LINECOV_MIN is set but the "
+            "monitoring slot was unavailable (another coverage tool owns "
+            "it) — no measurement was taken"
+        )
+        return False
     out = report(write)
     if out is None:
-        return True
+        return False
     ok = out["total_pct"] >= min_pct
     if not ok:
         write(
