@@ -206,3 +206,72 @@ grid_run.assert_safety()
 assert grid_run.commits == host_run.commits
 print(f"PASS: device vote-grid tallies drove consensus to height 4, "
       f"count-identical to host tallies ({grid_run.steps} steps)")
+
+# --- probe 9: deployment flush + flight record/replay ------------------
+# The round-5 deployment composition, embedded the way a node would run
+# it: a replica whose quorum counts come from its own n=1 device vote
+# grid (DeviceTallyFlusher behind the flusher seam), every consumed
+# input flight-recorded, then the log replayed into a fresh replica
+# offline — commit chains identical.
+from hyperdrive_tpu.tallyflush import DeviceTallyFlusher
+from hyperdrive_tpu.transport import FlightRecorder, replay_flight
+from hyperdrive_tpu.types import INVALID_ROUND
+from hyperdrive_tpu.verifier import NullVerifier
+
+_SIGS = [bytes([i + 1]) * 32 for i in range(4)]
+_val = lambda h, r: hashlib.sha256(b"dep-%d-%d" % (h, r)).digest()
+
+
+class _Loop:
+    def broadcast_propose(self, m):
+        self.rep.handle(m)
+    broadcast_prevote = broadcast_precommit = broadcast_propose
+
+
+def _dep_replica(commits, flusher=None, recorder=None):
+    lb = _Loop()
+    rep = Replica(
+        ReplicaOptions(), whoami=_SIGS[0], signatories=list(_SIGS),
+        timer=None, proposer=MockProposer(fn=_val),
+        validator=MockValidator(ok=True),
+        committer=CommitterCallback(
+            on_commit=lambda h, v: (commits.__setitem__(h, v),
+                                    (0, None))[1]),
+        catcher=None, broadcaster=lb if flusher is not None else None,
+        verifier=NullVerifier() if flusher is None else None,
+        flusher=flusher, recorder=recorder,
+    )
+    lb.rep = rep
+    return rep
+
+commits_live: dict = {}
+fl = DeviceTallyFlusher(
+    NullVerifier(), _SIGS, tally_check=CheckedTallyView,
+)
+rec = FlightRecorder()
+live = _dep_replica(commits_live, flusher=fl, recorder=rec)
+live.start()
+from hyperdrive_tpu.messages import Precommit as _Pc, Prevote as _Pv, \
+    Propose as _Pp
+for h in (1, 2):
+    v = _val(h, 0)
+    proposer = live.proc.scheduler.schedule(h, 0)
+    if proposer != _SIGS[0]:
+        live.handle(_Pp(height=h, round=0, valid_round=INVALID_ROUND,
+                        value=v, sender=proposer))
+    for s in _SIGS[1:]:
+        live.handle(_Pv(height=h, round=0, value=v, sender=s))
+    for s in _SIGS[1:]:
+        live.handle(_Pc(height=h, round=0, value=v, sender=s))
+assert set(commits_live) == {1, 2}, commits_live
+assert fl.launches > 0
+
+with tempfile.TemporaryDirectory() as d:
+    p = os.path.join(d, "flight.log")
+    rec.dump(p)
+    commits_replay: dict = {}
+    replay_flight(p, _dep_replica(commits_replay))
+    assert commits_replay == commits_live, "flight replay diverged"
+print(f"PASS: deployment flush (n=1 device grid, {fl.launches} tally "
+      f"launches, counts host-checked) committed 2 heights; flight log "
+      f"replayed to an identical chain offline")

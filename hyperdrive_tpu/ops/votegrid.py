@@ -391,10 +391,11 @@ class VoteGrid:
 
     Past one chip's HBM, ``mesh=`` shards the VALIDATOR axis (SURVEY §5's
     scaling story — scatter rows route by global index, counts psum over
-    the mesh); the 512-validator sharded consensus is exercised on the
-    8-device CPU mesh in tests and benchmarked in BENCH.md config 7.
-    Compacting round slots (R) scales the budget linearly when deep
-    round-skipping windows are not needed.
+    the mesh); SIGNED sharded consensus at 512 and 1024 validators is
+    exercised on the 8-device CPU mesh in tests
+    (test_device_tally_sharded_at_scale) and benchmarked in BENCH.md
+    config 7. Compacting round slots (R) scales the budget linearly when
+    deep round-skipping windows are not needed.
     """
 
     def __init__(self, n_replicas: int, n_validators: int, r_slots: int = 8,
