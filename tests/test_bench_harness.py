@@ -1,0 +1,75 @@
+"""bench.py's sustained harness, smoke-tested in-suite.
+
+The driver runs bench.py on the real chip at round end, and BENCH.md
+config 7 calls the same run_sustained; a harness API breakage would
+otherwise surface only there, after the round's work. This smoke runs
+the full paired-leg pipeline at miniature scale on the CPU platform
+(batch 64 — the bucket every other device test already compiles) and
+checks the self-describing record's contract.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_run_sustained_smoke():
+    import bench
+
+    out = bench.run_sustained(
+        validators=16, rounds=4, iters=1, trials=2, full_wire=False,
+        namespace=b"benchtest",
+    )
+    # The headline leg is the 64 B/lane transfer-floor format.
+    assert out["bytes_per_lane"] == 64
+    assert out["unique_signatures"] is True
+    assert out["sustained_votes_per_s"] > 0
+    assert len(out["sustained_trials"]) == 2
+    # Paired legs all measured, one ratio per trial.
+    assert out["sustained_68_votes_per_s"] > 0
+    assert out["sustained_hosthash_votes_per_s"] > 0
+    assert out["hosthash_bytes_per_lane"] == 100
+    assert len(out["paired_64_over_100_ratios"]) == 2
+    assert all(r > 0 for r in out["paired_64_over_100_ratios"])
+    # Resident-state accounting: table-shaped table bytes, the dense
+    # grid index its own key (4 bytes x batch lanes).
+    assert out["resident_index_bytes"] == 4 * 16 * 4
+    assert out["table_bytes"] > 0
+    assert out["device_only_votes_per_s"] > 0
+    # Pack legs report. (No rate ORDERING asserted: at this miniature
+    # batch, fixed overheads dominate both pack legs and the comparison
+    # is timing noise — the real-scale ordering is a BENCH.md claim,
+    # not a unit-test contract.)
+    assert out["chal_pack_sigs_per_s"] > 0
+    assert out["wire_pack_sigs_per_s"] > 0
+
+
+def test_run_sustained_rejects_tampered_lane(monkeypatch):
+    """The harness must REFUSE to publish a rate over unverified work: a
+    batch with one forged signature fails the pipeline's mask check."""
+    import bench
+    import pytest
+
+    real = bench._build_batches
+
+    def tampered(ring, validators, rounds, iters, namespace):
+        batches, tallies, m_rounds = real(
+            ring, validators, rounds, iters, namespace
+        )
+        pub, digest, sig = batches[0][3]
+        # Flip the LOW byte of S (S +/- 1): stays < L for any derived
+        # signature, so the forgery reaches the device mask check (the
+        # RuntimeError path) rather than tripping the packer's s < L
+        # prevalid gate, whose failure mode is a different exception.
+        batches[0][3] = (
+            pub, digest, sig[:32] + bytes([sig[32] ^ 1]) + sig[33:]
+        )
+        return batches, tallies, m_rounds
+
+    monkeypatch.setattr(bench, "_build_batches", tampered)
+    with pytest.raises(RuntimeError):
+        bench.run_sustained(
+            validators=16, rounds=4, iters=1, trials=1, full_wire=False,
+            namespace=b"benchtest2",
+        )
