@@ -402,6 +402,83 @@ def run_sustained(validators: int = N_VALIDATORS, rounds: int = ROUNDS,
     return out
 
 
+def run_insert_leg(validators: int = N_VALIDATORS, replicas: int = 64,
+                   rounds: int = 2, trials: int = 5):
+    """Host-side automaton INSERT leg: columnar settle fast path
+    (``Process.ingest_insert_cols`` over a shared ``WindowColumns`` view)
+    against the object path (per-replica keep/allowed filter comprehension
+    + ``Process.ingest_insert``), exactly the two code paths
+    ``Replica.ingest_insert_window[_cols]`` dispatches between.
+
+    Pure host Python — no device required — so it is the engine-path
+    metric a CPU-only container can still regenerate honestly. The window
+    is the lockstep settle shape: ``rounds`` full (propose + V prevotes +
+    V precommits) rounds, ingested by ``replicas`` fresh processes per
+    trial (the redundant-settle regime where the columnar view's one-pass
+    extraction amortizes across every replica). Ratios are PAIRED per
+    trial; the headline is their median.
+    """
+    import hashlib
+
+    from hyperdrive_tpu.batch import WindowColumns
+    from hyperdrive_tpu.messages import Precommit, Propose
+    from hyperdrive_tpu.process import Process
+    from hyperdrive_tpu.types import INVALID_ROUND
+
+    senders = [hashlib.sha256(b"ins-%d" % i).digest()
+               for i in range(validators)]
+    allowed = set(senders)
+    window = []
+    for r in range(rounds):
+        v = hashlib.sha256(b"insv-%d" % r).digest()
+        window.append(Propose(height=1, round=r, valid_round=INVALID_ROUND,
+                              value=v, sender=senders[r % validators]))
+        window.extend(Prevote(height=1, round=r, value=v, sender=s)
+                      for s in senders)
+        window.extend(Precommit(height=1, round=r, value=v, sender=s)
+                      for s in senders)
+    keep = [True] * len(window)
+    cols = WindowColumns.from_messages(window)
+    f = (validators - 1) // 3
+    total = replicas * len(window)
+
+    def leg_obj():
+        t0 = time.perf_counter()
+        for _ in range(replicas):
+            p = Process(senders[0], f=f)
+            batch = [m for j, m in enumerate(window)
+                     if keep[j] and m.sender in allowed]
+            p.ingest_insert(batch)
+        return total / (time.perf_counter() - t0)
+
+    def leg_col():
+        t0 = time.perf_counter()
+        for _ in range(replicas):
+            p = Process(senders[0], f=f)
+            p.ingest_insert_cols(cols, keep, allowed)
+        return total / (time.perf_counter() - t0)
+
+    leg_obj(), leg_col()  # warm allocator + bytecode caches
+    obj_rates, col_rates, ratios = [], [], []
+    for _ in range(trials):
+        a = leg_obj()
+        b = leg_col()
+        obj_rates.append(a)
+        col_rates.append(b)
+        ratios.append(b / a)
+    return {
+        "window_rows": len(window),
+        "replicas": replicas,
+        "validators": validators,
+        "trials": trials,
+        "object_rows_per_s": round(float(np.median(obj_rates)), 1),
+        "columnar_rows_per_s": round(float(np.median(col_rates)), 1),
+        "insert_leg_paired_ratios": [round(r, 3) for r in ratios],
+        "insert_leg_speedup_median": round(float(np.median(ratios)), 3),
+        "insert_leg_speedup_min": round(min(ratios), 3),
+    }
+
+
 def main():
     backend = sys.argv[1] if len(sys.argv) > 1 else None
     try:

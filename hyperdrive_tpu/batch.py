@@ -28,7 +28,7 @@ import numpy as np
 from hyperdrive_tpu.messages import Precommit, Prevote, Propose, Timeout
 from hyperdrive_tpu.types import INVALID_ROUND, MessageType
 
-__all__ = ["MESSAGE_DTYPE", "MessageBlock"]
+__all__ = ["MESSAGE_DTYPE", "MessageBlock", "WindowColumns"]
 
 #: One consensus message as a fixed-width structured row.
 MESSAGE_DTYPE = np.dtype(
@@ -124,32 +124,42 @@ class MessageBlock:
         rows["has_sig"] = has_sig
         return cls(rows, payloads)
 
+    def message_at(self, i: int):
+        """Materialize one row into its message object (the lazy unit of
+        :meth:`to_messages`; the columnar settle fast path calls it only
+        for rows the automaton keeps or reports)."""
+        row = self.rows[i]
+        ty = int(row["type"])
+        common = dict(
+            height=int(row["height"]),
+            round=int(row["round"]),
+            value=row["value"].tobytes(),
+            sender=row["sender"].tobytes(),
+        )
+        if ty == int(MessageType.PROPOSE):
+            msg = Propose(
+                valid_round=int(row["valid_round"]),
+                payload=self.payloads.get(i, b""),
+                **common,
+            )
+        elif ty == int(MessageType.PREVOTE):
+            msg = Prevote(**common)
+        else:
+            msg = Precommit(**common)
+        if row["has_sig"]:
+            msg = msg.with_signature(row["signature"].tobytes())
+        return msg
+
     def to_messages(self) -> list:
         """Materialize the rows back into message objects (exact inverse of
         :meth:`from_messages` for well-formed inputs)."""
-        out = []
-        for i, row in enumerate(self.rows):
-            ty = int(row["type"])
-            common = dict(
-                height=int(row["height"]),
-                round=int(row["round"]),
-                value=row["value"].tobytes(),
-                sender=row["sender"].tobytes(),
-            )
-            if ty == int(MessageType.PROPOSE):
-                msg = Propose(
-                    valid_round=int(row["valid_round"]),
-                    payload=self.payloads.get(i, b""),
-                    **common,
-                )
-            elif ty == int(MessageType.PREVOTE):
-                msg = Prevote(**common)
-            else:
-                msg = Precommit(**common)
-            if row["has_sig"]:
-                msg = msg.with_signature(row["signature"].tobytes())
-            out.append(msg)
-        return out
+        return [self.message_at(i) for i in range(len(self.rows))]
+
+    def columns(self) -> "WindowColumns":
+        """A :class:`WindowColumns` view over this block: the columnar
+        ingest entry point for wire-delivered windows — rows flow into the
+        automaton without up-front object materialization."""
+        return WindowColumns.from_block(self)
 
     # -------------------------------------------------------------- digests
 
@@ -259,3 +269,112 @@ class MessageBlock:
                 self.rows["value"][i].view("<i4").astype(np.int32)
             )
         return rounds, vote_vals, present
+
+
+class WindowColumns:
+    """A settle window decomposed into per-row columns plus run segments —
+    the feed of the columnar ingest fast path (``Process.
+    ingest_insert_cols``).
+
+    The object-path hot loop pays per-message attribute access and type
+    dispatch once per (message, replica); a lockstep settle re-pays it for
+    every one of the n replicas sharing the same window. This view hoists
+    that extraction to ONE pass per window: plain Python lists for the
+    fields the insert loop reads (kind tag, height, round, sender, value)
+    and maximal consecutive ``runs`` sharing (kind, height, round), so the
+    per-replica loop fetches its round-log views once per run instead of
+    re-checking per row.
+
+    Message objects stay the log/checkpoint/evidence source of truth, so
+    the fast path still stores them — but via :meth:`msg`, which is a list
+    index when the window already holds objects (:meth:`from_messages`)
+    and lazy row materialization when it came off the wire
+    (:meth:`from_block`): rows the automaton filters out (wrong height,
+    duplicate, unverified) never become objects at all.
+    """
+
+    __slots__ = ("n", "kinds", "heights", "rounds", "senders", "values",
+                 "runs", "msgs", "_block")
+
+    #: Row kind tags — the MessageType wire tags, matching
+    #: ``MESSAGE_DTYPE``'s ``type`` column.
+    KIND_PROPOSE = int(MessageType.PROPOSE)
+    KIND_PREVOTE = int(MessageType.PREVOTE)
+    KIND_PRECOMMIT = int(MessageType.PRECOMMIT)
+
+    def __init__(self, kinds, heights, rounds, senders, values, msgs,
+                 block=None):
+        self.n = len(kinds)
+        self.kinds = kinds
+        self.heights = heights
+        self.rounds = rounds
+        self.senders = senders
+        self.values = values
+        #: Per-row message objects; ``None`` entries materialize lazily
+        #: from ``_block`` on first :meth:`msg` access.
+        self.msgs = msgs
+        self._block = block
+        self.runs = self._segment()
+
+    def _segment(self):
+        """Maximal consecutive (kind, height, round) runs as
+        (kind, height, round, start, end) tuples. Windows arrive (height,
+        round)-sorted so runs are long; adversarial interleavings just
+        degrade to shorter runs with identical semantics (row order inside
+        and across runs is preserved)."""
+        kinds, heights, rounds = self.kinds, self.heights, self.rounds
+        runs = []
+        n = self.n
+        i = 0
+        while i < n:
+            k, h, r = kinds[i], heights[i], rounds[i]
+            j = i + 1
+            while j < n and kinds[j] == k and heights[j] == h \
+                    and rounds[j] == r:
+                j += 1
+            runs.append((k, h, r, i, j))
+            i = j
+        return runs
+
+    @classmethod
+    def from_messages(cls, msgs) -> "WindowColumns":
+        """Columnarize a window of live message objects (the simulator's
+        shared-superstep lane): one extraction pass serves every replica
+        that ingests the window."""
+        kinds = []
+        heights = []
+        rounds = []
+        senders = []
+        values = []
+        for m in msgs:
+            tag = _TYPE_TAG.get(type(m))
+            if tag is None:
+                raise TypeError(f"not a batchable message: {type(m)!r}")
+            kinds.append(tag)
+            heights.append(m.height)
+            rounds.append(m.round)
+            senders.append(m.sender)
+            values.append(m.value)
+        return cls(kinds, heights, rounds, senders, values,
+                   msgs if isinstance(msgs, list) else list(msgs))
+
+    @classmethod
+    def from_block(cls, block: MessageBlock) -> "WindowColumns":
+        """Columnar view over wire rows; message objects materialize only
+        on demand (accepted/equivocating/propose rows)."""
+        rows = block.rows
+        n = len(rows)
+        senders = [s.tobytes() for s in rows["sender"]]
+        values = [v.tobytes() for v in rows["value"]]
+        return cls(
+            rows["type"].tolist(), rows["height"].tolist(),
+            rows["round"].tolist(), senders, values,
+            [None] * n, block=block,
+        )
+
+    def msg(self, i: int):
+        """Row ``i`` as a message object (cached)."""
+        m = self.msgs[i]
+        if m is None:
+            m = self.msgs[i] = self._block.message_at(i)
+        return m

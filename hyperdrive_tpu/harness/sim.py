@@ -33,6 +33,7 @@ import numpy as np
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from hyperdrive_tpu.batch import WindowColumns
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.messages import (
     Precommit,
@@ -346,6 +347,9 @@ class Simulation:
         shared_superstep: Optional[bool] = None,
         small_window_host: Optional[bool] = None,
         fused_min_window: int = 0,
+        columnar_ingest: Optional[bool] = None,
+        pipeline_verify: Optional[bool] = None,
+        route_hysteresis: int = 32,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -513,6 +517,36 @@ class Simulation:
         #: AdaptiveVerifier's measured-crossover insight applied to the
         #: whole settle, not just the verify leg.
         self._fused_min_window = int(fused_min_window)
+        #: Columnar settle fast path: lockstep windows ingest through ONE
+        #: WindowColumns extraction shared by every replica instead of
+        #: per-replica attribute access over message objects
+        #: (Process.ingest_insert_cols). Differential-testing knob like
+        #: ``batch_ingest``: None = auto (on whenever ingestion is
+        #: batched), False forces the per-object window path so parity
+        #: can be asserted run-for-run.
+        self.columnar_ingest = (
+            self.batch_ingest
+            if columnar_ingest is None
+            else bool(columnar_ingest)
+        )
+        if self.columnar_ingest and not self.batch_ingest:
+            raise ValueError("columnar_ingest requires batched ingestion")
+        #: Double-buffered settle (redundant verify mode): chunk the
+        #: pass's windows into replica groups and enqueue group g+1's
+        #: pack+verify launches before fetching group g's mask, so the
+        #: device round trip runs under group g's host insert+cascade.
+        #: None/True = on (it degrades to the serial path when there is
+        #: nothing to overlap), False forces the single-launch schedule.
+        self._pipeline_verify = (
+            True if pipeline_verify is None else bool(pipeline_verify)
+        )
+        #: Router hysteresis window N (0 = off): when >= 95% of the last
+        #: N routed settles went to the host, the grid's per-settle
+        #: poison/scatter upkeep is dropped entirely (the workload is
+        #: host-shaped; upkeep was the remaining device-path tax) and the
+        #: grid rebuilds — claimed at the current height, fully dirty —
+        #: when a fused-sized settle re-engages it.
+        self._route_hyst_n = int(route_hysteresis)
         if device_tally and not (burst and self.batch_ingest):
             raise ValueError(
                 "device_tally requires burst=True with batched ingestion"
@@ -571,6 +605,14 @@ class Simulation:
             )
             self._grid_height = [-1] * n
             self._grid_dirty: list[set] = [set() for _ in range(n)]
+            #: Router hysteresis state: engaged = the grid receives its
+            #: per-settle upkeep (scatter bookkeeping, poison marks).
+            #: Disengaged (a host-shaped run of settles) skips that
+            #: upkeep entirely; _reengage_grid rebuilds before the next
+            #: device-routed settle touches the grid.
+            self._grid_engaged = True
+            self._route_hist: list = []
+            self._route_hyst_thresh = -(-95 * self._route_hyst_n // 100)
             self._sender_pos = {
                 s: v for v, s in enumerate(self.signatories)
             }
@@ -1117,7 +1159,9 @@ class Simulation:
                     # votes).
                     self._route_settle_to_host(windows, shared_window)
                     continue
+                self._reengage_grid()
                 if self._dispatch_fused(shared_window, windows):
+                    self._note_route(False)
                     continue
                 # Vote-free window (the propose settle): verification is
                 # still needed, but there is nothing to scatter or tally —
@@ -1125,8 +1169,7 @@ class Simulation:
                 # first vote-bearing settle) and cascade on host fallback,
                 # whose logs are near-empty this early in the height.
                 keeps = self._verify_windows(windows, shared_window)
-                for (i, w), keep in zip(windows, keeps):
-                    self.replicas[i].dispatch_window(w, keep)
+                self._dispatch_windows(windows, keeps, shared_window)
                 continue
             if self.device_tally and self._fused_min_window and not (
                 # A single window never holds the same object twice, so
@@ -1154,24 +1197,41 @@ class Simulation:
                     # Without this, every tiny settle paid an
                     # update_and_tally launch the fused-path router could
                     # never see (measured 8.8x the host leg's wall in the
-                    # adversarial regime).
-                    for i, w in windows:
-                        touched = self._touched_slots(w)
-                        if touched:
-                            self._poison_grid(i, touched)
+                    # adversarial regime). Under hysteresis disengagement
+                    # the poison upkeep itself is skipped — the grid is
+                    # already marked down for rebuild, and the per-window
+                    # touched-slot scans were the remaining device-path
+                    # tax on a host-shaped workload.
+                    if self._grid_engaged:
+                        for i, w in windows:
+                            touched = self._touched_slots(w)
+                            if touched:
+                                self._poison_grid(i, touched)
+                    else:
+                        self.tracer.count("sim.settle.grid_upkeep_skipped")
+                    self._note_route(True)
                     self.tracer.observe("sim.settle.host_routed", uniq)
                     keeps = self._verify_windows(
                         windows, shared_window, force_host=True
                     )
-                    for (i, w), keep in zip(windows, keeps):
-                        self.replicas[i].dispatch_window(w, keep)
+                    self._dispatch_windows(windows, keeps, shared_window)
                     continue
+            if (
+                self._pipeline_verify
+                and not self.device_tally
+                and not self.dedup_verify
+                and self.batch_verifier is not None
+                and len(windows) > 1
+            ):
+                self._settle_pipelined(windows, shared_window)
+                continue
             keeps = self._verify_windows(windows, shared_window)
             if self.device_tally:
-                self._dispatch_tallied(windows, keeps)
+                self._reengage_grid()
+                self._dispatch_tallied(windows, keeps, shared_window)
+                self._note_route(False)
             else:
-                for (i, w), keep in zip(windows, keeps):
-                    self.replicas[i].dispatch_window(w, keep)
+                self._dispatch_windows(windows, keeps, shared_window)
 
     def _order_key(self, sender) -> int:
         """The sim-level sender tie-break index: whitelist order for
@@ -1282,15 +1342,165 @@ class Simulation:
         the cascade falls back to its host counters, which are always
         complete; untouched rounds stay live on the grid). A vote-free
         window poisons nothing — there is nothing the grid could miss
-        (mirroring _dispatch_fused's vote-free skip)."""
-        touched = self._touched_slots(shared_window)
-        if touched:
-            for i, _ in windows:
-                self._poison_grid(i, touched)
+        (mirroring _dispatch_fused's vote-free skip). While hysteresis
+        has the grid disengaged the poison upkeep is skipped wholesale:
+        the rebuild on re-engage claims every slot dirty anyway."""
+        if self._grid_engaged:
+            touched = self._touched_slots(shared_window)
+            if touched:
+                for i, _ in windows:
+                    self._poison_grid(i, touched)
+        else:
+            self.tracer.count("sim.settle.grid_upkeep_skipped")
+        self._note_route(True)
         self.tracer.observe("sim.settle.host_routed", len(shared_window))
         keeps = self._verify_windows(windows, shared_window, force_host=True)
+        self._dispatch_windows(windows, keeps, shared_window)
+
+    def _note_route(self, host_routed: bool) -> None:
+        """Feed the router hysteresis: one observation per routed settle.
+        A full window of >= 95% host routes disengages grid upkeep; the
+        history only governs disengagement (re-engagement is size-driven,
+        see :meth:`_reengage_grid`), so a disengaged router records
+        nothing."""
+        n = self._route_hyst_n
+        if not n or not self._fused_min_window or not self._grid_engaged:
+            return
+        hist = self._route_hist
+        hist.append(host_routed)
+        if len(hist) > n:
+            del hist[0]
+        elif len(hist) < n:
+            return
+        if sum(hist) >= self._route_hyst_thresh:
+            self._grid_engaged = False
+            hist.clear()
+            self.tracer.count("sim.settle.grid_disengaged")
+
+    def _reengage_grid(self) -> None:
+        """Rebuild the grid bookkeeping before a device-routed settle
+        touches a disengaged grid. The rebuild claims each live replica's
+        CURRENT height with every slot dirty: votes host-routed while
+        disengaged never scattered, so no device count for this height
+        can be trusted (TallyView declines dirty slots and the cascade
+        reads its host fallback); the next height's reset starts the grid
+        clean, and upkeep resumes immediately."""
+        if self._grid_engaged:
+            return
+        all_slots = self.vote_grid.all_slots()
+        for i, r in enumerate(self.replicas):
+            if not self.alive[i]:
+                continue
+            self._grid_height[i] = r.proc.current_height
+            self._grid_dirty[i] = set(all_slots)
+        self._grid_engaged = True
+        self._route_hist.clear()
+        self.tracer.count("sim.settle.grid_reengaged")
+
+    def _dispatch_windows(self, windows, keeps, shared_window) -> None:
+        """Plain (host-cascade) dispatch of a settle pass's windows,
+        riding the columnar fast path for every window that IS the shared
+        lockstep list — one WindowColumns extraction serves all of them.
+        Stragglers (per-replica merged windows) keep the object path."""
+        cols = None
         for (i, w), keep in zip(windows, keeps):
-            self.replicas[i].dispatch_window(w, keep)
+            if self.columnar_ingest and w is shared_window:
+                if cols is None:
+                    cols = WindowColumns.from_messages(shared_window)
+                self.replicas[i].dispatch_window_cols(cols, keep)
+            else:
+                self.replicas[i].dispatch_window(w, keep)
+
+    def _settle_pipelined(self, windows, shared_window) -> None:
+        """Double-buffered redundant settle: verify+dispatch with the
+        windows chunked into replica groups, group g+1's pack+verify
+        launches enqueued BEFORE group g's mask is fetched. The device
+        round trip (the ~100 ms tunnel sync floor of BENCH.md config 8)
+        then runs underneath group g's host insert+cascade instead of
+        serializing ahead of it.
+
+        Shared lockstep windows pack once for the whole pass:
+        ``verify_signatures_begin(items, repeats=len(group))`` re-launches
+        the packed device arrays per receiver copy (every copy is real
+        device verification; no lane is re-packed or re-shipped — the
+        wire layer's pack reuse across buffered windows). Verifiers
+        without an async entry point degrade to per-group synchronous
+        verification — same verdicts, no overlap.
+
+        Only the redundant (non-dedup) path chunks: dedup'd verification
+        is one launch of unique lanes by construction, and the fused
+        device-tally settle is a single kernel either way.
+        """
+        begin = getattr(self.batch_verifier, "verify_signatures_begin",
+                        None)
+        buckets = getattr(
+            getattr(self.batch_verifier, "host", None), "buckets", None
+        )
+        # Group so one launch carries about one verify bucket of lanes:
+        # finer groups pay launch overhead, coarser ones leave nothing
+        # in flight to hide behind the cascade.
+        target = buckets[-1] if buckets else 4096
+        per_win = max(len(w) for _, w in windows)
+        gsize = max(1, target // max(per_win, 1))
+        groups = [
+            windows[a : a + gsize] for a in range(0, len(windows), gsize)
+        ]
+        shared_items = None
+        cols = None
+        total_items = 0
+
+        def launch(group):
+            nonlocal shared_items, total_items
+            if shared_window is not None and all(
+                w is shared_window for _, w in group
+            ):
+                if shared_items is None:
+                    shared_items = [
+                        (m.sender, m.digest(), m.signature)
+                        for m in shared_window
+                    ]
+                total_items += len(shared_items) * len(group)
+                if begin is not None:
+                    return begin(shared_items, repeats=len(group)), None
+                return self._verify_items(shared_items * len(group)), None
+            items = []
+            bounds = []
+            for _, w in group:
+                start = len(items)
+                items.extend(
+                    (m.sender, m.digest(), m.signature) for m in w
+                )
+                bounds.append((start, len(items)))
+            total_items += len(items)
+            if begin is not None:
+                return begin(items), bounds
+            return self._verify_items(items), bounds
+
+        inflight = launch(groups[0])
+        for gi, group in enumerate(groups):
+            nxt = launch(groups[gi + 1]) if gi + 1 < len(groups) else None
+            handle, bounds = inflight
+            mask = handle.mask() if hasattr(handle, "mask") else handle
+            mask = (
+                mask.tolist() if hasattr(mask, "tolist") else list(mask)
+            )
+            if bounds is None:
+                m = len(mask) // len(group)
+                keeps = [
+                    mask[j * m : (j + 1) * m] for j in range(len(group))
+                ]
+            else:
+                keeps = [mask[a:b] for a, b in bounds]
+            for (i, w), keep in zip(group, keeps):
+                if self.columnar_ingest and w is shared_window:
+                    if cols is None:
+                        cols = WindowColumns.from_messages(shared_window)
+                    self.replicas[i].dispatch_window_cols(cols, keep)
+                else:
+                    self.replicas[i].dispatch_window(w, keep)
+            inflight = nxt
+        self.tracer.count("sim.settle.pipelined")
+        self.tracer.observe("sim.verify.launch", total_items)
 
     def _touched_slots(self, msgs) -> set:
         """The (plane, round) grid slots a window's votes would fill —
@@ -1317,9 +1527,7 @@ class Simulation:
             # the next fused settle does not reset-and-clear the poison)
             # means no zeroing will happen — poison the whole height.
             self._grid_height[i] = h
-            self._grid_dirty[i] = {
-                (p, r) for p in (0, 1) for r in range(self.vote_grid.R)
-            }
+            self._grid_dirty[i] = set(self.vote_grid.all_slots())
         else:
             # Grid live at this height: only the slots this window's
             # votes would have filled are now missing; untouched rounds'
@@ -1402,7 +1610,7 @@ class Simulation:
             mask = self.batch_verifier.verify_signatures(items)
         return mask.tolist() if hasattr(mask, "tolist") else list(mask)
 
-    def _dispatch_tallied(self, windows, keeps) -> None:
+    def _dispatch_tallied(self, windows, keeps, shared_window=None) -> None:
         """Device-tally dispatch: insert every window, scatter the accepted
         votes into the persistent device vote grid, run ONE fused tally
         launch for the whole network, then run each replica's rule cascade
@@ -1460,11 +1668,22 @@ class Simulation:
             return on_accepted
 
         plans = []
+        cols = None
         for (i, w), keep in zip(windows, keeps):
             hook = make_hook(i, self._grid_dirty[i])
-            plans.append(
-                (i, self.replicas[i].ingest_insert_window(w, keep, hook))
-            )
+            if self.columnar_ingest and w is shared_window:
+                if cols is None:
+                    cols = WindowColumns.from_messages(shared_window)
+                plans.append((
+                    i,
+                    self.replicas[i].ingest_insert_window_cols(
+                        cols, keep, hook
+                    ),
+                ))
+            else:
+                plans.append(
+                    (i, self.replicas[i].ingest_insert_window(w, keep, hook))
+                )
 
         # Launch inputs. Matching targets are each replica's proposal value
         # per round slot (post-insert, so this window's proposals count);
@@ -1704,10 +1923,22 @@ class Simulation:
 
         t_host = time.perf_counter()
         plans = []
+        # Every window IS the shared list (fused eligibility), so one
+        # columnar extraction serves all n lockstep inserts.
+        cols = (
+            WindowColumns.from_messages(shared)
+            if self.columnar_ingest else None
+        )
         for i, w in windows:
-            plans.append(
-                (i, self.replicas[i].ingest_insert_window(w, keep))
-            )
+            if cols is not None and w is shared:
+                plans.append((
+                    i,
+                    self.replicas[i].ingest_insert_window_cols(cols, keep),
+                ))
+            else:
+                plans.append(
+                    (i, self.replicas[i].ingest_insert_window(w, keep))
+                )
         for i, plan in plans:
             view = TallyView(
                 i,

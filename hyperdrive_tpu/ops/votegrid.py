@@ -404,6 +404,9 @@ class VoteGrid:
         self.n = n_replicas
         self.V = n_validators
         self.R = r_slots
+        self._all_slots = frozenset(
+            (p, r) for p in (0, 1) for r in range(r_slots)
+        )
         self.buckets = tuple(sorted(buckets))
         self._mesh = mesh
         self._fused = None
@@ -478,6 +481,16 @@ class VoteGrid:
 
     def bucket_for(self, k: int) -> int:
         return bucketing.bucket_for(k, self.buckets)
+
+    def all_slots(self) -> frozenset:
+        """Every (plane, round-slot) pair this grid serves — the full
+        poison set. A host-routed settle that cannot say which slots it
+        bypassed (a whole-height claim, or the hysteresis rebuild after a
+        disengaged stretch) marks all of them dirty: TallyView then
+        declines every query for the claimed height and the cascade reads
+        its always-complete host fallback, while the next height's reset
+        starts the grid clean."""
+        return self._all_slots
 
     def _rep(self, x):
         """A replicated device input: plain ``jnp.asarray`` single-process,

@@ -581,6 +581,31 @@ class Replica:
                               len(window) - len(batch))
         return self.proc.ingest_insert(batch, on_accepted)
 
+    def ingest_insert_window_cols(self, cols, keep=None, on_accepted=None):
+        """Columnar phase 2a: insert a :class:`~hyperdrive_tpu.batch.
+        WindowColumns` view with the keep-mask and whitelist filters fused
+        into the loop — no per-replica window copy, no per-replica
+        attribute extraction (it was paid once when ``cols`` was built).
+        Accounting matches :meth:`ingest_insert_window` row for row;
+        ``replica.ingest.fastpath_rows`` counts the rows that rode the
+        columnar path."""
+        plan, n_ok = self.proc.ingest_insert_cols(
+            cols, keep, self.procs_allowed, on_accepted
+        )
+        if self.tracer is not NULL_TRACER:
+            self.tracer.count("replica.ingest.fastpath_rows", cols.n)
+            if keep is not None:
+                self.tracer.count("replica.verify.accepted", n_ok)
+                self.tracer.count("replica.verify.rejected", cols.n - n_ok)
+        return plan
+
+    def dispatch_window_cols(self, cols, keep=None) -> None:
+        """Columnar phase 2: insert + cascade over a WindowColumns view
+        (the batched-ingest analogue of :meth:`dispatch_window`; callers
+        must only use it when ``opts.batch_ingest`` is set — the
+        per-message path has no columnar equivalent)."""
+        self.proc.ingest_cascade(self.ingest_insert_window_cols(cols, keep))
+
     def ingest_cascade_window(self, plan, tallies=None) -> None:
         """Phase 2b (device-tally mode): run the rule cascade with the
         device tally counts installed."""

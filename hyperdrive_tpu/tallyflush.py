@@ -52,7 +52,8 @@ class DeviceTallyFlusher:
     """
 
     def __init__(self, verifier, validators, r_slots: int = 8,
-                 buckets: tuple = (256, 1024, 4096), tally_check=None):
+                 buckets: tuple = (256, 1024, 4096), tally_check=None,
+                 pipeline_split: int = 512):
         from hyperdrive_tpu.ops.votegrid import VoteGrid
 
         self.verifier = verifier
@@ -65,6 +66,16 @@ class DeviceTallyFlusher:
         self._dirty: set = set()
         #: Flush passes that ran a tally launch (observability).
         self.launches = 0
+        #: Double-buffered verify: a window at least this large splits in
+        #: two, both halves' verify launches are enqueued up front, and
+        #: the second half's device time runs UNDER the first half's host
+        #: insert instead of ahead of it. Requires a verifier with an
+        #: async entry point (``verify_signatures_begin``); others keep
+        #: the single-launch schedule. 0 disables splitting.
+        self.pipeline_split = int(pipeline_split)
+        #: Rows ingested through the columnar fast path (observability —
+        #: the wire-facing :meth:`settle_block` entry).
+        self.fastpath_rows = 0
 
     def warmup(self) -> None:
         """Compile the grid kernel (one empty scatter) before the replica
@@ -88,17 +99,90 @@ class DeviceTallyFlusher:
     def flush(self, replica) -> None:
         """Drain the replica's queue to quiescence (the reference flush
         contract, replica/replica.go:251-264), one verified + tallied
-        window per pass."""
+        window per pass.
+
+        Double-buffered when the window is large and the verifier is
+        async-capable: the window splits in half, BOTH halves' verify
+        launches are enqueued up front, then the first half's mask is
+        fetched and inserted into the host automaton while the second
+        half is still verifying on device. The second fetch lands after
+        ~an insert leg of overlap instead of after a dead sync wait. Both
+        halves feed ONE tally launch + cascade, so commit behaviour is
+        byte-identical to the single-launch schedule (the automaton sees
+        the same rows in the same order).
+        """
+        begin = getattr(self.verifier, "verify_signatures_begin", None)
         while True:
             window = replica.mq.drain_window(
                 replica.proc.current_height, replica.opts.verify_window
             )
             if not window:
                 return
-            keep = self.verifier.verify_batch(window)
-            self._settle(replica, window, keep)
+            if (
+                begin is not None
+                and self.pipeline_split > 0
+                and len(window) >= max(2, self.pipeline_split)
+            ):
+                mid = len(window) // 2
+                halves = (window[:mid], window[mid:])
+                # Enqueue BOTH launches before materializing either mask:
+                # half 2 verifies under half 1's fetch + host insert.
+                pending = [
+                    begin([(m.sender, m.digest(), m.signature) for m in h])
+                    for h in halves
+                ]
+                self._settle(
+                    replica,
+                    [
+                        (
+                            h,
+                            None,
+                            lambda p=p, h=h: [
+                                bool(ok) and bool(m.signature)
+                                for ok, m in zip(p.mask(), h)
+                            ],
+                        )
+                        for h, p in zip(halves, pending)
+                    ],
+                )
+            else:
+                keep = self.verifier.verify_batch(window)
+                self._settle(replica, [(window, None, lambda k=keep: k)])
 
-    def _settle(self, replica, window, keep) -> None:
+    def settle_block(self, replica, block) -> None:
+        """Wire-facing columnar settle: one verified + tallied pass over a
+        :class:`~hyperdrive_tpu.batch.MessageBlock` window straight off
+        the transport. Rows flow into the automaton through the columnar
+        fast path (:meth:`~hyperdrive_tpu.replica.Replica.
+        ingest_insert_window_cols`) — message objects materialize only
+        for rows the automaton actually accepts or that trip a catcher,
+        never for verify-rejected or duplicate rows. Bypasses the
+        replica's queue: the caller owns windowing (this IS the window).
+        """
+        cols = block.columns()
+        items = block.verify_items()
+        begin = getattr(self.verifier, "verify_signatures_begin", None)
+        if begin is not None:
+            pending = begin(items)
+            resolve = lambda: [bool(b) for b in pending.mask()]  # noqa: E731
+        elif hasattr(self.verifier, "verify_signatures"):
+            mask = self.verifier.verify_signatures(items)
+            resolve = lambda: [bool(b) for b in mask]  # noqa: E731
+        else:
+            # Transport-trusting verifier (NullVerifier): accept whatever
+            # carries a signature. Unsigned rows still drop — a wire row
+            # without a signature is a framing defect, not a trust call.
+            keep = [bool(sig) for _, _, sig in items]
+            resolve = lambda: keep  # noqa: E731
+        self.fastpath_rows += cols.n
+        self._settle(replica, [(None, cols, resolve)])
+
+    def _settle(self, replica, parts) -> None:
+        """Insert every part (resolving each part's verify mask just
+        before its insert leg — the double-buffer overlap point), union
+        the insert plans, then run ONE tally launch + cascade. ``parts``:
+        ``(window, cols, resolve_keep)`` triples; exactly one of
+        ``window`` (message list) / ``cols`` (WindowColumns) is set."""
         from hyperdrive_tpu.batch import MessageBlock
         from hyperdrive_tpu.ops.tally import pack_value
         from hyperdrive_tpu.ops.votegrid import TallyView
@@ -134,7 +218,21 @@ class DeviceTallyFlusher:
                 return
             accepted.append((plane, msg))
 
-        plan = replica.ingest_insert_window(window, keep, on_accepted)
+        commit_rounds: set = set()
+        vote_rounds: set = set()
+        for window, cols, resolve in parts:
+            keep = resolve()
+            if cols is not None:
+                part_plan = replica.ingest_insert_window_cols(
+                    cols, keep, on_accepted
+                )
+            else:
+                part_plan = replica.ingest_insert_window(
+                    window, keep, on_accepted
+                )
+            commit_rounds |= part_plan[0]
+            vote_rounds |= part_plan[1]
+        plan = (commit_rounds, vote_rounds)
 
         # Launch inputs (n = 1): per-round matching targets are this
         # replica's proposal values post-insert; the L28 lane carries the

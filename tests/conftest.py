@@ -23,7 +23,12 @@ if "--xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # jax >= 0.5: first-class CPU device-count option. Older jaxlibs get
+    # the device count from the XLA_FLAGS fallback exported above.
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 # Persistent compilation cache: the crypto kernels are large elementwise
 # graphs (the fe25519 ladder, the unrolled SHA-512) that cost tens of

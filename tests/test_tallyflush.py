@@ -194,3 +194,147 @@ def test_coalesced_threaded_drive_matches_sync(heights):
     rep_b.start()
     rep_b.handle_coalesced(script)
     assert commits_a == commits_b and set(commits_a) == {1, 2}
+
+
+# ---------------------------------------------------------------- fast path
+# The double-buffered flush and the wire-facing columnar settle need a
+# verifier with the async begin/mask protocol, so these run the real
+# TpuWireVerifier (CPU; bucket shapes shared with test_ed25519_wire so
+# the suite pays no extra compile) over ring-signed scripts.
+
+from hyperdrive_tpu.batch import MessageBlock  # noqa: E402
+from hyperdrive_tpu.crypto import ed25519 as host_ed  # noqa: E402
+from hyperdrive_tpu.crypto.keys import KeyRing  # noqa: E402
+from hyperdrive_tpu.ops.ed25519_wire import TpuWireVerifier  # noqa: E402
+from hyperdrive_tpu.verifier import HostVerifier  # noqa: E402
+
+RING = KeyRing.deterministic(N, namespace=b"flushfast")
+RSIGS = RING.signatories
+
+
+def _signed(m, kp):
+    return m.with_signature(host_ed.sign(kp.seed, m.digest()))
+
+
+_SCRIPT_CACHE: dict = {}
+
+
+def _signed_script(heights):
+    """Ring-signed clean run; proposers are validators 1..3 only (this
+    replica's own loopback votes are unsigned and verify-rejected, so
+    quorum comes from the other 2f+1 = 3 — itself a useful property).
+    Cached: pure-Python signing dominates these tests otherwise."""
+    if heights in _SCRIPT_CACHE:
+        return _SCRIPT_CACHE[heights]
+    msgs = []
+    for h in range(1, heights + 1):
+        i_prop = h % N
+        v = _value(h, 0)
+        if i_prop != 0:
+            msgs.append(_signed(Propose(height=h, round=0,
+                                        valid_round=INVALID_ROUND, value=v,
+                                        sender=RSIGS[i_prop]),
+                                RING[i_prop]))
+        for i in range(1, N):
+            msgs.append(_signed(Prevote(height=h, round=0, value=v,
+                                        sender=RSIGS[i]), RING[i]))
+        for i in range(1, N):
+            msgs.append(_signed(Precommit(height=h, round=0, value=v,
+                                          sender=RSIGS[i]), RING[i]))
+    _SCRIPT_CACHE[heights] = msgs
+    return msgs
+
+
+_WIRE = None
+
+
+def _wire_verifier():
+    """One TpuWireVerifier per process: per-instance warmup/compile state
+    is the expensive part, and launches are independent across flushers
+    (the shared-Verifier deployment shape)."""
+    global _WIRE
+    if _WIRE is None:
+        _WIRE = TpuWireVerifier(buckets=(16, 64))
+    return _WIRE
+
+
+def _build_signed(fl, commits):
+    lb = _Loopback()
+    rep = Replica(
+        ReplicaOptions(),
+        whoami=RSIGS[0],
+        signatories=list(RSIGS),
+        timer=None,
+        proposer=MockProposer(fn=_value),
+        validator=MockValidator(ok=True),
+        committer=CommitterCallback(
+            on_commit=lambda h, v: (commits.__setitem__(h, v), (0, None))[1]
+        ),
+        catcher=None,
+        broadcaster=lb,
+        verifier=None,
+        flusher=fl,
+    )
+    lb.rep = rep
+    return rep
+
+
+def _drive_mq(split):
+    """Feed each height's signed window into the mq, flush, return the
+    committed chain. ``split`` is the flusher's pipeline_split."""
+    commits: dict = {}
+    fl = DeviceTallyFlusher(_wire_verifier(), RSIGS,
+                            pipeline_split=split)
+    fl.warmup()
+    rep = _build_signed(fl, commits)
+    for h in range(1, 4):
+        for m in _signed_script(3):
+            if m.height == h:
+                if isinstance(m, Propose):
+                    rep.mq.insert_propose(m)
+                elif isinstance(m, Prevote):
+                    rep.mq.insert_prevote(m)
+                else:
+                    rep.mq.insert_precommit(m)
+        fl.flush(rep)
+    return commits, rep
+
+
+def test_flusher_split_window_matches_single_launch():
+    """pipeline_split=4 makes every 7-message window verify as two
+    overlapped launches (half 2 in flight during half 1's host insert);
+    the committed chain must equal the monolithic schedule's exactly."""
+    c_split, rep_split = _drive_mq(split=4)
+    c_mono, rep_mono = _drive_mq(split=0)
+    assert c_split == c_mono and set(c_split) == {1, 2, 3}
+    assert rep_split.proc.current_height == rep_mono.proc.current_height == 4
+
+
+def test_settle_block_columnar_matches_object_path():
+    """The wire-facing entry: a MessageBlock window settles through the
+    columnar fast path to the same chain as the object mq path, and the
+    fastpath row counter proves the columnar leg actually ran."""
+    c_mono, _ = _drive_mq(split=0)
+    commits: dict = {}
+    fl = DeviceTallyFlusher(_wire_verifier(), RSIGS)
+    fl.warmup()
+    rep = _build_signed(fl, commits)
+    for h in range(1, 4):
+        window = [m for m in _signed_script(3) if m.height == h]
+        fl.settle_block(rep, MessageBlock.from_messages(window))
+    assert commits == c_mono and set(commits) == {1, 2, 3}
+    assert fl.fastpath_rows == 21  # 3 heights x (1 propose + 6 votes)
+
+
+def test_settle_block_sync_verifier_fallback():
+    """settle_block with a begin-less verifier (HostVerifier) takes the
+    synchronous verify leg and still commits the same chain."""
+    c_mono, _ = _drive_mq(split=0)
+    commits: dict = {}
+    fl = DeviceTallyFlusher(HostVerifier(), RSIGS)
+    fl.warmup()
+    rep = _build_signed(fl, commits)
+    for h in range(1, 4):
+        window = [m for m in _signed_script(3) if m.height == h]
+        fl.settle_block(rep, MessageBlock.from_messages(window))
+    assert commits == c_mono and set(commits) == {1, 2, 3}
