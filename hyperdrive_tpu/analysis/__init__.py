@@ -1,0 +1,22 @@
+"""hdlint: repo-specific static analysis + runtime consensus sanitizer.
+
+Two halves, one contract (see ANALYSIS.md for the full catalog):
+
+* **Static** (``python -m hyperdrive_tpu.analysis``): AST rules HD001..
+  HD004 guard the properties the JAX port's headline numbers rest on —
+  hot paths free of silent host↔device syncs (HD001) and jit retrace
+  hazards (HD002), digest-feeding code free of nondeterministic
+  iteration (HD003), ops kernels free of dtype-width drift (HD004).
+* **Runtime** (:mod:`hyperdrive_tpu.analysis.sanitizer`): invariant
+  checks HDS001..HDS004 interposed on the Process DI seams and the
+  DeviceTallyFlusher tally view, toggled by ``HD_SANITIZE`` (tier-1
+  tests enable it by default via conftest).
+
+This module stays import-light (no jax, no numpy at import time): it is
+imported by :mod:`hyperdrive_tpu.replica` on every construction.
+"""
+
+from hyperdrive_tpu.analysis.annotations import device_fetch, hot_path
+from hyperdrive_tpu.analysis.sanitizer import SanitizerError
+
+__all__ = ["device_fetch", "hot_path", "SanitizerError"]

@@ -1,0 +1,78 @@
+"""CLI: ``python -m hyperdrive_tpu.analysis [paths...] [--strict]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/parse errors. With no paths,
+lints the installed ``hyperdrive_tpu`` package tree (what CI gates on).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from hyperdrive_tpu.analysis.engine import lint_paths
+from hyperdrive_tpu.analysis.rules import ALL_RULES, default_rules
+
+
+def _default_target() -> str:
+    import hyperdrive_tpu
+
+    return os.path.dirname(os.path.abspath(hyperdrive_tpu.__file__))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hyperdrive_tpu.analysis",
+        description="hdlint: JAX-aware static analysis for hyperdrive_tpu "
+                    "(rule catalog: ANALYSIS.md)",
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the hyperdrive_tpu "
+             "package)",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="also fail on suppressions that omit a reason (HD000)",
+    )
+    parser.add_argument(
+        "--rules", default=None, metavar="HD001,HD003",
+        help="comma-separated rule codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for code, cls in sorted(ALL_RULES.items()):
+            print(f"{code}  {cls.name:28s} {cls.summary}")
+        return 0
+
+    if args.rules:
+        codes = [c.strip().upper() for c in args.rules.split(",") if c.strip()]
+        unknown = [c for c in codes if c not in ALL_RULES]
+        if unknown:
+            print(f"unknown rule(s): {', '.join(unknown)} "
+                  f"(known: {', '.join(sorted(ALL_RULES))})", file=sys.stderr)
+            return 2
+        rules = [ALL_RULES[c]() for c in codes]
+    else:
+        rules = default_rules()
+
+    paths = args.paths or [_default_target()]
+    findings, errors = lint_paths(paths, rules, strict=args.strict)
+
+    for err in errors:
+        print(f"error: {err}", file=sys.stderr)
+    for f in findings:
+        print(f.format())
+    if findings:
+        print(f"hdlint: {len(findings)} finding(s)", file=sys.stderr)
+    if errors:
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

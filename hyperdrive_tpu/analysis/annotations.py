@@ -1,0 +1,53 @@
+"""Source annotations the lint rules key on.
+
+``@hot_path`` marks a function outside the path-scoped hot set
+(``ops/``, ``tallyflush.py``, ``batch.py``, ``harness/sim.py``) as a
+throughput-critical leg: HD001 then audits its body for implicit
+host↔device syncs exactly as it audits the scoped files.
+
+``device_fetch`` is the ONE blessed device→host materialization point.
+A sync that is genuinely required (a verify mask the host automaton
+must branch on, a warmup result that forces compilation) goes through
+it; HD001 treats anything inside a ``device_fetch(...)`` call as
+accounted-for. Keeping every deliberate sync behind one name makes the
+cost grep-able: ``grep -rn device_fetch hyperdrive_tpu`` IS the sync
+budget.
+"""
+
+from __future__ import annotations
+
+__all__ = ["hot_path", "device_fetch"]
+
+
+def hot_path(fn=None):
+    """Mark ``fn`` as a throughput-critical leg for HD001.
+
+    Usable bare (``@hot_path``) or called (``@hot_path()``). Pure
+    marker: returns ``fn`` unchanged apart from a ``__hd_hot_path__``
+    attribute, so it composes with jit/caching decorators and costs
+    nothing at call time.
+    """
+    if fn is None:
+        return hot_path
+    try:
+        fn.__hd_hot_path__ = True
+    except (AttributeError, TypeError):  # builtins / slotted callables
+        pass
+    return fn
+
+
+def device_fetch(x, *, why: str = ""):
+    """THE annotated device→host sync point.
+
+    Materializes ``x`` (a jax array, a device-backed buffer, or
+    anything ``np.asarray`` accepts) on the host and returns a numpy
+    array. ``why`` is a free-form justification that lives at the call
+    site for reviewers; it is not interpreted.
+
+    HD001 recognizes this call and exempts its subtree — the point is
+    not to forbid syncs but to make every one of them a named,
+    searchable decision.
+    """
+    import numpy as np
+
+    return np.asarray(x)

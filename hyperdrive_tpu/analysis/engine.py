@@ -1,0 +1,215 @@
+"""hdlint engine: file loading, scope resolution, suppressions, reporting.
+
+A *scope* names a slice of the repo a rule cares about:
+
+* ``hot``    — host↔device sync discipline (HD001): ``ops/``,
+  ``tallyflush.py``, ``batch.py``, ``harness/sim.py``; elsewhere only
+  functions marked ``@hot_path``.
+* ``digest`` — determinism feeding commit digests / wire bytes (HD003):
+  ``codec.py``, ``process.py``, ``harness/sim.py``.
+* ``ops``    — device kernel dtype discipline (HD004): ``ops/``.
+
+Scopes resolve from the file path; a file outside the path set can opt
+in with a pragma comment (used by the fixture corpus)::
+
+    # hdlint: scope=hot,digest,ops
+
+Suppressions attach to the flagged line or the line directly above::
+
+    # hdlint: disable=HD003 replay order is fixed upstream
+    for h in maybe_a_set: ...
+
+The reason text is part of the syntax: ``--strict`` reports any
+suppression that omits it (as HD000), so waivers stay auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Finding",
+    "Suppression",
+    "FileContext",
+    "iter_python_files",
+    "lint_paths",
+]
+
+SUPPRESS_RE = re.compile(
+    r"#\s*hdlint:\s*disable=(?P<codes>[A-Z][A-Z0-9]*(?:\s*,\s*[A-Z][A-Z0-9]*)*)"
+    r"(?:\s+(?P<reason>\S.*))?"
+)
+SCOPE_RE = re.compile(r"#\s*hdlint:\s*scope=(?P<scopes>[a-z]+(?:\s*,\s*[a-z]+)*)")
+
+VALID_SCOPES = frozenset({"hot", "digest", "ops"})
+
+_HOT_SUFFIXES = ("/tallyflush.py", "/batch.py", "/harness/sim.py")
+_DIGEST_SUFFIXES = ("/codec.py", "/process.py", "/harness/sim.py")
+
+_SKIP_DIRS = frozenset({"__pycache__", ".git", ".jax_cache", "fixtures"})
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: frozenset
+    reason: str
+    used: bool = field(default=False, compare=False)
+
+    def covers(self, rule: str) -> bool:
+        return rule in self.rules
+
+
+class FileContext:
+    """One parsed source file: AST + pragmas, handed to every rule."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        #: line -> list[Suppression]
+        self.suppressions: dict[int, list] = {}
+        self.forced_scopes: set = set()
+        self._scan_comments()
+        self.scopes = self._path_scopes() | self.forced_scopes
+
+    # ------------------------------------------------------------- comments
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [
+                (t.start[0], t.string)
+                for t in tokens
+                if t.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return
+        for line, text in comments:
+            m = SUPPRESS_RE.search(text)
+            if m:
+                codes = frozenset(
+                    c.strip() for c in m.group("codes").split(",") if c.strip()
+                )
+                sup = Suppression(line, codes, (m.group("reason") or "").strip())
+                self.suppressions.setdefault(line, []).append(sup)
+            m = SCOPE_RE.search(text)
+            if m:
+                self.forced_scopes |= {
+                    s.strip()
+                    for s in m.group("scopes").split(",")
+                    if s.strip() in VALID_SCOPES
+                }
+
+    # --------------------------------------------------------------- scopes
+
+    def _path_scopes(self) -> set:
+        p = self.path.replace(os.sep, "/")
+        scopes: set = set()
+        in_ops = "/ops/" in p or p.startswith("ops/")
+        if in_ops or any(p.endswith(s) for s in _HOT_SUFFIXES):
+            scopes.add("hot")
+        if any(p.endswith(s) for s in _DIGEST_SUFFIXES):
+            scopes.add("digest")
+        if in_ops:
+            scopes.add("ops")
+        return scopes
+
+    # --------------------------------------------------------- suppressions
+
+    def suppressed(self, finding: Finding) -> bool:
+        """A finding is waived by a matching suppression on its own line
+        or on the line directly above (the comment-above idiom)."""
+        for line in (finding.line, finding.line - 1):
+            for sup in self.suppressions.get(line, ()):
+                if sup.covers(finding.rule):
+                    sup.used = True
+                    return True
+        return False
+
+    def suppression_issues(self) -> list:
+        """Reasonless suppressions, reported under HD000 in --strict."""
+        issues = []
+        for line, sups in sorted(self.suppressions.items()):
+            for sup in sups:
+                if not sup.reason:
+                    issues.append(
+                        Finding(
+                            "HD000",
+                            self.path,
+                            line,
+                            "suppression without a reason: append a "
+                            "justification after the rule code(s)",
+                        )
+                    )
+        return issues
+
+
+def iter_python_files(paths) -> list:
+    """Expand files/directories into a sorted list of .py files.
+
+    Skips caches, VCS internals, and any directory named ``fixtures``
+    (the known-bad lint corpus must never leak into a default repo
+    scan — tests point at it explicitly)."""
+    out = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(path)
+            continue
+        for root, dirs, files in os.walk(path):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d not in _SKIP_DIRS and not d.startswith(".")
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return sorted(set(out))
+
+
+def lint_paths(paths, rules, strict: bool = False):
+    """Run ``rules`` over ``paths``.
+
+    Returns ``(findings, errors)``: surviving findings sorted by
+    location, and non-lint problems (unreadable / unparsable files) as
+    strings. ``strict`` adds HD000 findings for reasonless
+    suppressions."""
+    findings: list = []
+    errors: list = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as e:
+            errors.append(f"{path}: unreadable: {e}")
+            continue
+        try:
+            ctx = FileContext(path, source)
+        except SyntaxError as e:
+            errors.append(f"{path}: syntax error: {e}")
+            continue
+        raw: list = []
+        for rule in rules:
+            raw.extend(rule.check(ctx))
+        findings.extend(f for f in set(raw) if not ctx.suppressed(f))
+        if strict:
+            findings.extend(ctx.suppression_issues())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return findings, errors

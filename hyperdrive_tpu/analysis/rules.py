@@ -1,0 +1,646 @@
+"""hdlint rules HD001–HD004.
+
+Every rule is a heuristic tuned against THIS repo's idioms (see
+ANALYSIS.md for the catalog with examples). False positives are waived
+in place with ``# hdlint: disable=HDnnn <reason>`` — the reason is part
+of the syntax, so the waiver ledger stays reviewable.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from hyperdrive_tpu.analysis.engine import Finding
+
+__all__ = ["ALL_RULES", "default_rules", "HostSyncRule", "RetraceRule",
+           "NondetIterRule", "DtypeWidthRule"]
+
+_CASTS = frozenset({"int", "float", "bool"})
+_NP_CONVERTERS = frozenset(
+    {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+)
+_STATIC_ATTRS = frozenset(
+    {"shape", "ndim", "dtype", "size", "itemsize", "weak_type", "aval"}
+)
+_STATIC_FUNCS = frozenset(
+    {"len", "isinstance", "hasattr", "getattr", "type", "id"}
+)
+_SET_METHODS = frozenset(
+    {"union", "intersection", "difference", "symmetric_difference"}
+)
+_SET_BINOPS = (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)
+
+
+# ------------------------------------------------------------------ helpers
+
+def _dotted(node):
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _contains_name(node, name) -> bool:
+    return any(
+        isinstance(n, ast.Name) and n.id == name for n in ast.walk(node)
+    )
+
+
+def _contains_jnp(node) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id == "jnp":
+            return True
+        if isinstance(n, ast.Attribute):
+            d = _dotted(n)
+            if d and d.startswith("jax.numpy"):
+                return True
+    return False
+
+
+def _is_device_fetch(call) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    d = _dotted(call.func)
+    return bool(d) and d.split(".")[-1] == "device_fetch"
+
+
+def _walk_skipping_fetch(node):
+    """ast.walk, but a ``device_fetch(...)`` call hides its whole
+    subtree: whatever syncs inside it is the annotated, accounted-for
+    sync."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if _is_device_fetch(n):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _has_self_call(node) -> bool:
+    """Any call whose callee dereferences ``self`` (``self.fn(...)``,
+    ``self.a.fn(...)``) — the classic shape of a method returning a
+    device value that is then cast on the host."""
+    for n in _walk_skipping_fetch(node):
+        if isinstance(n, ast.Call) and _contains_name(n.func, "self"):
+            return True
+    return False
+
+
+def _attr_call_outside_fetch(node) -> bool:
+    for n in _walk_skipping_fetch(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            return True
+    return False
+
+
+def _decorator_targets(fn):
+    for d in fn.decorator_list:
+        yield d.func if isinstance(d, ast.Call) else d, d
+
+
+def _has_decorator(fn, leaf_names) -> bool:
+    for target, _ in _decorator_targets(fn):
+        d = _dotted(target)
+        if d and d.split(".")[-1] in leaf_names:
+            return True
+    return False
+
+
+def _jit_decorator(fn):
+    """The jit (or partial(jit, ...)) decorator Call/expr, or None."""
+    for target, full in _decorator_targets(fn):
+        d = _dotted(target)
+        if not d:
+            continue
+        leaf = d.split(".")[-1]
+        if leaf == "jit":
+            return full
+        if leaf == "partial" and isinstance(full, ast.Call) and full.args:
+            inner = _dotted(full.args[0])
+            if inner and inner.split(".")[-1] == "jit":
+                return full
+    return None
+
+
+def _parent_map(tree):
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _enclosing_function(node, parents):
+    n = parents.get(node)
+    while n is not None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return n
+        n = parents.get(n)
+    return None
+
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+# ------------------------------------------------------------------- HD001
+
+class HostSyncRule:
+    """HD001: implicit host↔device sync on a hot path.
+
+    In hot scope (``ops/``, ``tallyflush.py``, ``batch.py``,
+    ``harness/sim.py``, or any ``@hot_path`` function) flags:
+
+    * ``x.item()`` / ``x.block_until_ready()``
+    * ``np.asarray(x)`` / ``np.array(x)`` where ``x`` references ``jnp``
+      or ``self`` (device-resident state); list/tuple/comprehension
+      payloads are host-side construction and pass
+    * ``int()/float()/bool()`` over a ``jnp`` expression or a
+      ``self.…(...)`` method result
+    * per-element cast loops (``[bool(b) for b in x.mask()]``) whose
+      iterable calls a method — a device mask materialized one scalar at
+      a time instead of one ``device_fetch``
+
+    Anything inside ``device_fetch(...)`` is exempt by design.
+    """
+
+    code = "HD001"
+    name = "implicit-host-sync"
+    summary = "implicit host<->device sync on a hot path"
+
+    def check(self, ctx):
+        findings: list = []
+        if "hot" in ctx.scopes:
+            roots = [ctx.tree]
+        else:
+            roots = [
+                n for n in ast.walk(ctx.tree)
+                if isinstance(n, _FUNC_NODES)
+                and _has_decorator(n, {"hot_path"})
+            ]
+        for root in roots:
+            self._scan(root, ctx.path, findings)
+        return findings
+
+    def _scan(self, root, path, findings):
+        def flag(node, msg):
+            findings.append(Finding(self.code, path, node.lineno, msg))
+
+        for n in _walk_skipping_fetch(root):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                if n.func.attr == "item" and not n.args:
+                    flag(n, "'.item()' forces a device sync; fetch the "
+                            "batch once via device_fetch(...)")
+                    continue
+                if n.func.attr == "block_until_ready":
+                    flag(n, "'.block_until_ready()' is a device sync; if "
+                            "deliberate, route it through device_fetch(...)")
+                    continue
+            if isinstance(n, ast.Call):
+                d = _dotted(n.func)
+                if d in _NP_CONVERTERS and n.args:
+                    x = n.args[0]
+                    host_side = isinstance(
+                        x, (ast.List, ast.Tuple, ast.Dict, ast.ListComp,
+                            ast.GeneratorExp, ast.Constant)
+                    )
+                    if not host_side and (
+                        _contains_jnp(x) or _contains_name(x, "self")
+                    ):
+                        flag(n, f"'{d}(...)' over a device-resident value "
+                                "is an implicit sync; use device_fetch(...)")
+                        continue
+                if (
+                    isinstance(n.func, ast.Name)
+                    and n.func.id in _CASTS
+                    and len(n.args) == 1
+                    and (_contains_jnp(n.args[0])
+                         or _has_self_call(n.args[0]))
+                ):
+                    flag(n, f"'{n.func.id}(...)' over a device-derived "
+                            "value syncs per call; fetch once via "
+                            "device_fetch(...)")
+                    continue
+            if isinstance(n, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+                elt_casts = any(
+                    isinstance(c, ast.Call)
+                    and isinstance(c.func, ast.Name)
+                    and c.func.id in _CASTS
+                    for c in ast.walk(n.elt)
+                )
+                if elt_casts and any(
+                    _attr_call_outside_fetch(g.iter) for g in n.generators
+                ):
+                    flag(n, "per-element cast over a method-call iterable "
+                            "materializes a device result one scalar at a "
+                            "time; fetch the array once via "
+                            "device_fetch(...) and cast on host")
+
+
+# ------------------------------------------------------------------- HD002
+
+class RetraceRule:
+    """HD002: ``jax.jit`` retrace / recompile hazards.
+
+    * a jit call inside a function with no compile cache (no
+      ``lru_cache``/``cache`` decorator, result not stored into a
+      cache-dict subscript) recompiles on every call
+    * a jitted function that references ``self`` closes over mutable
+      attributes — traced values silently refresh per instance, or
+      retrace per mutation when marked static
+    * ``static_argnums``/``static_argnames`` naming a parameter with a
+      mutable (unhashable) default fails at call time
+    * a Python ``if``/``while`` on a traced parameter retraces per value
+      (or raises TracerBoolConversionError); branch on ``.shape`` /
+      ``.ndim`` / ``len()`` or move the branch to ``jnp.where``
+    """
+
+    code = "HD002"
+    name = "jit-retrace-hazard"
+    summary = "jax.jit retrace / recompile hazard"
+
+    def check(self, ctx):
+        findings: list = []
+        parents = _parent_map(ctx.tree)
+        path = ctx.path
+
+        def flag(node, msg):
+            findings.append(Finding(self.code, path, node.lineno, msg))
+
+        # (a) uncached jit construction inside a function
+        for n in ast.walk(ctx.tree):
+            if not (isinstance(n, ast.Call) and self._is_jit_name(n.func)):
+                continue
+            fn = _enclosing_function(n, parents)
+            if fn is None:
+                continue  # module-level jit: compiled once per import
+            if _has_decorator(fn, {"lru_cache", "cache"}):
+                continue
+            if self._stored_in_cache(n, parents):
+                continue
+            flag(n, "jax.jit(...) built inside a function with no compile "
+                    "cache recompiles per call; hoist to module level, "
+                    "decorate the factory with functools.lru_cache, or "
+                    "store the result in an explicit cache dict")
+
+        # (b)(c)(d) jitted function bodies
+        for fn in self._jitted_functions(ctx.tree, parents):
+            dec = _jit_decorator(fn)
+            static = self._static_params(fn, dec)
+            if _contains_name(fn, "self"):
+                flag(fn, f"jitted function '{fn.name}' references 'self': "
+                         "closing over mutable attributes retraces per "
+                         "mutation (or silently stales); pass arrays as "
+                         "arguments")
+            for name, default in self._mutable_static_defaults(fn, static):
+                flag(default, f"static arg '{name}' has a mutable default "
+                              "(unhashable under jit); use a tuple/None")
+            self._scan_traced_branches(fn, static, flag)
+        return findings
+
+    # -------------------------------------------------------------- helpers
+
+    @staticmethod
+    def _is_jit_name(func) -> bool:
+        d = _dotted(func)
+        return bool(d) and (d == "jit" or d.endswith(".jit"))
+
+    @staticmethod
+    def _stored_in_cache(call, parents) -> bool:
+        """Constructions that amortize the compile: ``fn = CACHE[k] =
+        jax.jit(...)`` (explicit cache dict), ``self._fn = jax.jit(...)``
+        (per-instance cache), ``return jax.jit(...)`` (factory — the
+        caller owns the lifetime)."""
+        n, p = call, parents.get(call)
+        while p is not None and not isinstance(p, ast.stmt):
+            n, p = p, parents.get(p)
+        # The exemptions only hold when the jit call itself is what gets
+        # returned/stored; jax.jit(...)(x) nested in a larger expression
+        # still compiles per invocation.
+        if isinstance(p, ast.Return) and p.value is call:
+            return True
+        if isinstance(p, ast.Assign) and p.value is call:
+            return any(
+                isinstance(t, ast.Subscript)
+                or (isinstance(t, ast.Attribute)
+                    and _contains_name(t.value, "self"))
+                for t in p.targets
+            )
+        return False
+
+    def _jitted_functions(self, tree, parents):
+        """Defs decorated with jit/partial(jit, ...), plus local defs
+        passed positionally to a jit call in the same scope."""
+        out = []
+        local_jitted: set = set()
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call) and self._is_jit_name(n.func):
+                for a in n.args:
+                    if isinstance(a, ast.Name):
+                        local_jitted.add(a.id)
+            if isinstance(n, _FUNC_NODES) and _jit_decorator(n) is not None:
+                out.append(n)
+        for n in ast.walk(tree):
+            if (
+                isinstance(n, _FUNC_NODES)
+                and n.name in local_jitted
+                and n not in out
+            ):
+                out.append(n)
+        return out
+
+    @staticmethod
+    def _static_params(fn, dec):
+        """Names of parameters marked static on the jit decorator."""
+        static: set = set()
+        if not isinstance(dec, ast.Call):
+            return static
+        posnames = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for kw in dec.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            vals = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in vals:
+                if not isinstance(v, ast.Constant):
+                    continue
+                if isinstance(v.value, int) and 0 <= v.value < len(posnames):
+                    static.add(posnames[v.value])
+                elif isinstance(v.value, str):
+                    static.add(v.value)
+        return static
+
+    @staticmethod
+    def _mutable_static_defaults(fn, static):
+        args = fn.args.posonlyargs + fn.args.args
+        defaults = fn.args.defaults
+        for a, d in zip(args[len(args) - len(defaults):], defaults):
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call)
+                and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set")
+            )
+            if a.arg in static and mutable:
+                yield a.arg, d
+        for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if d is None:
+                continue
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set))
+            if a.arg in static and mutable:
+                yield a.arg, d
+
+    def _scan_traced_branches(self, fn, static, flag):
+        tainted = {
+            a.arg
+            for a in (fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs)
+            if a.arg not in static and a.arg != "self"
+        }
+        if fn.args.vararg:
+            tainted.add(fn.args.vararg.arg)
+
+        def is_static(e) -> bool:
+            if isinstance(e, ast.Constant):
+                return True
+            if isinstance(e, ast.Name):
+                return e.id not in tainted
+            if isinstance(e, ast.Attribute):
+                if e.attr in _STATIC_ATTRS:
+                    return True
+                return is_static(e.value)
+            if isinstance(e, ast.Call):
+                d = _dotted(e.func)
+                if d and d.split(".")[-1] in _STATIC_FUNCS:
+                    return True
+                args = list(e.args) + [k.value for k in e.keywords]
+                return is_static(e.func) and all(is_static(a) for a in args)
+            if isinstance(e, ast.Compare):
+                if (
+                    all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops)
+                    and all(
+                        isinstance(c, ast.Constant) for c in e.comparators
+                    )
+                ):
+                    return True  # `x is None` probes arg presence, not value
+                return is_static(e.left) and all(
+                    is_static(c) for c in e.comparators
+                )
+            if isinstance(e, (ast.BoolOp, ast.Tuple, ast.List)):
+                vals = e.values if isinstance(e, ast.BoolOp) else e.elts
+                return all(is_static(v) for v in vals)
+            if isinstance(e, ast.BinOp):
+                return is_static(e.left) and is_static(e.right)
+            if isinstance(e, ast.UnaryOp):
+                return is_static(e.operand)
+            if isinstance(e, ast.Subscript):
+                return is_static(e.value) and is_static(e.slice)
+            if isinstance(e, ast.IfExp):
+                return all(is_static(x) for x in (e.test, e.body, e.orelse))
+            if isinstance(e, (ast.JoinedStr, ast.Lambda)):
+                return True
+            return all(is_static(c) for c in ast.iter_child_nodes(e))
+
+        def visit(stmts):
+            for s in stmts:
+                if isinstance(s, _FUNC_NODES + (ast.Lambda, ast.ClassDef)):
+                    continue  # separate scope
+                if isinstance(s, ast.Assign):
+                    val_static = is_static(s.value)
+                    for t in s.targets:
+                        for nm in ast.walk(t):
+                            if isinstance(nm, ast.Name):
+                                if val_static:
+                                    tainted.discard(nm.id)
+                                else:
+                                    tainted.add(nm.id)
+                elif isinstance(s, ast.AugAssign):
+                    if isinstance(s.target, ast.Name) and not is_static(
+                        s.value
+                    ):
+                        tainted.add(s.target.id)
+                elif isinstance(s, (ast.If, ast.While)):
+                    if not is_static(s.test):
+                        flag(s, f"python branch on a traced value in "
+                                f"jitted '{fn.name}' retraces per value "
+                                "(or raises on bool()); branch on "
+                                ".shape/.ndim/len() or use jnp.where/"
+                                "lax.cond")
+                elif isinstance(s, ast.For):
+                    if not is_static(s.iter):
+                        for nm in ast.walk(s.target):
+                            if isinstance(nm, ast.Name):
+                                tainted.add(nm.id)
+                body_lists = [
+                    getattr(s, f)
+                    for f in ("body", "orelse", "finalbody")
+                    if getattr(s, f, None)
+                ]
+                for bl in body_lists:
+                    if isinstance(bl, list):
+                        visit([x for x in bl if isinstance(x, ast.stmt)])
+                for h in getattr(s, "handlers", []) or []:
+                    visit(h.body)
+
+        visit(fn.body)
+
+
+# ------------------------------------------------------------------- HD003
+
+class NondetIterRule:
+    """HD003: nondeterministic iteration feeding digests / wire bytes.
+
+    In digest scope (``codec.py``, ``process.py``, ``harness/sim.py``)
+    flags ``for``-loops and comprehensions whose iterable is set-typed:
+    set/frozenset literals and calls, ``.union()``-family chains rooted
+    at a set, set-operator expressions (``a | b`` of sets), and locals
+    assigned from any of those. Iterating a set hashes pointers —
+    PYTHONHASHSEED decides the order, and any digest or wire encoding
+    folded over it forks across runs. ``sorted(...)`` at the iteration
+    point is the fix and the exemption.
+    """
+
+    code = "HD003"
+    name = "nondeterministic-iteration"
+    summary = "set iteration feeding digests or wire bytes"
+
+    def check(self, ctx):
+        if "digest" not in ctx.scopes:
+            return []
+        findings: list = []
+        local_sets = self._set_named_locals(ctx.tree)
+
+        def setish(e) -> bool:
+            if isinstance(e, (ast.Set, ast.SetComp)):
+                return True
+            if isinstance(e, ast.Name):
+                return e.id in local_sets
+            if isinstance(e, ast.Call):
+                if isinstance(e.func, ast.Name) and e.func.id in (
+                    "set", "frozenset"
+                ):
+                    return True
+                if (
+                    isinstance(e.func, ast.Attribute)
+                    and e.func.attr in _SET_METHODS
+                ):
+                    return setish(e.func.value)
+                return False
+            if isinstance(e, ast.BinOp) and isinstance(e.op, _SET_BINOPS):
+                return setish(e.left) or setish(e.right)
+            if isinstance(e, ast.IfExp):
+                return setish(e.body) or setish(e.orelse)
+            return False
+
+        def flag(node):
+            findings.append(Finding(
+                self.code, ctx.path, node.lineno,
+                "iteration over a set is hash-order nondeterministic and "
+                "this file feeds commit digests / wire bytes; iterate "
+                "sorted(...) instead",
+            ))
+
+        for n in ast.walk(ctx.tree):
+            iters = []
+            if isinstance(n, ast.For):
+                iters.append(n.iter)
+            elif isinstance(n, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                ast.GeneratorExp)):
+                iters.extend(g.iter for g in n.generators)
+            for it in iters:
+                if setish(it):
+                    flag(it)
+        return findings
+
+    @staticmethod
+    def _set_named_locals(tree) -> set:
+        names: set = set()
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Assign):
+                v = n.value
+                is_set = isinstance(v, (ast.Set, ast.SetComp)) or (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Name)
+                    and v.func.id in ("set", "frozenset")
+                )
+                if is_set:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+            elif isinstance(n, ast.AnnAssign) and isinstance(
+                n.target, ast.Name
+            ):
+                ann = _dotted(n.annotation) or ""
+                if ann.split(".")[-1].lower() in ("set", "frozenset"):
+                    names.add(n.target.id)
+        return names
+
+
+# ------------------------------------------------------------------- HD004
+
+class DtypeWidthRule:
+    """HD004: dtype-width drift in ops kernels.
+
+    In ``ops/``, a bare Python int literal ≥ 2³¹ inside a function that
+    touches ``jnp`` will not fit int32 — whether it overflows, promotes
+    to int64, or raises depends on ``jax_enable_x64`` and the op it
+    meets. Flagged unless some enclosing call pins ``dtype=`` (the
+    constant-table idiom: ``jnp.asarray([...], dtype=jnp.uint32)``).
+    """
+
+    code = "HD004"
+    name = "dtype-width-drift"
+    summary = "int literal >= 2**31 in a jnp kernel without dtype pin"
+
+    _LIMIT = 2 ** 31
+
+    def check(self, ctx):
+        if "ops" not in ctx.scopes:
+            return []
+        findings: list = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, _FUNC_NODES):
+                continue
+            if not _contains_jnp(fn):
+                continue
+            self._scan(fn, ctx.path, findings, protected=False)
+        return findings
+
+    def _scan(self, node, path, findings, protected):
+        if isinstance(node, ast.Call) and any(
+            kw.arg == "dtype" for kw in node.keywords
+        ):
+            protected = True
+        if (
+            not protected
+            and isinstance(node, ast.Constant)
+            and isinstance(node.value, int)
+            and not isinstance(node.value, bool)
+            and abs(node.value) >= self._LIMIT
+        ):
+            findings.append(Finding(
+                self.code, path, node.lineno,
+                f"int literal {node.value:#x} does not fit int32; pin a "
+                "dtype= on the enclosing constructor or build it from "
+                "narrow parts",
+            ))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FUNC_NODES):
+                continue  # nested defs scanned on their own
+            self._scan(child, path, findings, protected)
+
+
+ALL_RULES = {
+    r.code: r
+    for r in (HostSyncRule, RetraceRule, NondetIterRule, DtypeWidthRule)
+}
+
+
+def default_rules():
+    return [cls() for cls in ALL_RULES.values()]

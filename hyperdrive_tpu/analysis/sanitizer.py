@@ -1,0 +1,187 @@
+"""Runtime consensus sanitizer: HDS001–HDS004 invariant checks.
+
+The static rules keep hazards out of the source; this half watches the
+running automaton. It interposes on the Process DI seams — the
+committer and broadcaster slots are plain assignable attributes, so no
+monkeypatching of slotted methods is needed — and on the
+DeviceTallyFlusher's per-launch tally view:
+
+* **HDS001** quorum recount: every commit is re-derived from the HOST
+  message logs (a valid proposal round whose value holds ≥ 2f+1
+  precommits). A device tally that lies its way past L49 dies here.
+* **HDS002** lock sanity: ``locked_round ≤ current_round`` at every
+  broadcast and commit (the automaton only ever locks the round it is
+  in — paper L36).
+* **HDS003** height monotonicity: committed heights strictly increase
+  and always equal the automaton's ``current_height`` at commit time.
+* **HDS004** settle-path parity: the flusher's device counts must be
+  bit-equal to the host counters for every answered query
+  (:class:`~hyperdrive_tpu.ops.votegrid.CheckedTallyView` differential,
+  re-raised under the rule name).
+
+Toggled by ``HD_SANITIZE`` (tests default it ON via conftest; perf runs
+export ``HD_SANITIZE=0`` — see BENCH.md). Violations raise
+:class:`SanitizerError`, an ``AssertionError`` whose message leads with
+the rule name so harnesses can match on it.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["SanitizerError", "enabled", "install", "maybe_install",
+           "maybe_tally_check"]
+
+
+class SanitizerError(AssertionError):
+    """An HDS invariant violation. ``rule`` is the HDSnnn code; the
+    message always starts with it."""
+
+    def __init__(self, rule: str, message: str):
+        super().__init__(f"{rule}: {message}")
+        self.rule = rule
+
+
+def enabled() -> bool:
+    return os.environ.get("HD_SANITIZE", "0").strip().lower() in (
+        "1", "true", "on", "yes"
+    )
+
+
+def _check_lock(proc) -> None:
+    st = proc.state
+    if st.locked_round > st.current_round:
+        raise SanitizerError(
+            "HDS002",
+            f"locked_round {st.locked_round} > current_round "
+            f"{st.current_round} at height {st.current_height} "
+            f"(replica {proc.whoami!r}): the automaton only locks the "
+            "round it is in (L36)",
+        )
+
+
+class _SanitizedCommitter:
+    """Wraps the committer seam: HDS001 + HDS002 + HDS003 on the way
+    into every commit. Delegates everything else to the wrapped
+    committer (which may itself be the replica's tracing wrapper)."""
+
+    def __init__(self, inner, proc):
+        self._inner = inner
+        self._proc = proc
+        self._last_height = None
+
+    def commit(self, height, value):
+        proc = self._proc
+        st = proc.state
+        if height != st.current_height:
+            raise SanitizerError(
+                "HDS003",
+                f"commit at height {height} while the automaton is at "
+                f"{st.current_height} (replica {proc.whoami!r})",
+            )
+        if self._last_height is not None and height <= self._last_height:
+            raise SanitizerError(
+                "HDS003",
+                f"commit height {height} does not advance past "
+                f"{self._last_height} (replica {proc.whoami!r}): heights "
+                "must be strictly increasing",
+            )
+        _check_lock(proc)
+        need = 2 * proc.f + 1
+        quorum = any(
+            p.value == value
+            and st.propose_is_valid.get(rnd, False)
+            and st.count_precommits_for(rnd, value) >= need
+            for rnd, p in st.propose_logs.items()
+        )
+        if not quorum:
+            raise SanitizerError(
+                "HDS001",
+                f"commit of {value!r} at height {height} has no host-log "
+                f"quorum: no valid proposal round carries >= {need} "
+                f"(2f+1) precommits for it (replica {proc.whoami!r}); a "
+                "device tally overruled the message logs",
+            )
+        self._last_height = height
+        return self._inner.commit(height, value)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class _SanitizedBroadcaster:
+    """Wraps the broadcaster seam: HDS002 before every outbound step —
+    the automaton's externally visible actions never leave a state
+    where it locked a round it has not reached."""
+
+    def __init__(self, inner, proc):
+        self._inner = inner
+        self._proc = proc
+
+    def broadcast_propose(self, msg):
+        _check_lock(self._proc)
+        return self._inner.broadcast_propose(msg)
+
+    def broadcast_prevote(self, msg):
+        _check_lock(self._proc)
+        return self._inner.broadcast_prevote(msg)
+
+    def broadcast_precommit(self, msg):
+        _check_lock(self._proc)
+        return self._inner.broadcast_precommit(msg)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def install(proc):
+    """Interpose HDS checks on ``proc``'s committer/broadcaster seams.
+    Idempotent; tolerates absent seams (a Process built without a
+    committer has no commit effect to guard)."""
+    if proc.committer is not None and not isinstance(
+        proc.committer, _SanitizedCommitter
+    ):
+        proc.committer = _SanitizedCommitter(proc.committer, proc)
+    if proc.broadcaster is not None and not isinstance(
+        proc.broadcaster, _SanitizedBroadcaster
+    ):
+        proc.broadcaster = _SanitizedBroadcaster(proc.broadcaster, proc)
+    return proc
+
+
+def maybe_install(proc):
+    """:func:`install` iff ``HD_SANITIZE`` is on (the Replica
+    constructor's hook)."""
+    if enabled():
+        install(proc)
+    return proc
+
+
+def maybe_tally_check():
+    """HDS004 factory for the DeviceTallyFlusher's ``tally_check`` seam:
+    a ``(view, proc) -> view`` wrapper cross-checking device counts
+    against the host counters, or None when the sanitizer is off.
+
+    Imported lazily so merely loading this module never drags in jax.
+    """
+    if not enabled():
+        return None
+
+    from hyperdrive_tpu.ops.votegrid import CheckedTallyView
+
+    class _HDS004View(CheckedTallyView):
+        __slots__ = ()
+
+        def _check(self, device, host, what):
+            try:
+                return super()._check(device, host, what)
+            except SanitizerError:
+                raise
+            except AssertionError as e:
+                raise SanitizerError(
+                    "HDS004",
+                    f"device/host tally divergence: {e} — the redundant "
+                    "settle paths no longer agree",
+                ) from e
+
+    return _HDS004View

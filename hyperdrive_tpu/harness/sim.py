@@ -307,9 +307,33 @@ class SimulationResult:
         before its death are still evidence: a fork committed pre-kill must
         fail the check."""
         maps = self.commits
-        for h in set().union(*[set(c) for c in maps]) if maps else ():
+        # Sorted: set-union order is hash-seed dependent, and everything
+        # downstream of this walk (first-failure reporting, digesting)
+        # must be replay-stable run to run.
+        for h in sorted(set().union(*[set(c) for c in maps])) if maps else ():
             vals = {c[h] for c in maps if h in c}
             assert len(vals) <= 1, f"safety violation at height {h}: {vals}"
+
+    def commit_digest(self) -> str:
+        """Canonical digest of the network's agreed chain: SHA-256 over
+        the height-sorted (height, value) pairs of the merged commit
+        maps (:meth:`assert_safety` certifies the merge is fork-free).
+        Two runs that committed the same chain produce the same hex
+        digest regardless of replica count, delivery schedule, or hash
+        seed — the regression handle for determinism tests."""
+        import hashlib
+
+        self.assert_safety()
+        merged: dict = {}
+        for c in self.commits:
+            merged.update(c)
+        h = hashlib.sha256()
+        for height in sorted(merged):
+            v = merged[height]
+            h.update(int(height).to_bytes(8, "little"))
+            h.update(len(v).to_bytes(4, "little"))
+            h.update(v)
+        return h.hexdigest()
 
 
 class Simulation:

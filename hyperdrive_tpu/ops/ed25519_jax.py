@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from hyperdrive_tpu.analysis.annotations import device_fetch
 from hyperdrive_tpu.crypto import ed25519 as host_ed
 from hyperdrive_tpu.ops import bucketing
 from hyperdrive_tpu.ops import fe25519 as fe
@@ -158,7 +159,7 @@ def _b_niels_np(entries: int = 16):
     8); the RLC kernel keeps the unsigned 16-entry table."""
     yp, ym, t2 = [], [], []
     pt = host_ed.IDENTITY
-    for v in range(entries):
+    for _v in range(entries):
         x, y, z, _ = pt
         zinv = pow(z, P - 2, P)
         xa, ya = (x * zinv) % P, (y * zinv) % P
@@ -796,10 +797,12 @@ class TpuBatchVerifier:
         for b in self.host.buckets:
             z = jnp.zeros((b, fe.N_LIMBS), dtype=jnp.int32)
             zn = jnp.zeros((b, 64), dtype=jnp.int32)
-            np.asarray(self._device_verify((z, z, z, z, z, zn, zn)))
+            device_fetch(self._device_verify((z, z, z, z, z, zn, zn)),
+                         why="warmup: block until the compile lands")
             if self._rlc_fn is not None:
                 zn1 = jnp.zeros((1, 64), dtype=jnp.int32)
-                np.asarray(self._rlc_fn(z, z, z, z, z, zn, zn, zn1))
+                device_fetch(self._rlc_fn(z, z, z, z, z, zn, zn, zn1),
+                             why="warmup: block until the compile lands")
 
 
     def verify_signatures(self, items) -> np.ndarray:
@@ -865,7 +868,8 @@ class TpuBatchVerifier:
         if self._rlc_fn is None:
             devs = [d for d, _, _, _ in pending if d is not None]
             if len(devs) > 1:
-                big = np.asarray(jnp.concatenate(devs))
+                big = device_fetch(jnp.concatenate(devs),
+                                   why="one RTT for the whole batch mask")
                 off = 0
                 out = []
                 for dev, _, prevalid, n in pending:
@@ -883,14 +887,17 @@ class TpuBatchVerifier:
             if dev is None:
                 out.append(prevalid[:n].copy())  # all lanes malformed
             elif self._rlc_fn is not None:
-                if bool(np.asarray(dev)):
+                if bool(device_fetch(dev, why="RLC verdict gates the "
+                                              "fallback launch")):
                     out.append(prevalid[:n].copy())
                 else:
                     self.rlc_fallbacks += 1
-                    mask = np.asarray(self._device_verify(arrays))
+                    mask = device_fetch(self._device_verify(arrays),
+                                        why="per-signature fallback mask")
                     out.append((mask & prevalid)[:n])
             else:
-                out.append((np.asarray(dev) & prevalid)[:n])
+                out.append((device_fetch(dev, why="chunk verify mask")
+                            & prevalid)[:n])
         return out[0] if len(out) == 1 else np.concatenate(out)
 
     def _verify_chunk_deduped(self, chunk, scan):

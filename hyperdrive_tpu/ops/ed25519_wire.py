@@ -35,6 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hyperdrive_tpu.analysis.annotations import device_fetch
 from hyperdrive_tpu.crypto import ed25519 as host_ed
 from hyperdrive_tpu.ops import bucketing
 from hyperdrive_tpu.ops import fe25519 as fe
@@ -635,7 +636,12 @@ class PendingVerify:
             return self._mask
         pending = self._pending
         devs = [d for d, _, _ in pending if d is not None]
-        big = np.asarray(jnp.concatenate(devs)) if devs else None
+        big = (
+            device_fetch(jnp.concatenate(devs),
+                         why="THE double-buffer sync point: one RTT for "
+                             "every enqueued launch's verdicts")
+            if devs else None
+        )
         off = 0
         out = []
         for dev, prevalid, n in pending:
@@ -765,17 +771,20 @@ class TpuWireVerifier:
     def warmup(self) -> None:
         for b in self.host.buckets:
             z = jnp.zeros((b, 32), dtype=jnp.uint8)
-            np.asarray(self._device_verify((z, z, z, z)))
+            device_fetch(self._device_verify((z, z, z, z)),
+                         why="warmup: block until the compile lands")
             if self.table is not None:
                 zi = jnp.zeros(b, dtype=jnp.int32)
-                np.asarray(self._device_verify_chal((zi, z, z, z)))
+                device_fetch(self._device_verify_chal((zi, z, z, z)),
+                             why="warmup: block until the compile lands")
                 zm = jnp.zeros(b, dtype=jnp.uint8)
                 for mb in self.host.M_BUCKETS:
                     zu = jnp.zeros((mb, 32), dtype=jnp.uint8)
-                    np.asarray(
+                    device_fetch(
                         self._device_verify_chal_grouped(
                             (zi, z, z, zm, zu)
-                        )
+                        ),
+                        why="warmup: block until the compile lands",
                     )
 
     def verify_signatures_begin(

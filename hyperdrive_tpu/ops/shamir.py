@@ -18,6 +18,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hyperdrive_tpu.analysis.annotations import device_fetch
 from hyperdrive_tpu.crypto import shamir as host_shamir
 from hyperdrive_tpu.ops import fe25519 as fe
 
@@ -116,7 +117,8 @@ class BatchReconstructor:
                 ),
             )
         y = jnp.asarray(fe.to_limbs(y_blocks))  # [k, B, 20]
-        out = np.asarray(self._fn(y, lams))
+        out = device_fetch(self._fn(y, lams),
+                           why="reconstructed limbs feed host re-encoding")
         return [fe.from_limbs(row) for row in out]
 
     def reconstruct_payload_shares(self, per_block_shares) -> bytes:

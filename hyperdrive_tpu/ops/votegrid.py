@@ -39,6 +39,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hyperdrive_tpu.analysis.annotations import device_fetch
 from hyperdrive_tpu.ops import bucketing
 from hyperdrive_tpu.types import NIL_VALUE
 
@@ -649,7 +650,9 @@ class _FusedOut:
 
     def mask(self) -> np.ndarray:
         if self._np is None:
-            self._np = np.asarray(self._out)
+            self._np = device_fetch(
+                self._out, why="single deferred fetch of mask+counts"
+            )
             self._out = None
         return self._np[: self._b].astype(bool)
 
@@ -689,13 +692,16 @@ class LazyCounts(Mapping):
     def _materialize(self) -> dict:
         d = self._dict
         if d is None:
-            flat = np.asarray(self._packed)
+            flat = device_fetch(
+                self._packed, why="deferred count fetch on first access"
+            )
             n, R = self._n, self._R
             three = flat[:, : 2 * R * 3].reshape(n, 2, R, 3)
             l28 = flat[:, 2 * R * 3]
             # Quorum flags are host-derived (counts and f travel; flags
             # don't — half the transfer for a handful of comparisons).
-            q = (2 * np.asarray(self._f).reshape(n) + 1)[:, None, None]
+            q = (2 * device_fetch(self._f, why="f rides the count fetch")
+                 .reshape(n) + 1)[:, None, None]
             d = self._dict = {
                 "matching": three[..., 0],
                 "nil": three[..., 1],

@@ -37,6 +37,8 @@ from collections import deque
 from dataclasses import dataclass, replace
 from typing import Callable, Optional
 
+from hyperdrive_tpu.analysis.annotations import hot_path
+from hyperdrive_tpu.analysis.sanitizer import maybe_install as _maybe_sanitize
 from hyperdrive_tpu.messages import Precommit, Prevote, Propose, Timeout
 from hyperdrive_tpu.utils.log import get_logger, kv as _kv
 from hyperdrive_tpu.utils.trace import NULL_TRACER
@@ -187,6 +189,10 @@ class Replica:
             catcher=self._instrument_catcher(catcher),
             height=opts.starting_height,
         )
+        # Consensus sanitizer (ANALYSIS.md, HDS001-HDS003): interposes on
+        # the committer/broadcaster seams when HD_SANITIZE is on. No-op
+        # otherwise — perf runs export HD_SANITIZE=0 (BENCH.md).
+        _maybe_sanitize(self.proc)
         self.procs_allowed: set[Signatory] = set(signatories)
         self.mq = MessageQueue(max_capacity=opts.max_capacity)
         # Pre-register the whitelist in the queue's tie-break order map:
@@ -324,6 +330,7 @@ class Replica:
         finally:
             self._handling = False
 
+    @hot_path
     def handle_burst(self, msgs) -> None:
         """Buffer one superstep's deliveries in a single pass.
 
@@ -502,6 +509,7 @@ class Replica:
     # empty reproduces the flush-until-quiescent contract
     # (reference: replica/replica.go:251-264) at the network level.
 
+    @hot_path
     def drain_pending(self) -> list:
         """Phase 1: pop this replica's eligible window without dispatching.
 
@@ -526,6 +534,7 @@ class Replica:
         self._lane_counts = {}
         return merge_drain(backlog, lane, self.mq.order_of)
 
+    @hot_path
     def dispatch_window(self, window, keep=None) -> None:
         """Phase 2: feed the verified survivors of ``window`` to the Process.
 
@@ -599,6 +608,7 @@ class Replica:
                 self.tracer.count("replica.verify.rejected", cols.n - n_ok)
         return plan
 
+    @hot_path
     def dispatch_window_cols(self, cols, keep=None) -> None:
         """Columnar phase 2: insert + cascade over a WindowColumns view
         (the batched-ingest analogue of :meth:`dispatch_window`; callers

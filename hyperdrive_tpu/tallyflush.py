@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from hyperdrive_tpu.analysis.annotations import device_fetch
+
 __all__ = ["DeviceTallyFlusher"]
 
 
@@ -61,6 +63,13 @@ class DeviceTallyFlusher:
             1, len(validators), r_slots=r_slots, buckets=buckets
         )
         self._pos = {s: i for i, s in enumerate(validators)}
+        if tally_check is None:
+            # Sanitizer HDS004 (ANALYSIS.md): under HD_SANITIZE every
+            # launch's device counts are cross-checked against the host
+            # counters; callers that pass their own tally_check keep it.
+            from hyperdrive_tpu.analysis.sanitizer import maybe_tally_check
+
+            tally_check = maybe_tally_check()
         self.tally_check = tally_check
         self._height = None
         self._dirty: set = set()
@@ -139,7 +148,15 @@ class DeviceTallyFlusher:
                             None,
                             lambda p=p, h=h: [
                                 bool(ok) and bool(m.signature)
-                                for ok, m in zip(p.mask(), h)
+                                for ok, m in zip(
+                                    device_fetch(
+                                        p.mask(),
+                                        why="half-window verdicts; the "
+                                            "2nd half verifies under "
+                                            "this fetch + insert",
+                                    ),
+                                    h,
+                                )
                             ],
                         )
                         for h, p in zip(halves, pending)
@@ -164,7 +181,11 @@ class DeviceTallyFlusher:
         begin = getattr(self.verifier, "verify_signatures_begin", None)
         if begin is not None:
             pending = begin(items)
-            resolve = lambda: [bool(b) for b in pending.mask()]  # noqa: E731
+            resolve = lambda: [  # noqa: E731
+                bool(b)
+                for b in device_fetch(pending.mask(),
+                                      why="columnar settle verify mask")
+            ]
         elif hasattr(self.verifier, "verify_signatures"):
             mask = self.verifier.verify_signatures(items)
             resolve = lambda: [bool(b) for b in mask]  # noqa: E731

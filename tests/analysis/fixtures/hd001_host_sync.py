@@ -1,0 +1,39 @@
+# hdlint: scope=hot
+"""HD001 fixture: every implicit-sync shape the rule must catch."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from hyperdrive_tpu.analysis.annotations import device_fetch
+
+
+class Flusher:
+    def __init__(self, fn):
+        self._fn = fn
+        self._out = None
+
+    def scalar_item(self, x):
+        return x.item()  # BAD: .item() per scalar
+
+    def eager_block(self, x):
+        return x.block_until_ready()  # BAD: unannotated sync
+
+    def convert_device(self):
+        return np.asarray(self._out)  # BAD: self state fetched bare
+
+    def convert_jnp(self, a, b):
+        return np.asarray(jnp.concatenate([a, b]))  # BAD: jnp fetched bare
+
+    def cast_method_result(self):
+        return bool(self._fn())  # BAD: cast over a self-method result
+
+    def per_element(self, pending):
+        return [bool(b) for b in pending.mask()]  # BAD: scalar-at-a-time
+
+    def annotated(self, pending):
+        # GOOD: the one blessed sync point
+        return [bool(b) for b in device_fetch(pending.mask())]
+
+    def host_side(self, rows):
+        # GOOD: building a host array from host scalars is not a sync
+        return np.array([(r, r + 1) for r in rows])

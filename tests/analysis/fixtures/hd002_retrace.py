@@ -1,0 +1,60 @@
+"""HD002 fixture: jit retrace / recompile hazards."""
+
+import functools
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def recompiles_per_call(x):
+    fn = jax.jit(lambda v: v * 2)  # BAD: fresh executable every call
+    return fn(x)
+
+
+@functools.lru_cache(maxsize=None)
+def cached_factory(n):
+    return jax.jit(lambda v: v * n)  # GOOD: factory is memoized
+
+
+_CACHE: dict = {}
+
+
+def dict_cached_factory(k):
+    fn = _CACHE.get(k)
+    if fn is None:
+        fn = _CACHE[k] = jax.jit(lambda v: v + k)  # GOOD: explicit cache
+    return fn
+
+
+class Kernelized:
+    def __init__(self):
+        self.scale = 2.0
+        self._fn = jax.jit(self._impl)  # GOOD: per-instance cache
+
+    def _impl(self, v):
+        return v * 2
+
+    @jax.jit
+    def bad_method(self, v):
+        return v * self.scale  # BAD: jitted body closes over self
+
+
+@partial(jax.jit, static_argnames=("opts",))
+def bad_static_default(v, opts=[]):  # BAD: mutable static default
+    return v
+
+
+@jax.jit
+def bad_branch(x, n):
+    if x > 0:  # BAD: python branch on a traced value
+        return x * n
+    return x
+
+
+@jax.jit
+def good_branch(x):
+    pad = x.shape[0] - 1
+    if pad:  # GOOD: shape-derived, static under trace
+        x = jnp.pad(x, (0, pad))
+    return x

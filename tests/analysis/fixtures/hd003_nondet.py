@@ -1,0 +1,36 @@
+# hdlint: scope=digest
+"""HD003 fixture: nondeterministic iteration feeding a digest."""
+
+
+def digest_over_union(maps):
+    acc = []
+    for h in set().union(*[set(c) for c in maps]):  # BAD: hash order
+        acc.append(h)
+    return acc
+
+
+def digest_over_literal():
+    return [x for x in {3, 1, 2}]  # BAD: set literal iteration
+
+
+def digest_over_named_set(items):
+    seen = set(items)
+    out = b""
+    for s in seen:  # BAD: local known to be a set
+        out += s
+    return out
+
+
+def digest_over_binop(a, b):
+    return [x for x in set(a) | set(b)]  # BAD: set union operator
+
+
+def digest_sorted(maps):
+    out = []
+    for h in sorted(set().union(*[set(c) for c in maps])):  # GOOD
+        out.append(h)
+    return out
+
+
+def membership_is_fine(seen, x):
+    return x in seen and len(seen) > 0  # GOOD: not iteration

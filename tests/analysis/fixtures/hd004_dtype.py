@@ -1,0 +1,21 @@
+# hdlint: scope=ops
+"""HD004 fixture: dtype-width drift in a jnp kernel."""
+
+import jax.numpy as jnp
+
+
+def bad_wide_literal(x):
+    return jnp.bitwise_and(x, 0xFFFFFFFF00)  # BAD: width rides the x64 flag
+
+
+def bad_wide_table():
+    return jnp.asarray([0x123456789, 0x98765432AB])  # BAD: no dtype pin
+
+
+def good_pinned_table():
+    # GOOD: dtype pins the width, the literal is a documented constant
+    return jnp.asarray([0xFFFFFFFF & 0x6A09E667F3BCC908], dtype=jnp.uint32)
+
+
+def good_narrow(x):
+    return x + 0x7FFFFFFF  # GOOD: fits int32

@@ -1,0 +1,12 @@
+# hdlint: scope=hot,digest
+"""Suppression fixture: every violation is waived with a reason, so a
+default run reports nothing and --strict stays clean too."""
+
+
+def annotated_sync(x):
+    return x.item()  # hdlint: disable=HD001 one scalar per commit, measured in BENCH.md
+
+
+def annotated_union(maps):
+    # hdlint: disable=HD003 order feeds a set, not a digest
+    return [h for h in set().union(*maps)]

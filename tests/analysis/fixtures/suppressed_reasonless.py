@@ -1,0 +1,7 @@
+# hdlint: scope=hot
+"""Suppression-hygiene fixture: the waiver has no reason, so a default
+run is clean but --strict reports HD000."""
+
+
+def waived_without_reason(x):
+    return x.item()  # hdlint: disable=HD001

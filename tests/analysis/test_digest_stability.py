@@ -1,0 +1,77 @@
+"""Digest-stability regression for the sim.py set-union ordering fix.
+
+``assert_safety`` walks the union of every replica's committed heights;
+before the fix that union was iterated in raw ``set`` order, which is
+hash-seed dependent, so the first reported violation — and anything
+digesting the walk — drifted between interpreter invocations. The walk
+is now sorted, and ``commit_digest()`` is the regression handle: two
+runs that committed the same chain must produce the same hex digest no
+matter how the commit maps were built up.
+"""
+
+from hyperdrive_tpu.harness import Simulation
+from hyperdrive_tpu.harness.sim import SimulationResult
+
+
+def result_with(commits):
+    return SimulationResult(
+        completed=True,
+        steps=0,
+        virtual_time=0.0,
+        heights=[max(c) for c in commits],
+        commits=commits,
+        record=None,
+        alive=[True] * len(commits),
+    )
+
+
+def chain(heights, order):
+    """One replica's commit map with a chosen dict insertion order."""
+    vals = {h: bytes([h % 251]) * 32 for h in heights}
+    return {h: vals[h] for h in order}
+
+
+def test_digest_ignores_commit_map_insertion_order():
+    heights = list(range(1, 40))
+    forward = result_with([chain(heights, heights)] * 3)
+    backward = result_with([chain(heights, heights[::-1])] * 3)
+    shuffled = result_with(
+        [chain(heights, sorted(heights, key=lambda h: (h * 7919) % 101))] * 3
+    )
+    assert forward.commit_digest() == backward.commit_digest()
+    assert forward.commit_digest() == shuffled.commit_digest()
+
+
+def test_digest_merges_partial_overlapping_maps():
+    heights = list(range(1, 21))
+    full = result_with([chain(heights, heights)])
+    # Replicas that each saw only a slice of the chain still merge to the
+    # same canonical digest — coverage, not replica count, is what's hashed.
+    halves = result_with(
+        [chain(heights[:12], heights[:12]), chain(heights[8:], heights[8:])]
+    )
+    assert full.commit_digest() == halves.commit_digest()
+
+
+def test_digest_detects_value_tamper():
+    heights = list(range(1, 10))
+    honest = result_with([chain(heights, heights)])
+    tampered_map = chain(heights, heights)
+    tampered_map[5] = bytes([0xEE]) * 32
+    tampered = result_with([tampered_map])
+    assert honest.commit_digest() != tampered.commit_digest()
+
+
+def test_digest_distinguishes_adjacent_heights():
+    # The length-prefixed encoding must not let (h, v) pairs alias across
+    # boundaries: same byte soup, different framing.
+    a = result_with([{1: b"\x01" * 32, 2: b"\x02" * 32}])
+    b = result_with([{1: b"\x02" * 32, 2: b"\x01" * 32}])
+    assert a.commit_digest() != b.commit_digest()
+
+
+def test_identical_seeds_produce_identical_digests():
+    a = Simulation(n=4, target_height=3, seed=91).run()
+    b = Simulation(n=4, target_height=3, seed=91).run()
+    assert a.completed and b.completed
+    assert a.commit_digest() == b.commit_digest()

@@ -1,0 +1,191 @@
+"""hdlint engine + rule specs, driven over the fixture corpus.
+
+The fixtures under ``fixtures/`` are the rule-by-rule contract: every
+line commented BAD must be flagged, every line commented GOOD must not.
+The repo itself is the other half of the contract: a default strict run
+over the installed package must be clean (the CI gate).
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from hyperdrive_tpu.analysis.__main__ import main
+from hyperdrive_tpu.analysis.engine import FileContext, lint_paths
+from hyperdrive_tpu.analysis.rules import ALL_RULES, default_rules
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def run_on(path, rules=None, strict=False):
+    findings, errors = lint_paths(
+        [path], rules if rules is not None else default_rules(), strict=strict
+    )
+    assert not errors, errors
+    return findings
+
+
+def lines_of(findings, rule):
+    return sorted({f.line for f in findings if f.rule == rule})
+
+
+# ------------------------------------------------------------ fixture corpus
+
+
+def test_hd001_fixture_flags_every_bad_sync_shape():
+    findings = run_on(os.path.join(FIXTURES, "hd001_host_sync.py"))
+    assert {f.rule for f in findings} == {"HD001"}
+    # .item, block_until_ready, np.asarray(self...), np.asarray(jnp...),
+    # bool(self-method), per-element cast — and nothing on the GOOD lines.
+    assert len(findings) == 6
+    src = open(os.path.join(FIXTURES, "hd001_host_sync.py")).read()
+    bad_lines = {
+        i + 1 for i, text in enumerate(src.splitlines()) if "# BAD" in text
+    }
+    assert set(lines_of(findings, "HD001")) == bad_lines
+
+
+def test_hd002_fixture_flags_retrace_hazards_not_cached_factories():
+    findings = run_on(os.path.join(FIXTURES, "hd002_retrace.py"))
+    assert {f.rule for f in findings} == {"HD002"}
+    msgs = " | ".join(f.message for f in findings)
+    assert "no compile cache" in msgs
+    assert "references 'self'" in msgs
+    assert "mutable default" in msgs
+    assert "branch on a traced value" in msgs
+    assert len(findings) == 4
+
+
+def test_hd003_fixture_flags_set_iteration_not_sorted_or_membership():
+    findings = run_on(os.path.join(FIXTURES, "hd003_nondet.py"))
+    assert {f.rule for f in findings} == {"HD003"}
+    assert len(findings) == 4
+
+
+def test_hd004_fixture_flags_wide_literals_without_dtype_pin():
+    findings = run_on(os.path.join(FIXTURES, "hd004_dtype.py"))
+    assert {f.rule for f in findings} == {"HD004"}
+    assert len(findings) == 3
+
+
+def test_suppressed_fixture_is_clean_even_in_strict():
+    path = os.path.join(FIXTURES, "suppressed_clean.py")
+    assert run_on(path) == []
+    assert run_on(path, strict=True) == []
+
+
+def test_reasonless_suppression_passes_default_fails_strict():
+    path = os.path.join(FIXTURES, "suppressed_reasonless.py")
+    assert run_on(path) == []
+    strict = run_on(path, strict=True)
+    assert [f.rule for f in strict] == ["HD000"]
+
+
+# ------------------------------------------------------------- repo is clean
+
+
+def test_repo_passes_strict():
+    """The acceptance gate CI runs: the installed package lints clean."""
+    assert main(["--strict"]) == 0
+
+
+# ---------------------------------------------------------------- CLI shape
+
+
+def test_cli_exit_codes_on_fixture_corpus():
+    assert main([FIXTURES]) == 1
+    assert main([os.path.join(FIXTURES, "suppressed_clean.py")]) == 0
+    assert main(["--list-rules"]) == 0
+    assert main(["--rules", "HD999", FIXTURES]) == 2
+
+
+def test_cli_rule_selection_limits_findings(capsys):
+    assert main(["--rules", "HD003", FIXTURES]) == 1
+    out = capsys.readouterr().out
+    assert "HD003" in out
+    assert "HD001" not in out
+
+
+# -------------------------------------------------------- scopes + hot_path
+
+
+def test_hot_path_decorator_extends_hd001_beyond_scoped_files(tmp_path):
+    src = textwrap.dedent(
+        """
+        from hyperdrive_tpu.analysis.annotations import hot_path
+
+        @hot_path
+        def settle(x):
+            return x.item()
+
+        def cold(x):
+            return x.item()
+        """
+    )
+    p = tmp_path / "elsewhere.py"
+    p.write_text(src)
+    findings = run_on(str(p))
+    assert len(findings) == 1  # only the @hot_path body is audited
+    assert findings[0].rule == "HD001"
+
+
+def test_unscoped_file_is_exempt_from_path_scoped_rules(tmp_path):
+    p = tmp_path / "free.py"
+    p.write_text("for x in {1, 2, 3}:\n    print(x)\n")
+    assert run_on(str(p)) == []
+
+
+def test_scope_pragma_opts_a_file_in(tmp_path):
+    p = tmp_path / "opted.py"
+    p.write_text(
+        "# hdlint: scope=digest\nfor x in {1, 2, 3}:\n    print(x)\n"
+    )
+    findings = run_on(str(p))
+    assert [f.rule for f in findings] == ["HD003"]
+
+
+def test_device_fetch_subtree_is_exempt(tmp_path):
+    p = tmp_path / "fetchy.py"
+    p.write_text(
+        "# hdlint: scope=hot\n"
+        "from hyperdrive_tpu.analysis.annotations import device_fetch\n"
+        "def f(pending):\n"
+        "    return [bool(b) for b in device_fetch(pending.mask())]\n"
+    )
+    assert run_on(str(p)) == []
+
+
+def test_suppression_on_preceding_line_covers_next_line():
+    ctx = FileContext(
+        "x.py",
+        "# hdlint: scope=digest\n"
+        "# hdlint: disable=HD003 replay order fixed upstream\n"
+        "out = [x for x in {1, 2}]\n",
+    )
+    findings = []
+    for rule in default_rules():
+        findings.extend(rule.check(ctx))
+    assert findings, "sanity: the set iteration is flagged pre-suppression"
+    assert all(ctx.suppressed(f) for f in findings)
+
+
+def test_rule_catalog_is_complete():
+    assert set(ALL_RULES) == {"HD001", "HD002", "HD003", "HD004"}
+    for cls in ALL_RULES.values():
+        assert cls.summary and cls.name
+
+
+@pytest.mark.parametrize("snippet,expect", [
+    # jit stored on self in __init__: a per-instance compile cache
+    ("import jax\nclass A:\n    def __init__(self):\n"
+     "        self._fn = jax.jit(lambda v: v)\n", 0),
+    # jit returned from a factory: the caller owns the lifetime
+    ("import jax\ndef make():\n    return jax.jit(lambda v: v)\n", 0),
+    # jit called inline per invocation: the actual hazard
+    ("import jax\ndef f(x):\n    return jax.jit(lambda v: v)(x)\n", 1),
+])
+def test_hd002_cache_exemptions(tmp_path, snippet, expect):
+    p = tmp_path / "jits.py"
+    p.write_text(snippet)
+    assert len(run_on(str(p))) == expect

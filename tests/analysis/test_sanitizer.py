@@ -1,0 +1,248 @@
+"""Consensus sanitizer (HDS001–HDS004) specs.
+
+The Byzantine-window scenario: a corrupted device tally (a lying
+TallyView — the failure class HDS001 exists for) claims a 2f+1 quorum
+the host message logs do not hold. The sanitizer recounts every commit
+from the logs and must block it with the rule name in the error. The
+other invariants get targeted corruption tests of their own, plus
+positive controls proving honest runs sail through untouched.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from hyperdrive_tpu.analysis.sanitizer import (
+    SanitizerError,
+    _SanitizedBroadcaster,
+    _SanitizedCommitter,
+    enabled,
+    install,
+    maybe_install,
+    maybe_tally_check,
+)
+from hyperdrive_tpu.messages import Precommit, Propose
+from hyperdrive_tpu.process import Process
+from hyperdrive_tpu.replica import Replica, ReplicaOptions
+from hyperdrive_tpu.testutil import (
+    BroadcasterCallbacks,
+    CommitterCallback,
+    MockProposer,
+    MockScheduler,
+    MockValidator,
+)
+from hyperdrive_tpu.types import INVALID_ROUND
+
+
+def sig(i: int) -> bytes:
+    return bytes([i]) * 32
+
+
+WHOAMI = sig(1)
+PROPOSER = sig(2)
+OTHERS = [sig(3), sig(4), sig(5)]
+VALUE = bytes([0xAB]) * 32
+
+
+def make_proc(sanitize=True):
+    rec = SimpleNamespace(commits=[], prevotes=[], precommits=[], proposes=[])
+    proc = Process(
+        whoami=WHOAMI,
+        f=1,
+        timer=None,
+        scheduler=MockScheduler(PROPOSER),
+        proposer=MockProposer(value=VALUE),
+        validator=MockValidator(ok=True),
+        broadcaster=BroadcasterCallbacks(
+            on_propose=rec.proposes.append,
+            on_prevote=rec.prevotes.append,
+            on_precommit=rec.precommits.append,
+        ),
+        committer=CommitterCallback(
+            on_commit=lambda h, v: (rec.commits.append((h, v)), (0, None))[1]
+        ),
+        catcher=None,
+        height=1,
+    )
+    if sanitize:
+        install(proc)
+    return proc, rec
+
+
+def deliver_valid_proposal(proc):
+    proc.start()
+    proc.propose(Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                         value=VALUE, sender=PROPOSER))
+    assert proc.state.propose_is_valid.get(0), "fixture: proposal must log"
+
+
+class LyingTallyView:
+    """Claims a 2f+1 precommit quorum regardless of what the logs hold —
+    the observable behaviour of a corrupted / Byzantine device tally."""
+
+    def __init__(self, height, claimed):
+        self.height = height
+        self.rep = 0
+        self._claimed = claimed
+
+    def prevotes_for(self, rnd, value):
+        return None  # decline: cascade falls back to host counters
+
+    def precommits_for(self, rnd, value):
+        return self._claimed
+
+    def prevote_total(self, rnd):
+        return None
+
+    def precommit_total(self, rnd):
+        return self._claimed
+
+
+# ------------------------------------------------------- HDS001 (2f+1 recount)
+
+
+def test_byzantine_device_tally_cannot_force_commit():
+    proc, rec = make_proc()
+    deliver_valid_proposal(proc)
+    # One real precommit in the logs; quorum needs 2f+1 = 3.
+    proc.precommit(Precommit(height=1, round=0, value=VALUE,
+                             sender=OTHERS[0]))
+
+    with pytest.raises(SanitizerError, match="^HDS001") as exc:
+        proc.ingest_cascade(({0}, set()), tallies=LyingTallyView(1, 3))
+    assert exc.value.rule == "HDS001"
+    assert rec.commits == [], "the lying tally must not reach the app"
+
+
+def test_honest_quorum_commits_through_the_sanitizer():
+    proc, rec = make_proc()
+    deliver_valid_proposal(proc)
+    for s in OTHERS:
+        proc.precommit(Precommit(height=1, round=0, value=VALUE, sender=s))
+    assert rec.commits == [(1, VALUE)]
+    assert proc.state.current_height == 2
+
+
+# -------------------------------------------------- HDS002 (locked <= current)
+
+
+def test_corrupted_locked_round_surfaces_with_rule_name():
+    proc, rec = make_proc()
+    proc.start()
+    proc.state.locked_round = 5  # corruption: lock a round never reached
+    with pytest.raises(SanitizerError, match="^HDS002") as exc:
+        proc.propose(Propose(height=1, round=0, valid_round=INVALID_ROUND,
+                             value=VALUE, sender=PROPOSER))
+    assert exc.value.rule == "HDS002"
+
+
+# ------------------------------------------------- HDS003 (height monotonic)
+
+
+def test_commit_at_wrong_height_is_blocked():
+    proc, rec = make_proc()
+    with pytest.raises(SanitizerError, match="^HDS003"):
+        proc.committer.commit(99, VALUE)
+    assert rec.commits == []
+
+
+def test_replayed_commit_height_is_blocked():
+    proc, rec = make_proc()
+    deliver_valid_proposal(proc)
+    for s in OTHERS:
+        proc.precommit(Precommit(height=1, round=0, value=VALUE, sender=s))
+    assert rec.commits == [(1, VALUE)]
+    proc.state.current_height = 1  # roll the automaton back behind its commit
+    with pytest.raises(SanitizerError, match="^HDS003"):
+        proc.committer.commit(1, VALUE)
+
+
+# ------------------------------------------------- HDS004 (settle-path parity)
+
+
+def test_device_host_tally_divergence_surfaces_with_rule_name(monkeypatch):
+    monkeypatch.setenv("HD_SANITIZE", "1")
+    factory = maybe_tally_check()
+    assert factory is not None
+    view = SimpleNamespace(
+        height=1, rep=0,
+        prevotes_for=lambda rnd, value: 7,
+        precommits_for=lambda rnd, value: None,
+        prevote_total=lambda rnd: None,
+        precommit_total=lambda rnd: None,
+    )
+    proc = SimpleNamespace(
+        state=SimpleNamespace(count_prevotes_for=lambda rnd, value: 2)
+    )
+    checked = factory(view, proc)
+    with pytest.raises(SanitizerError, match="^HDS004") as exc:
+        checked.prevotes_for(0, VALUE)
+    assert exc.value.rule == "HDS004"
+
+
+def test_matching_tallies_pass_the_parity_check(monkeypatch):
+    monkeypatch.setenv("HD_SANITIZE", "1")
+    factory = maybe_tally_check()
+    view = SimpleNamespace(
+        height=1, rep=0,
+        prevotes_for=lambda rnd, value: 2,
+        precommits_for=lambda rnd, value: None,
+        prevote_total=lambda rnd: None,
+        precommit_total=lambda rnd: None,
+    )
+    proc = SimpleNamespace(
+        state=SimpleNamespace(count_prevotes_for=lambda rnd, value: 2)
+    )
+    checked = factory(view, proc)
+    assert checked.prevotes_for(0, VALUE) == 2
+    assert checked.hits == 1
+
+
+# ----------------------------------------------------------- wiring + toggles
+
+
+def test_env_toggle_gates_installation(monkeypatch):
+    monkeypatch.setenv("HD_SANITIZE", "0")
+    assert not enabled()
+    proc, _ = make_proc(sanitize=False)
+    before = proc.committer
+    maybe_install(proc)
+    assert proc.committer is before
+    assert maybe_tally_check() is None
+
+    monkeypatch.setenv("HD_SANITIZE", "1")
+    assert enabled()
+    maybe_install(proc)
+    assert isinstance(proc.committer, _SanitizedCommitter)
+    assert isinstance(proc.broadcaster, _SanitizedBroadcaster)
+
+
+def test_install_is_idempotent():
+    proc, _ = make_proc()
+    once = proc.committer
+    install(proc)
+    assert proc.committer is once
+
+
+def test_replica_installs_sanitizer_by_default(monkeypatch):
+    monkeypatch.setenv("HD_SANITIZE", "1")
+
+    class AppCommitter:
+        def commit(self, height, value):
+            return 0, None
+
+    replica = Replica(
+        opts=ReplicaOptions(),
+        whoami=WHOAMI,
+        signatories=[WHOAMI, PROPOSER] + OTHERS,
+        timer=None,
+        proposer=MockProposer(value=VALUE),
+        validator=MockValidator(ok=True),
+        committer=AppCommitter(),
+        catcher=None,
+        broadcaster=BroadcasterCallbacks(),
+    )
+    assert isinstance(replica.proc.committer, _SanitizedCommitter)
+    # The sanitizer wraps the replica's tracing committer, which wraps
+    # the app's: attribute access falls through the whole chain.
+    assert replica.proc.committer.commit is not None

@@ -10,6 +10,10 @@ import random
 
 import pytest
 
+# Consensus sanitizer (ANALYSIS.md): tier-1 runs with the HDS invariant
+# checks active unless the caller opted out explicitly (HD_SANITIZE=0).
+os.environ.setdefault("HD_SANITIZE", "1")
+
 # The container exports JAX_PLATFORMS=axon and a sitecustomize that
 # re-registers the TPU plugin, so env vars alone don't stick — force the
 # platform through jax.config before any backend initializes.

@@ -66,7 +66,7 @@ def test_rejections_match_host(verifier, ring, rng):
 def test_random_differential(verifier, ring, rng):
     # Random mix of valid/corrupted; device must match host bit-for-bit.
     items = []
-    for i in range(32):
+    for _i in range(32):
         kp = ring[rng.randrange(len(ring))]
         msg = rng.randbytes(rng.randint(0, 64))
         sig = host_ed.sign(kp.seed, msg)
@@ -167,7 +167,7 @@ def test_rlc_malformed_lanes_skip_fallback(rlc_verifier, ring):
 
 def test_rlc_differential_random(rlc_verifier, ring, rng):
     items = []
-    for i in range(24):
+    for _i in range(24):
         kp = ring[rng.randrange(len(ring))]
         msg = rng.randbytes(rng.randint(0, 48))
         sig = host_ed.sign(kp.seed, msg)

@@ -55,7 +55,7 @@ def _oracle(items):
 
 def test_wire_matches_oracle_valid_and_corrupted(ring, rng):
     items = []
-    for i in range(24):
+    for _i in range(24):
         kp = ring[rng.randrange(len(ring))]
         msg = rng.randbytes(rng.randint(0, 64))
         sig = host_ed.sign(kp.seed, msg)

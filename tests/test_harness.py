@@ -72,7 +72,7 @@ def test_sub_quorum_network_stalls():
     sim = Simulation(n=10, target_height=3, seed=19, offline={6, 7, 8, 9})
     res = sim.run(max_steps=40_000)
     assert not res.completed
-    for c, alive in zip(res.commits, res.alive):
+    for c, _alive in zip(res.commits, res.alive):
         assert not c  # nothing can ever commit below quorum
     res.assert_safety()
 
@@ -197,7 +197,7 @@ def test_forged_signature_blocks_vote():
     from hyperdrive_tpu.messages import Prevote
 
     sim = Simulation(n=4, target_height=2, seed=59, sign=True)
-    for i, r in enumerate(sim.replicas):
+    for _i, r in enumerate(sim.replicas):
         r.start()
     # Inject a vote with a forged signature from a legitimate sender.
     forged = Prevote(
@@ -822,7 +822,7 @@ def test_payload_tampered_bundle_is_invalid():
     from hyperdrive_tpu.messages import Propose
 
     sim = Simulation(n=4, target_height=2, seed=103, payload_bytes=31)
-    for i, r in enumerate(sim.replicas):
+    for _i, r in enumerate(sim.replicas):
         r.start()
     legit = None
     while sim.queue:

@@ -279,7 +279,7 @@ def test_drain_all_matches_drain_window_order(rng):
     # the IDENTICAL sequence for any backlog.
     mq1, mq2 = MessageQueue(), MessageQueue()
     msgs = []
-    for i in range(200):
+    for _i in range(200):
         m = pv(sig(rng.randint(1, 9)), rng.randint(1, 4), rng.randint(0, 3))
         msgs.append(m)
     for m in msgs:

@@ -84,7 +84,7 @@ def test_equivocation_metrics_and_logging():
     from hyperdrive_tpu.messages import Propose
 
     sim = Simulation(n=4, target_height=2, seed=73)
-    for i, r in enumerate(sim.replicas):
+    for _i, r in enumerate(sim.replicas):
         r.start()
     # Deliver one legit propose to replica 0, then a conflicting one.
     legit = None
