@@ -497,7 +497,34 @@ def main():
         "unit": "votes/s",
         "vs_baseline": round(votes_per_sec / TARGET_VOTES_PER_SEC, 4),
         **r,
+        **_consensus_metrics(),
     }))
+
+
+def _consensus_metrics() -> dict:
+    """Tracer snapshot + commit anatomy from a small observed host sim.
+
+    The headline number above is the wire pipeline alone; this rider
+    makes the artifact self-describing about the consensus side too — a
+    fixed-seed 4-replica run whose metric registry and per-phase
+    commit-latency breakdown (OBSERVABILITY.md) land in the same JSON
+    line, so artifact diffs catch regressions in either half.
+    """
+    try:
+        from hyperdrive_tpu.harness import Simulation
+        from hyperdrive_tpu.obs.report import phase_summary
+
+        sim = Simulation(n=4, target_height=5, seed=91, timeout=20.0,
+                         delivery_cost=0.001, observe=True)
+        res = sim.run()
+        if not res.completed:
+            return {}
+        return {
+            "tracer_snapshot": sim.tracer.snapshot(),
+            "commit_anatomy": phase_summary(sim.obs.snapshot()),
+        }
+    except Exception as e:  # the rider must never sink the headline run
+        return {"consensus_metrics_error": str(e)}
 
 
 if __name__ == "__main__":

@@ -59,7 +59,7 @@ def _sim_metrics(sim, res, wall: float) -> dict:
     snap = sim.tracer.snapshot()
     lat = snap["histograms"].get("replica.height.latency", {})
     rounds = snap["histograms"].get("replica.commit.rounds", {})
-    return {
+    out = {
         "completed": res.completed,
         "steps": res.steps,
         "wall_s": round(wall, 3),
@@ -67,14 +67,26 @@ def _sim_metrics(sim, res, wall: float) -> dict:
         "virtual_time": round(res.virtual_time, 3),
         "p50_height_latency_virtual": round(lat.get("p50", 0.0), 6),
         "mean_rounds_per_height": round(rounds.get("mean", 1.0), 3),
+        # The full metric registry rides along so BENCH_r*.json is a
+        # self-contained record: a regression diff never needs a re-run
+        # to ask "what did sim.settle.* look like that day".
+        "tracer_snapshot": snap,
     }
+    if len(sim.obs):
+        from hyperdrive_tpu.obs.report import phase_summary
+
+        # Per-phase commit-latency anatomy over the recorder's retained
+        # window (the ring keeps the most recent obs_capacity events).
+        out["commit_anatomy"] = phase_summary(sim.obs.snapshot())
+    return out
 
 
 def config_1() -> dict:
     from hyperdrive_tpu.harness import Simulation
 
     t0 = time.perf_counter()
-    sim = Simulation(n=4, target_height=100, seed=1001, timeout=20.0, delivery_cost=0.001)
+    sim = Simulation(n=4, target_height=100, seed=1001, timeout=20.0,
+                     delivery_cost=0.001, observe=True)
     res = sim.run()
     wall = time.perf_counter() - t0
     res.assert_safety()
@@ -88,7 +100,8 @@ def config_2() -> dict:
     from hyperdrive_tpu.harness import Simulation
 
     t0 = time.perf_counter()
-    sim = Simulation(n=16, target_height=1000, seed=1002, timeout=20.0, delivery_cost=0.001)
+    sim = Simulation(n=16, target_height=1000, seed=1002, timeout=20.0,
+                     delivery_cost=0.001, observe=True)
     res = sim.run(max_steps=5_000_000)
     wall = time.perf_counter() - t0
     res.assert_safety()
@@ -125,7 +138,7 @@ def config_3() -> dict:
     t0 = time.perf_counter()
     sim = Simulation(
         n=64, target_height=heights, seed=1003, reorder=True, offline=offline,
-        timeout=20.0, delivery_cost=0.001,
+        timeout=20.0, delivery_cost=0.001, observe=True,
     )
     res = sim.run(max_steps=5_000_000)
     wall = time.perf_counter() - t0
@@ -266,11 +279,11 @@ def _run_signed_burst_paired(ver, heights: int, seed: int, block: int = 20,
             a["verified"] += int(
                 launch.get("count", 0) * launch.get("mean", 0.0)
             )
-            sync = hists.get("sim.fused.sync_s", {})
+            sync = hists.get("sim.fused.sync.latency", {})
             if sync.get("count"):
                 a["sync_count"] += int(sync["count"])
                 a["sync_p50s"].append(float(sync.get("p50", 0.0)))
-            casc = hists.get("sim.fused.cascade_s", {})
+            casc = hists.get("sim.fused.cascade.latency", {})
             if casc.get("count"):
                 a["cascade_p50s"].append(float(casc.get("p50", 0.0)))
             routed = hists.get("sim.settle.host_routed", {})
@@ -999,7 +1012,7 @@ def config_8() -> dict:
             "heights_per_s": round(2 / wall, 4),
             **window_stats(sim),
             "fused_syncs": int(
-                hists.get("sim.fused.sync_s", {}).get("count", 0)
+                hists.get("sim.fused.sync.latency", {}).get("count", 0)
             ),
             "host_routed_settles": int(
                 hists.get("sim.settle.host_routed", {}).get("count", 0)

@@ -16,7 +16,20 @@ budget.
 
 from __future__ import annotations
 
-__all__ = ["hot_path", "device_fetch"]
+__all__ = ["hot_path", "device_fetch", "set_fetch_observer"]
+
+#: Optional callback invoked with the ``why`` string on every
+#: device_fetch — the flight recorder's tap (obs/recorder.py). Module
+#: global, not thread-local: the sim installs it for the duration of an
+#: observed run and removes it in a finally; the default None keeps the
+#: fetch path at one global load.
+_fetch_observer = None
+
+
+def set_fetch_observer(cb) -> None:
+    """Install (or, with None, remove) the device_fetch observer."""
+    global _fetch_observer
+    _fetch_observer = cb
 
 
 def hot_path(fn=None):
@@ -50,4 +63,6 @@ def device_fetch(x, *, why: str = ""):
     """
     import numpy as np
 
+    if _fetch_observer is not None:
+        _fetch_observer(why)
     return np.asarray(x)

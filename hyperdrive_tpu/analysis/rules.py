@@ -1,4 +1,4 @@
-"""hdlint rules HD001–HD004.
+"""hdlint rules HD001–HD005.
 
 Every rule is a heuristic tuned against THIS repo's idioms (see
 ANALYSIS.md for the catalog with examples). False positives are waived
@@ -9,11 +9,12 @@ of the syntax, so the waiver ledger stays reviewable.
 from __future__ import annotations
 
 import ast
+import re
 
 from hyperdrive_tpu.analysis.engine import Finding
 
 __all__ = ["ALL_RULES", "default_rules", "HostSyncRule", "RetraceRule",
-           "NondetIterRule", "DtypeWidthRule"]
+           "NondetIterRule", "DtypeWidthRule", "MetricNameRule"]
 
 _CASTS = frozenset({"int", "float", "bool"})
 _NP_CONVERTERS = frozenset(
@@ -636,9 +637,94 @@ class DtypeWidthRule:
             self._scan(child, path, findings, protected)
 
 
+# ------------------------------------------------------------------- HD005
+
+class MetricNameRule:
+    """HD005: metric / event names must be static lowercase dotted names.
+
+    Tracer metrics (``tracer.count/observe/span``) and flight-recorder
+    events (``obs.emit``) form a queryable taxonomy: dashboards, bench
+    diffs and the obs CLI all key on exact strings. A name built per
+    call — an f-string, ``+`` concatenation, ``str.format`` — forks the
+    taxonomy silently (``replica.caught.double_propose`` vs a typo'd
+    interpolation) and defeats grep. It can also allocate a fresh
+    counter per distinct value, unbounding the registry.
+
+    Applies in every file (the receiver leaf — ``tracer``, ``obs``,
+    ``recorder`` — is the scope). The name argument must be one of:
+
+    * a string literal matching ``segment(.segment)*`` of
+      ``[a-z0-9_]`` — the documented ``<subsystem>.<stage>.<event>``
+      shape;
+    * a name / attribute / subscript — a table lookup
+      (``_MSG_METRIC[t]``), where the table's literals are checked at
+      their definition site by the same grep-ability argument;
+    * a ``<table>.get(...)`` call — the dict-with-default lookup idiom;
+    * a conditional expression whose arms are themselves allowed.
+
+    Everything else — f-strings, concatenation, ``.format()``/arbitrary
+    call results, non-conforming literals — is flagged.
+    """
+
+    code = "HD005"
+    name = "dynamic-metric-name"
+    summary = "tracer/recorder metric name built per call or malformed"
+
+    _METHODS = frozenset({"count", "observe", "span", "emit"})
+    _RECEIVERS = frozenset({"tracer", "obs", "recorder"})
+    _NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+
+    def check(self, ctx):
+        findings: list = []
+        for n in ast.walk(ctx.tree):
+            if not (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in self._METHODS
+                and n.args
+            ):
+                continue
+            recv = _dotted(n.func.value)
+            if recv is None or recv.split(".")[-1] not in self._RECEIVERS:
+                continue
+            problem = self._problem(n.args[0])
+            if problem:
+                findings.append(Finding(
+                    self.code, ctx.path, n.lineno,
+                    f"metric name for .{n.func.attr}() {problem}; use a "
+                    "lowercase dotted literal or a lookup into a literal "
+                    "table",
+                ))
+        return findings
+
+    def _problem(self, arg):
+        """None if ``arg`` is an acceptable name form, else a description."""
+        if isinstance(arg, ast.Constant):
+            if isinstance(arg.value, str) and self._NAME_RE.match(arg.value):
+                return None
+            return f"literal {arg.value!r} is not lowercase dotted form"
+        if isinstance(arg, (ast.Name, ast.Attribute, ast.Subscript)):
+            return None  # table lookup; literals audited where defined
+        if isinstance(arg, ast.IfExp):
+            return self._problem(arg.body) or self._problem(arg.orelse)
+        if isinstance(arg, ast.JoinedStr):
+            return "is an f-string built per call"
+        if isinstance(arg, ast.BinOp):
+            return "is concatenated per call"
+        if isinstance(arg, ast.Call):
+            if (
+                isinstance(arg.func, ast.Attribute)
+                and arg.func.attr == "get"
+            ):
+                return None  # dict .get(key, default) lookup
+            return "is a call result, not a static name"
+        return "is not a static name"
+
+
 ALL_RULES = {
     r.code: r
-    for r in (HostSyncRule, RetraceRule, NondetIterRule, DtypeWidthRule)
+    for r in (HostSyncRule, RetraceRule, NondetIterRule, DtypeWidthRule,
+              MetricNameRule)
 }
 
 

@@ -43,6 +43,7 @@ from hyperdrive_tpu.messages import (
     marshal_message,
     unmarshal_message,
 )
+from hyperdrive_tpu.obs.recorder import NULL_BOUND as _OBS_NULL
 from hyperdrive_tpu.replica import Replica, ReplicaOptions, merge_drain
 from hyperdrive_tpu.testutil import (
     BroadcasterCallbacks,
@@ -374,6 +375,8 @@ class Simulation:
         columnar_ingest: Optional[bool] = None,
         pipeline_verify: Optional[bool] = None,
         route_hysteresis: int = 32,
+        observe: bool = False,
+        obs_capacity: int = 65536,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -439,6 +442,25 @@ class Simulation:
 
         # The sim is single-threaded; skip the tracer's per-call locking.
         self.tracer = Tracer(time_fn=lambda: self.clock.now, threadsafe=False)
+        # Flight recorder (obs/recorder.py): a bounded, deterministic event
+        # journal on the same virtual clock, so fixed-seed runs are
+        # digest-identical (OBSERVABILITY.md). Off by default — NULL_RECORDER
+        # hands every replica the shared no-op handle, keeping disabled
+        # recording at one identity check per emit site.
+        from hyperdrive_tpu.obs.recorder import NULL_RECORDER, Recorder
+
+        self.obs = (
+            Recorder(
+                capacity=obs_capacity,
+                time_fn=lambda: self.clock.now,
+                threadsafe=False,
+            )
+            if observe
+            else NULL_RECORDER
+        )
+        #: Sim-level emit handle (replica = -1): settle/verify/tally launch
+        #: events that belong to the harness, not any one replica.
+        self._obs_sim = self.obs.scoped(-1)
         # The delivery queue is consumed via a head index (O(1) per step;
         # list.pop(0) would make 256-replica x 10k-height runs quadratic).
         self.queue: list[tuple[int, object]] = []
@@ -866,6 +888,7 @@ class Simulation:
                 tracer=self.tracer,
                 external_flush=self.burst,
                 batch_ingest=self.batch_ingest,
+                obs=self.obs.scoped(i),
             ),
             self.signatories[i],
             list(self.signatories),
@@ -908,6 +931,24 @@ class Simulation:
             for i, r in enumerate(self.replicas):
                 if self.alive[i]:
                     r.start()
+        obs = self._obs_sim
+        if obs is _OBS_NULL:
+            return self._run_delivery(max_steps)
+        # Observed run: tap every device_fetch for the journal. The
+        # observer is a module global (annotations.py), so install/remove
+        # brackets the run — nested observed sims are not a thing.
+        from hyperdrive_tpu.analysis.annotations import set_fetch_observer
+
+        set_fetch_observer(
+            lambda why: obs.emit("fetch.sync", -1, -1, why or None)
+        )
+        try:
+            return self._run_delivery(max_steps)
+        finally:
+            set_fetch_observer(None)
+
+    def _run_delivery(self, max_steps: int) -> SimulationResult:
+        """The delivery loop behind :meth:`run` (burst or lock-step)."""
         if self.burst:
             return self._run_burst(max_steps)
 
@@ -1163,6 +1204,9 @@ class Simulation:
                         windows.append((i, w))
             if not windows:
                 return
+            obs = self._obs_sim
+            if obs is not _OBS_NULL:
+                obs.emit("settle.pass", -1, -1, len(windows))
             if (
                 shared_window is not None
                 and self.device_tally
@@ -1525,6 +1569,8 @@ class Simulation:
             inflight = nxt
         self.tracer.count("sim.settle.pipelined")
         self.tracer.observe("sim.verify.launch", total_items)
+        if self._obs_sim is not _OBS_NULL:
+            self._obs_sim.emit("verify.launch", -1, -1, total_items)
 
     def _touched_slots(self, msgs) -> set:
         """The (plane, round) grid slots a window's votes would fill —
@@ -1599,6 +1645,8 @@ class Simulation:
                     row.append(j)
                 slots.append(row)
             self.tracer.observe("sim.verify.launch", len(items))
+            if self._obs_sim is not _OBS_NULL:
+                self._obs_sim.emit("verify.launch", -1, -1, len(items))
             mask = self._verify_items(items, force_host)
             shared_keep = (
                 mask if shared_len == len(mask) else mask[:shared_len]
@@ -1613,6 +1661,8 @@ class Simulation:
                 items.extend((m.sender, m.digest(), m.signature) for m in w)
                 bounds.append((start, len(items)))
             self.tracer.observe("sim.verify.launch", len(items))
+            if self._obs_sim is not _OBS_NULL:
+                self._obs_sim.emit("verify.launch", -1, -1, len(items))
             mask = self._verify_items(items, force_host)
             keeps = [mask[a:b] for a, b in bounds]
         return keeps
@@ -1754,6 +1804,8 @@ class Simulation:
             idx, words, reset, targets, tvalid, l28_slot, l28_target, fs
         )
         self.tracer.observe("sim.tally.launch", len(idx))
+        if self._obs_sim is not _OBS_NULL:
+            self._obs_sim.emit("tally.launch", -1, -1, len(idx))
 
         for i, plan in plans:
             view = TallyView(
@@ -1802,6 +1854,8 @@ class Simulation:
 
         items = [(m.sender, m.digest(), m.signature) for m in shared]
         self.tracer.observe("sim.verify.launch", len(items))
+        if self._obs_sim is not _OBS_NULL:
+            self._obs_sim.emit("verify.launch", h, -1, len(items))
         arrays, prevalid, nitems = self.batch_verifier.host.pack(items)
 
         # The dense one-superstep update image: one lane per (plane,
@@ -1847,6 +1901,8 @@ class Simulation:
             upd_vals[plane, rnd, v] = np.frombuffer(m.value, dtype="<i4")
             k += 1
         self.tracer.observe("sim.tally.launch", k)
+        if self._obs_sim is not _OBS_NULL:
+            self._obs_sim.emit("tally.launch", h, -1, k)
 
         # Per-replica launch metadata. Targets come from PRE-insert propose
         # logs plus this window's (schedule-checked) proposes — identical
@@ -1942,7 +1998,7 @@ class Simulation:
         keep = (fused_out.mask() & prevalid)[:nitems].tolist()
         counts = fused_out.counts()
         self.tracer.observe(
-            "sim.fused.sync_s", time.perf_counter() - t_sync
+            "sim.fused.sync.latency", time.perf_counter() - t_sync
         )
 
         t_host = time.perf_counter()
@@ -1977,11 +2033,12 @@ class Simulation:
             if self._tally_check is not None:
                 view = self._tally_check(view, self.replicas[i].proc)
             self.replicas[i].ingest_cascade_window(plan, view)
-        # Host insert+cascade wall time, the companion to sim.fused.sync_s:
-        # when cascade_s < sync_s, even a perfectly overlapped pipeline
-        # cannot hide the sync behind host work — the settle is RTT-bound.
+        # Host insert+cascade wall time, the companion to
+        # sim.fused.sync.latency: when the cascade leg is shorter than the
+        # sync leg, even a perfectly overlapped pipeline cannot hide the
+        # sync behind host work — the settle is RTT-bound.
         self.tracer.observe(
-            "sim.fused.cascade_s", time.perf_counter() - t_host
+            "sim.fused.cascade.latency", time.perf_counter() - t_host
         )
         return True
 

@@ -21,6 +21,7 @@ from bisect import bisect_right
 from typing import Callable, Iterable
 
 from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+from hyperdrive_tpu.obs.recorder import NULL_BOUND
 from hyperdrive_tpu.types import Height, Signatory
 
 __all__ = ["MessageQueue", "DEFAULT_MAX_CAPACITY"]
@@ -44,10 +45,20 @@ class MessageQueue:
     stale and dropped.
     """
 
-    __slots__ = ("max_capacity", "_queues", "_order", "_heads", "_head_key")
+    __slots__ = (
+        "max_capacity",
+        "_queues",
+        "_order",
+        "_heads",
+        "_head_key",
+        "obs",
+    )
 
     def __init__(self, max_capacity: int = DEFAULT_MAX_CAPACITY):
         self.max_capacity = int(max_capacity)
+        #: Flight-recorder handle (obs/recorder.py); the owning replica
+        #: rebinds it. Only the overflow branch ever touches it.
+        self.obs = NULL_BOUND
         self._queues: dict[Signatory, list[Message]] = {}
         #: sender -> stable tiebreak index (queue-creation order).
         self._order: dict[Signatory, int] = {}
@@ -145,6 +156,14 @@ class MessageQueue:
                 q.insert(idx, msg)
         # Drop the far-future tail when over capacity (reference: mq/mq.go:139-142).
         if len(q) > self.max_capacity:
+            if self.obs is not NULL_BOUND:
+                dropped = q[self.max_capacity]
+                self.obs.emit(
+                    "mq.drop",
+                    dropped.height,
+                    dropped.round,
+                    len(q) - self.max_capacity,
+                )
             del q[self.max_capacity :]
         if idx == 0:
             self._register_head(msg.sender)

@@ -1,0 +1,38 @@
+"""Consensus observability: flight recorder, anatomy report, traces.
+
+See OBSERVABILITY.md for the event taxonomy and CLI usage. The hot-path
+contract is the same as utils/trace.py's NULL_TRACER: components hold a
+recorder handle that defaults to the shared no-op singleton, and guard
+any non-trivial event construction with an identity check.
+"""
+
+from hyperdrive_tpu.obs.recorder import (
+    EVENT_KINDS,
+    NULL_BOUND,
+    NULL_RECORDER,
+    BoundRecorder,
+    Event,
+    NullBound,
+    NullRecorder,
+    Recorder,
+    load_journal,
+)
+from hyperdrive_tpu.obs.report import anatomy, phase_summary, render_table
+from hyperdrive_tpu.obs.perfetto import export, to_trace_events
+
+__all__ = [
+    "EVENT_KINDS",
+    "NULL_BOUND",
+    "NULL_RECORDER",
+    "BoundRecorder",
+    "Event",
+    "NullBound",
+    "NullRecorder",
+    "Recorder",
+    "load_journal",
+    "anatomy",
+    "phase_summary",
+    "render_table",
+    "export",
+    "to_trace_events",
+]

@@ -1,0 +1,127 @@
+"""``python -m hyperdrive_tpu.obs`` — record, report, export.
+
+    record  run a short observed sim and save its event journal
+    report  render the round-anatomy table from a saved journal
+    export  convert a saved journal to Perfetto/Chrome trace JSON
+
+``record`` exists so CI (and anyone without a saved journal) can go
+from nothing to a viewable trace in two commands:
+
+    python -m hyperdrive_tpu.obs record -o journal.json
+    python -m hyperdrive_tpu.obs export journal.json -o trace.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from hyperdrive_tpu.obs.recorder import load_journal
+from hyperdrive_tpu.obs.report import anatomy, phase_summary, render_table
+from hyperdrive_tpu.obs.perfetto import export
+
+
+def _cmd_record(ns):
+    # Imported here: the sim pulls in jax; report/export stay stdlib.
+    from hyperdrive_tpu.harness import Simulation
+
+    sim = Simulation(
+        n=ns.replicas,
+        target_height=ns.heights,
+        seed=ns.seed,
+        timeout=ns.timeout,
+        delivery_cost=ns.delivery_cost,
+        observe=True,
+    )
+    res = sim.run()
+    sim.obs.save(ns.output)
+    print(
+        json.dumps(
+            {
+                "completed": res.completed,
+                "events": len(sim.obs),
+                "dropped": sim.obs.dropped,
+                "digest": sim.obs.digest(),
+                "journal": ns.output,
+            }
+        )
+    )
+    return 0 if res.completed else 1
+
+
+def _cmd_report(ns):
+    journal = load_journal(ns.journal)
+    rows = anatomy(journal["events"])
+    if ns.json:
+        print(
+            json.dumps(
+                {"rows": rows, "summary": phase_summary(journal["events"])},
+                indent=1,
+            )
+        )
+        return 0
+    if not rows:
+        print("no committed heights in journal window")
+        return 1
+    print(render_table(rows))
+    summary = phase_summary(journal["events"])
+    print()
+    print(
+        f"{summary['commits']} commits · "
+        f"mean rounds {summary['mean_rounds']:.2f} · "
+        f"mean total {summary['mean_total_s']:.4f}s · "
+        f"timeout-driven {summary['timeout_driven']} · "
+        f"extra-round {summary['extra_round_commits']}"
+    )
+    if journal.get("dropped"):
+        print(
+            f"(ring dropped {journal['dropped']} oldest events; "
+            "raise obs_capacity for full anatomy)"
+        )
+    return 0
+
+
+def _cmd_export(ns):
+    journal = load_journal(ns.journal)
+    doc = export(journal["events"], ns.output)
+    print(
+        json.dumps(
+            {"trace": ns.output, "events": len(doc["traceEvents"])}
+        )
+    )
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m hyperdrive_tpu.obs",
+        description="consensus flight recorder tooling (OBSERVABILITY.md)",
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    rec = sub.add_parser("record", help="run an observed sim, save journal")
+    rec.add_argument("-o", "--output", default="journal.json")
+    rec.add_argument("--replicas", type=int, default=4)
+    rec.add_argument("--heights", type=int, default=5)
+    rec.add_argument("--seed", type=int, default=91)
+    rec.add_argument("--timeout", type=float, default=20.0)
+    rec.add_argument("--delivery-cost", type=float, default=0.001)
+    rec.set_defaults(fn=_cmd_record)
+
+    rep = sub.add_parser("report", help="round-anatomy table from journal")
+    rep.add_argument("journal")
+    rep.add_argument("--json", action="store_true")
+    rep.set_defaults(fn=_cmd_report)
+
+    exp = sub.add_parser("export", help="journal -> Perfetto trace JSON")
+    exp.add_argument("journal")
+    exp.add_argument("-o", "--output", default="trace.json")
+    exp.set_defaults(fn=_cmd_export)
+
+    ns = p.parse_args(argv)
+    return ns.fn(ns)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

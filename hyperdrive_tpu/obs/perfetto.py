@@ -1,0 +1,162 @@
+"""Chrome/Perfetto trace JSON exporter for recorder journals.
+
+Emits the Trace Event Format (the JSON flavour ui.perfetto.dev and
+chrome://tracing both load): one track (tid) per replica under a single
+pid, ``B``/``E`` duration spans for rounds and consensus phases, and
+``i`` instant events for timeout fires, commits, equivocations, and
+wire anomalies. Timestamps are the journal's (virtual) seconds scaled
+to microseconds, so a sim second reads as a second in the UI.
+"""
+
+from __future__ import annotations
+
+import json
+
+__all__ = ["to_trace_events", "export"]
+
+PID = 0
+
+_INSTANTS = {
+    "timeout.propose.fired": "timeout propose",
+    "timeout.prevote.fired": "timeout prevote",
+    "timeout.precommit.fired": "timeout precommit",
+    "commit": "commit",
+    "equivocation": "equivocation",
+    "round.skip": "round skip",
+    "height.resync": "height resync",
+    "mq.drop": "mq drop",
+    "wire.frame.malformed": "frame malformed",
+    "wire.frame.oversize": "frame oversize",
+    "wire.frame.shed": "frame shed",
+}
+
+_PHASE_OPENERS = {
+    "round.start": ("propose", "phase"),
+    "step.prevoting": ("prevote", "phase"),
+    "step.precommitting": ("precommit", "phase"),
+}
+
+
+def _us(ts):
+    return max(0.0, ts * 1e6)
+
+
+def to_trace_events(events):
+    """Journal events -> list of Chrome trace event dicts."""
+    out = []
+    tids = set()
+    # Per-replica open-span state: rounds nest phases, so the phase span
+    # must close before the round span that contains it.
+    open_round = {}  # tid -> (name, height, round)
+    open_phase = {}  # tid -> name
+
+    def begin(tid, ts, name, cat, args=None):
+        ev = {
+            "ph": "B",
+            "ts": _us(ts),
+            "pid": PID,
+            "tid": tid,
+            "name": name,
+            "cat": cat,
+        }
+        if args:
+            ev["args"] = args
+        out.append(ev)
+
+    def end(tid, ts):
+        out.append({"ph": "E", "ts": _us(ts), "pid": PID, "tid": tid})
+
+    def close_phase(tid, ts):
+        if open_phase.pop(tid, None) is not None:
+            end(tid, ts)
+
+    def close_round(tid, ts):
+        close_phase(tid, ts)
+        if open_round.pop(tid, None) is not None:
+            end(tid, ts)
+
+    for ev in events:
+        ts, replica, height, round_, kind, detail = (
+            ev[0], ev[1], ev[2], ev[3], ev[4], ev[5],
+        )
+        tid = replica
+        tids.add(tid)
+        if kind == "round.start":
+            close_round(tid, ts)
+            begin(
+                tid,
+                ts,
+                f"h{height} r{round_}",
+                "round",
+                {"height": height, "round": round_},
+            )
+            open_round[tid] = (height, round_)
+            begin(tid, ts, "propose", "phase")
+            open_phase[tid] = "propose"
+        elif kind in ("step.prevoting", "step.precommitting"):
+            close_phase(tid, ts)
+            name = _PHASE_OPENERS[kind][0]
+            begin(tid, ts, name, "phase")
+            open_phase[tid] = name
+
+        if kind in _INSTANTS:
+            inst = {
+                "ph": "i",
+                "ts": _us(ts),
+                "pid": PID,
+                "tid": tid,
+                "name": _INSTANTS[kind],
+                "cat": kind.split(".", 1)[0],
+                "s": "t",
+                "args": {"height": height, "round": round_},
+            }
+            if detail is not None:
+                inst["args"]["detail"] = detail
+            out.append(inst)
+
+        if kind == "commit":
+            # The commit ends the whole round span for this height.
+            close_round(tid, ts)
+
+    # Close anything still open at the journal edge.
+    if events:
+        last_ts = events[-1][0]
+        for tid in list(open_phase):
+            close_phase(tid, last_ts)
+        for tid in list(open_round):
+            close_round(tid, last_ts)
+
+    # Track naming metadata first, so the UI labels tids as replicas.
+    meta = []
+    for tid in sorted(tids):
+        name = "sim" if tid < 0 else f"replica {tid}"
+        meta.append(
+            {
+                "ph": "M",
+                "pid": PID,
+                "tid": tid,
+                "name": "thread_name",
+                "args": {"name": name},
+            }
+        )
+    meta.append(
+        {
+            "ph": "M",
+            "pid": PID,
+            "name": "process_name",
+            "args": {"name": "hyperdrive consensus"},
+        }
+    )
+    return meta + out
+
+
+def export(events, path):
+    """Write the Perfetto-loadable trace JSON for ``events``."""
+    doc = {
+        "traceEvents": to_trace_events(events),
+        "displayTimeUnit": "ms",
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh, separators=(",", ":"))
+        fh.write("\n")
+    return doc

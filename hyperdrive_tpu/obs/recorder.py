@@ -1,0 +1,260 @@
+"""Consensus flight recorder: a bounded, deterministic event journal.
+
+The Tracer (utils/trace.py) answers "how many / how long"; this module
+answers "in what order, and why". A :class:`Recorder` captures typed
+events — step transitions, timeout schedules and fires, commits,
+equivocations, flush launches/settles, device fetches — into a fixed
+ring buffer, each stamped with (ts, replica, height, round, kind,
+detail). The timestamp comes from an injectable ``time_fn`` so a sim
+wired to the VirtualClock produces a replay-identical journal: two
+fixed-seed runs digest to the same bytes (tests/analysis/
+test_digest_stability.py).
+
+Disabled recording follows the NULL_TRACER discipline: hot paths hold a
+:data:`NULL_BOUND` handle and guard with an identity check, so the off
+state costs one attribute load and one ``is not``. The ``Replica``
+constructor seam is named ``obs`` throughout — ``recorder`` was already
+taken by the transport-replay FlightRecorder (transport.py), which logs
+consumption, not causality.
+
+Event kinds are a closed, documented taxonomy (OBSERVABILITY.md); the
+``detail`` slot carries at most one deterministic scalar (an int or a
+short string), never wall-clock-, id()- or hash-order-derived values.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+
+__all__ = [
+    "Event",
+    "Recorder",
+    "BoundRecorder",
+    "NullRecorder",
+    "NullBound",
+    "NULL_RECORDER",
+    "NULL_BOUND",
+    "load_journal",
+]
+
+# The closed event taxonomy. Kept here (not just in docs) so tooling —
+# the report, the exporter, HD005 fixtures — can validate against it.
+EVENT_KINDS = frozenset(
+    {
+        "round.start",
+        "round.skip",
+        "step.prevoting",
+        "step.precommitting",
+        "timeout.propose.scheduled",
+        "timeout.propose.fired",
+        "timeout.prevote.scheduled",
+        "timeout.prevote.fired",
+        "timeout.precommit.scheduled",
+        "timeout.precommit.fired",
+        "commit",
+        "equivocation",
+        "height.resync",
+        "ingest.window",
+        "mq.drop",
+        "settle.pass",
+        "verify.launch",
+        "tally.launch",
+        "flush.launch",
+        "flush.settle",
+        "fetch.sync",
+        "wire.frame.malformed",
+        "wire.frame.oversize",
+        "wire.frame.shed",
+    }
+)
+
+JOURNAL_VERSION = 1
+
+
+class Event(tuple):
+    """A recorded event: ``(ts, replica, height, round, kind, detail)``.
+
+    A bare tuple subclass (not a dataclass) so ring inserts stay a
+    single allocation; the named properties are for report/export code,
+    which is off the hot path.
+    """
+
+    __slots__ = ()
+
+    ts = property(lambda self: self[0])
+    replica = property(lambda self: self[1])
+    height = property(lambda self: self[2])
+    round = property(lambda self: self[3])
+    kind = property(lambda self: self[4])
+    detail = property(lambda self: self[5])
+
+
+class Recorder:
+    """Fixed-capacity ring journal of consensus events.
+
+    Parameters
+    ----------
+    capacity:
+        Maximum retained events; older events are overwritten (the
+        ``dropped`` counter in the journal says how many).
+    time_fn:
+        Zero-arg timestamp source. Inject the sim's VirtualClock
+        (``lambda: clock.now``) for deterministic journals; defaults to
+        a monotonically increasing sequence number when omitted so the
+        recorder is still usable standalone.
+    threadsafe:
+        Guard inserts with a lock. The sim is single-threaded and
+        passes False; TcpNode wiring needs True.
+    """
+
+    __slots__ = ("capacity", "_ring", "total", "_time_fn", "_lock", "_seq")
+
+    def __init__(self, capacity=65536, time_fn=None, threadsafe=False):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._ring = []
+        self.total = 0
+        self._time_fn = time_fn
+        self._lock = threading.Lock() if threadsafe else None
+        self._seq = 0
+
+    # ------------------------------------------------------------ insert
+
+    def emit(self, kind, replica, height, round_, detail=None):
+        ts = self._time_fn() if self._time_fn is not None else self._tick()
+        ev = Event((ts, replica, height, round_, kind, detail))
+        lock = self._lock
+        if lock is None:
+            self._insert(ev)
+        else:
+            with lock:
+                self._insert(ev)
+
+    def _insert(self, ev):
+        ring = self._ring
+        if len(ring) < self.capacity:
+            ring.append(ev)
+        else:
+            ring[self.total % self.capacity] = ev
+        self.total += 1
+
+    def _tick(self):
+        self._seq += 1
+        return float(self._seq)
+
+    # ------------------------------------------------------------- views
+
+    def scoped(self, replica):
+        """A per-replica handle that pre-binds the replica key."""
+        return BoundRecorder(self, replica)
+
+    def __len__(self):
+        return len(self._ring)
+
+    @property
+    def dropped(self):
+        return max(0, self.total - self.capacity)
+
+    def snapshot(self):
+        """Events oldest-to-newest, as a new list of :class:`Event`."""
+        ring = self._ring
+        if self.total <= self.capacity:
+            return list(ring)
+        head = self.total % self.capacity
+        return ring[head:] + ring[:head]
+
+    def journal(self):
+        """A JSON-ready dict of the whole journal."""
+        return {
+            "version": JOURNAL_VERSION,
+            "capacity": self.capacity,
+            "total": self.total,
+            "dropped": self.dropped,
+            "events": [list(ev) for ev in self.snapshot()],
+        }
+
+    def digest(self):
+        """sha256 over the canonical JSON encoding of the events.
+
+        Two fixed-seed sim runs must agree here — any nondeterminism in
+        the hook sites (hash-order iteration, wall-clock stamps) shows
+        up as a digest mismatch.
+        """
+        blob = json.dumps(
+            [list(ev) for ev in self.snapshot()],
+            separators=(",", ":"),
+            sort_keys=False,
+        ).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def save(self, path):
+        with open(path, "w") as fh:
+            json.dump(self.journal(), fh, separators=(",", ":"))
+            fh.write("\n")
+
+
+class BoundRecorder:
+    """A recorder handle with the replica key baked in.
+
+    This is what hot paths hold: ``obs.emit(kind, height, round)`` is
+    one bound-method call, and the disabled case is the shared
+    :data:`NULL_BOUND` singleton so ``obs is not NULL_BOUND`` gates any
+    extra work (building a detail value, say) off entirely.
+    """
+
+    __slots__ = ("_rec", "replica")
+
+    def __init__(self, rec, replica):
+        self._rec = rec
+        self.replica = replica
+
+    def emit(self, kind, height, round_, detail=None):
+        self._rec.emit(kind, self.replica, height, round_, detail)
+
+
+class NullRecorder(Recorder):
+    """Recording disabled: every emit is a no-op, scoped() is shared."""
+
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__(capacity=1)
+
+    def emit(self, kind, replica, height, round_, detail=None):
+        pass
+
+    def scoped(self, replica):
+        return NULL_BOUND
+
+
+class NullBound(BoundRecorder):
+    __slots__ = ()
+
+    def __init__(self):
+        super().__init__(None, -1)
+
+    def emit(self, kind, height, round_, detail=None):
+        pass
+
+
+NULL_RECORDER = NullRecorder()
+NULL_BOUND = NullBound()
+
+
+def load_journal(path):
+    """Read a journal written by :meth:`Recorder.save`.
+
+    Returns the journal dict with ``events`` rehydrated to
+    :class:`Event` instances (tuples with named accessors).
+    """
+    with open(path) as fh:
+        data = json.load(fh)
+    if data.get("version") != JOURNAL_VERSION:
+        raise ValueError(
+            f"unsupported journal version {data.get('version')!r}"
+        )
+    data["events"] = [Event(tuple(ev)) for ev in data["events"]]
+    return data

@@ -1,0 +1,195 @@
+"""Round-anatomy report: decompose each committed height into phases.
+
+Reads a recorder journal (live snapshot or one loaded from disk) and,
+per (replica, height), reconstructs the commit's latency anatomy:
+
+  propose   round.start      -> step.prevoting     (proposal wait+verify)
+  prevote   step.prevoting   -> step.precommitting (prevote quorum)
+  precommit step.precommitting -> commit           (precommit quorum)
+
+A height that needed several rounds attributes the phases of the round
+that actually committed, and the time burned in earlier rounds shows up
+as ``stall`` (round.start of round 0 -> round.start of the committing
+round). Outlier flags mark the interesting rows: extra rounds,
+timeout-driven progress, and totals far above the run median.
+"""
+
+from __future__ import annotations
+
+__all__ = ["anatomy", "phase_summary", "render_table"]
+
+_TIMEOUT_FIRES = (
+    "timeout.propose.fired",
+    "timeout.prevote.fired",
+    "timeout.precommit.fired",
+)
+
+
+def anatomy(events):
+    """Per-(replica, height) commit anatomy rows, sorted.
+
+    ``events`` is an iterable of Event tuples (ts, replica, height,
+    round, kind, detail). Returns a list of dict rows; heights that
+    never committed in the journal window are omitted.
+    """
+    # Pass 1: index the marker events per (replica, height).
+    marks = {}  # (replica, height) -> state dict
+
+    def st(ev):
+        key = (ev[1], ev[2])
+        s = marks.get(key)
+        if s is None:
+            s = {
+                "round_start": {},  # round -> ts of first round.start
+                "prevoting": {},  # round -> ts
+                "precommitting": {},  # round -> ts
+                "commit": None,  # (ts, round, detail)
+                "timeouts": 0,
+                "equivocations": 0,
+                "skips": 0,
+            }
+            marks[key] = s
+        return s
+
+    for ev in events:
+        kind = ev[4]
+        if kind == "round.start":
+            s = st(ev)
+            s["round_start"].setdefault(ev[3], ev[0])
+        elif kind == "step.prevoting":
+            s = st(ev)
+            s["prevoting"].setdefault(ev[3], ev[0])
+        elif kind == "step.precommitting":
+            s = st(ev)
+            s["precommitting"].setdefault(ev[3], ev[0])
+        elif kind == "commit":
+            s = st(ev)
+            if s["commit"] is None:
+                s["commit"] = (ev[0], ev[3], ev[5])
+        elif kind in _TIMEOUT_FIRES:
+            st(ev)["timeouts"] += 1
+        elif kind == "equivocation":
+            st(ev)["equivocations"] += 1
+        elif kind == "round.skip":
+            st(ev)["skips"] += 1
+
+    # Pass 2: committed heights -> anatomy rows.
+    rows = []
+    for (replica, height), s in marks.items():
+        if s["commit"] is None:
+            continue
+        t_commit, commit_round, detail = s["commit"]
+        r0 = s["round_start"].get(0)
+        rstart = s["round_start"].get(commit_round)
+        tpv = s["prevoting"].get(commit_round)
+        tpc = s["precommitting"].get(commit_round)
+
+        def dur(a, b):
+            if a is None or b is None:
+                return None
+            return max(0.0, b - a)
+
+        total = dur(r0 if r0 is not None else rstart, t_commit)
+        rows.append(
+            {
+                "replica": replica,
+                "height": height,
+                "rounds": commit_round + 1,
+                "propose_s": dur(rstart, tpv),
+                "prevote_s": dur(tpv, tpc),
+                "precommit_s": dur(tpc, t_commit),
+                "stall_s": dur(r0, rstart) if commit_round > 0 else 0.0,
+                "total_s": total,
+                "timeouts": s["timeouts"],
+                "equivocations": s["equivocations"],
+                "skips": s["skips"],
+                "value": detail,
+            }
+        )
+    rows.sort(key=lambda r: (r["height"], r["replica"]))
+
+    # Pass 3: outlier flags need the run median.
+    totals = sorted(r["total_s"] for r in rows if r["total_s"] is not None)
+    median = totals[len(totals) // 2] if totals else 0.0
+    for r in rows:
+        flags = []
+        if r["rounds"] > 1:
+            flags.append("extra-rounds")
+        if r["timeouts"] > 0:
+            flags.append("timeout-driven")
+        if (
+            median > 0.0
+            and r["total_s"] is not None
+            and r["total_s"] > 3.0 * median
+        ):
+            flags.append("slow")
+        if r["equivocations"] > 0:
+            flags.append("equivocation")
+        r["flags"] = flags
+    return rows
+
+
+def phase_summary(events):
+    """Aggregate commit-latency breakdown for bench artifact embedding.
+
+    Means over all committed (replica, height) rows, in journal time
+    units (virtual seconds in the sim).
+    """
+    rows = anatomy(events)
+    if not rows:
+        return {"commits": 0}
+
+    def mean_of(key):
+        vals = [r[key] for r in rows if r[key] is not None]
+        return (sum(vals) / len(vals)) if vals else None
+
+    return {
+        "commits": len(rows),
+        "mean_rounds": sum(r["rounds"] for r in rows) / len(rows),
+        "mean_propose_s": mean_of("propose_s"),
+        "mean_prevote_s": mean_of("prevote_s"),
+        "mean_precommit_s": mean_of("precommit_s"),
+        "mean_stall_s": mean_of("stall_s"),
+        "mean_total_s": mean_of("total_s"),
+        "timeout_driven": sum(1 for r in rows if r["timeouts"] > 0),
+        "extra_round_commits": sum(1 for r in rows if r["rounds"] > 1),
+    }
+
+
+def _fmt(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4f}"
+    return str(v)
+
+
+def render_table(rows):
+    """The anatomy rows as an aligned text table (the CLI's output)."""
+    cols = [
+        ("ht", "height"),
+        ("rep", "replica"),
+        ("rnds", "rounds"),
+        ("propose", "propose_s"),
+        ("prevote", "prevote_s"),
+        ("precommit", "precommit_s"),
+        ("stall", "stall_s"),
+        ("total", "total_s"),
+        ("t/o", "timeouts"),
+        ("flags", "flags"),
+    ]
+    table = [[h for h, _ in cols]]
+    for r in rows:
+        table.append(
+            [
+                ",".join(r[k]) if k == "flags" else _fmt(r[k])
+                for _, k in cols
+            ]
+        )
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)

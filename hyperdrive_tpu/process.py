@@ -38,6 +38,7 @@ from typing import Optional, Protocol, runtime_checkable
 
 from hyperdrive_tpu.codec import Reader, Writer
 from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+from hyperdrive_tpu.obs.recorder import NULL_BOUND
 from hyperdrive_tpu.state import OnceFlag, State
 from hyperdrive_tpu.types import (
     INVALID_ROUND,
@@ -156,6 +157,7 @@ class Process:
         "state",
         "_tally_source",
         "host_counts",
+        "obs",
     )
 
     def __init__(
@@ -171,6 +173,7 @@ class Process:
         catcher: Optional[Catcher] = None,
         height: Height | None = None,
         state: State | None = None,
+        obs=None,
     ):
         self.whoami = whoami
         self.f = int(f)
@@ -197,6 +200,11 @@ class Process:
         #: to State.count_*'s O(V) log scan. The logs themselves (the
         #: checkpoint/evidence source of truth) are always maintained.
         self.host_counts = True
+        #: Flight-recorder handle (obs/recorder.py); the shared no-op
+        #: singleton when observability is off, so every emit site can
+        #: gate on one identity check. Named ``obs`` because ``recorder``
+        #: already means the transport-replay FlightRecorder elsewhere.
+        self.obs = obs if obs is not None else NULL_BOUND
 
     # ---------------------------------------------------------------- inputs
 
@@ -600,6 +608,9 @@ class Process:
         try:
             self.state.current_round = round
             self.state.current_step = Step.PROPOSING
+            obs = self.obs
+            if obs is not NULL_BOUND:
+                obs.emit("round.start", self.state.current_height, round)
 
             # Without a scheduler we can never know the proposer; do nothing
             # (matching reference behaviour when the seam is nil).
@@ -613,6 +624,12 @@ class Process:
                     self.timer.timeout_propose(
                         self.state.current_height, self.state.current_round
                     )
+                    if obs is not NULL_BOUND:
+                        obs.emit(
+                            "timeout.propose.scheduled",
+                            self.state.current_height,
+                            round,
+                        )
                 return
 
             # We are the proposer: re-propose our ValidValue if we have one,
@@ -661,6 +678,8 @@ class Process:
             and round == self.state.current_round
             and self.state.current_step == Step.PROPOSING
         ):
+            if self.obs is not NULL_BOUND:
+                self.obs.emit("timeout.propose.fired", height, round)
             if self.broadcaster is not None:
                 self.broadcaster.broadcast_prevote(
                     Prevote(
@@ -680,6 +699,8 @@ class Process:
             and round == self.state.current_round
             and self.state.current_step == Step.PREVOTING
         ):
+            if self.obs is not NULL_BOUND:
+                self.obs.emit("timeout.prevote.fired", height, round)
             if self.broadcaster is not None:
                 self.broadcaster.broadcast_precommit(
                     Precommit(
@@ -695,6 +716,8 @@ class Process:
         """L65: a precommit timeout fired — move to the next round
         (reference: process/process.go:406-410)."""
         if height == self.state.current_height and round == self.state.current_round:
+            if self.obs is not NULL_BOUND:
+                self.obs.emit("timeout.precommit.fired", height, round)
             self.start_round(round + 1)
 
     # ------------------------------------------------------------- rules L22+
@@ -778,6 +801,12 @@ class Process:
                 self.timer.timeout_prevote(
                     self.state.current_height, self.state.current_round
                 )
+                if self.obs is not NULL_BOUND:
+                    self.obs.emit(
+                        "timeout.prevote.scheduled",
+                        self.state.current_height,
+                        self.state.current_round,
+                    )
                 self._set_once_flag(
                     self.state.current_round,
                     OnceFlag.TIMEOUT_PREVOTE_UPON_SUFFICIENT_PREVOTES,
@@ -877,6 +906,12 @@ class Process:
                 self.timer.timeout_precommit(
                     self.state.current_height, self.state.current_round
                 )
+                if self.obs is not NULL_BOUND:
+                    self.obs.emit(
+                        "timeout.precommit.scheduled",
+                        self.state.current_height,
+                        self.state.current_round,
+                    )
                 self._set_once_flag(
                     self.state.current_round,
                     OnceFlag.TIMEOUT_PRECOMMIT_UPON_SUFFICIENT_PRECOMMITS,
@@ -896,6 +931,15 @@ class Process:
         if self._precommits_for(round, propose.value) < 2 * self.f + 1:
             return
 
+        if self.obs is not NULL_BOUND:
+            # Emit before the height advance so the event's (height, round)
+            # keys name the committed height, not its successor.
+            self.obs.emit(
+                "commit",
+                self.state.current_height,
+                round,
+                propose.value.hex()[:16],
+            )
         new_f, new_scheduler = self.committer.commit(
             self.state.current_height, propose.value
         )
@@ -913,6 +957,13 @@ class Process:
         if round <= self.state.current_round:
             return
         if len(self.state.trace_logs.get(round, ())) >= self.f + 1:
+            if self.obs is not NULL_BOUND:
+                self.obs.emit(
+                    "round.skip",
+                    self.state.current_height,
+                    round,
+                    self.state.current_round,
+                )
             self.start_round(round)
 
     # --------------------------------------------------------------- inserts
@@ -999,6 +1050,12 @@ class Process:
         """Enter Prevoting and retry the rules the step change could open
         (reference: process/process.go:896-905)."""
         self.state.current_step = Step.PREVOTING
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "step.prevoting",
+                self.state.current_height,
+                self.state.current_round,
+            )
         self._try_precommit_upon_sufficient_prevotes()
         self._try_precommit_nil_upon_sufficient_prevotes()
         self._try_timeout_prevote_upon_sufficient_prevotes()
@@ -1007,6 +1064,12 @@ class Process:
         """Enter Precommitting and retry the rules the step change could open
         (reference: process/process.go:909-916)."""
         self.state.current_step = Step.PRECOMMITTING
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "step.precommitting",
+                self.state.current_height,
+                self.state.current_round,
+            )
         self._try_precommit_upon_sufficient_prevotes()
 
     # ------------------------------------------------------------ once flags

@@ -40,6 +40,7 @@ from typing import Callable, Optional
 from hyperdrive_tpu.analysis.annotations import hot_path
 from hyperdrive_tpu.analysis.sanitizer import maybe_install as _maybe_sanitize
 from hyperdrive_tpu.messages import Precommit, Prevote, Propose, Timeout
+from hyperdrive_tpu.obs.recorder import NULL_BOUND
 from hyperdrive_tpu.utils.log import get_logger, kv as _kv
 from hyperdrive_tpu.utils.trace import NULL_TRACER
 from hyperdrive_tpu.mq import DEFAULT_MAX_CAPACITY, MessageQueue
@@ -100,6 +101,15 @@ _MSG_METRIC = {
     Timeout: "replica.msg.timeout",
 }
 
+#: Same discipline for evidence counters (and the HD005 lint contract:
+#: metric names are literals or table lookups, never built per call).
+_CAUGHT_METRIC = {
+    "double_propose": "replica.caught.double_propose",
+    "double_prevote": "replica.caught.double_prevote",
+    "double_precommit": "replica.caught.double_precommit",
+    "out_of_turn_propose": "replica.caught.out_of_turn_propose",
+}
+
 
 @dataclass(frozen=True)
 class ReplicaOptions:
@@ -128,6 +138,11 @@ class ReplicaOptions:
     batch_ingest: bool = False
     tracer: object = None
     logger: object = None
+    #: Flight-recorder handle (a BoundRecorder from obs/recorder.py, or
+    #: None for the shared no-op). The seam is called ``obs`` — not
+    #: ``recorder`` — because Replica already takes a ``recorder``
+    #: constructor argument for the transport consumption log.
+    obs: object = None
 
     def with_starting_height(self, height: Height) -> "ReplicaOptions":
         return replace(self, starting_height=height)
@@ -143,6 +158,9 @@ class ReplicaOptions:
 
     def with_logger(self, logger) -> "ReplicaOptions":
         return replace(self, logger=logger)
+
+    def with_obs(self, obs) -> "ReplicaOptions":
+        return replace(self, obs=obs)
 
 
 @dataclass(frozen=True)
@@ -177,6 +195,7 @@ class Replica:
         self.opts = opts
         self.tracer = opts.tracer if opts.tracer is not None else NULL_TRACER
         self.logger = opts.logger if opts.logger is not None else get_logger()
+        self.obs = opts.obs if opts.obs is not None else NULL_BOUND
         self.proc = Process(
             whoami=whoami,
             f=f,
@@ -188,6 +207,7 @@ class Replica:
             committer=self._instrument_committer(committer),
             catcher=self._instrument_catcher(catcher),
             height=opts.starting_height,
+            obs=self.obs,
         )
         # Consensus sanitizer (ANALYSIS.md, HDS001-HDS003): interposes on
         # the committer/broadcaster seams when HD_SANITIZE is on. No-op
@@ -195,6 +215,7 @@ class Replica:
         _maybe_sanitize(self.proc)
         self.procs_allowed: set[Signatory] = set(signatories)
         self.mq = MessageQueue(max_capacity=opts.max_capacity)
+        self.mq.obs = self.obs
         # Pre-register the whitelist in the queue's tie-break order map:
         # "senders tie-broken by registration order" then means whitelist
         # order — identical across replicas and across driving modes — so a
@@ -274,7 +295,14 @@ class Replica:
 
         class _TracingCatcher:
             def _note(self, kind, sender):
-                replica.tracer.count(f"replica.caught.{kind}")
+                replica.tracer.count(_CAUGHT_METRIC[kind])
+                if replica.obs is not NULL_BOUND:
+                    replica.obs.emit(
+                        "equivocation",
+                        replica.proc.current_height,
+                        replica.proc.current_round,
+                        kind,
+                    )
                 replica.logger.warning(
                     "byzantine evidence %s", _kv(kind=kind, sender=sender)
                 )
@@ -420,6 +448,13 @@ class Replica:
                         rotating=bool(msg.signatories),
                     ),
                 )
+                if self.obs is not NULL_BOUND:
+                    self.obs.emit(
+                        "height.resync",
+                        self.proc.current_height,
+                        self.proc.current_round,
+                        msg.height,
+                    )
                 self.proc.state = State.default_with_height(msg.height)
                 self.mq.drop_messages_below_height(msg.height)
                 # Lane messages were for the pre-reset current height,
@@ -550,6 +585,13 @@ class Replica:
             # path is exactly insert + cascade with no tallies installed.
             self.proc.ingest_cascade(self.ingest_insert_window(window, keep))
             return
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "ingest.window",
+                self.proc.current_height,
+                self.proc.current_round,
+                len(window),
+            )
         verified = keep is not None
         allowed = self.procs_allowed
         n_ok = 0
@@ -577,6 +619,13 @@ class Replica:
         grid before the rule phase. Returns the plan for
         :meth:`ingest_cascade_window`.
         """
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "ingest.window",
+                self.proc.current_height,
+                self.proc.current_round,
+                len(window),
+            )
         verified = keep is not None
         allowed = self.procs_allowed
         batch = [
@@ -598,6 +647,13 @@ class Replica:
         Accounting matches :meth:`ingest_insert_window` row for row;
         ``replica.ingest.fastpath_rows`` counts the rows that rode the
         columnar path."""
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "ingest.window",
+                self.proc.current_height,
+                self.proc.current_round,
+                cols.n,
+            )
         plan, n_ok = self.proc.ingest_insert_cols(
             cols, keep, self.procs_allowed, on_accepted
         )

@@ -34,6 +34,7 @@ from __future__ import annotations
 import numpy as np
 
 from hyperdrive_tpu.analysis.annotations import device_fetch
+from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
 __all__ = ["DeviceTallyFlusher"]
 
@@ -55,7 +56,7 @@ class DeviceTallyFlusher:
 
     def __init__(self, verifier, validators, r_slots: int = 8,
                  buckets: tuple = (256, 1024, 4096), tally_check=None,
-                 pipeline_split: int = 512):
+                 pipeline_split: int = 512, obs=None):
         from hyperdrive_tpu.ops.votegrid import VoteGrid
 
         self.verifier = verifier
@@ -85,6 +86,8 @@ class DeviceTallyFlusher:
         #: Rows ingested through the columnar fast path (observability —
         #: the wire-facing :meth:`settle_block` entry).
         self.fastpath_rows = 0
+        #: Flight-recorder handle (obs/recorder.py; NULL_BOUND = off).
+        self.obs = obs if obs is not None else NULL_BOUND
 
     def warmup(self) -> None:
         """Compile the grid kernel (one empty scatter) before the replica
@@ -127,6 +130,13 @@ class DeviceTallyFlusher:
             )
             if not window:
                 return
+            if self.obs is not NULL_BOUND:
+                self.obs.emit(
+                    "flush.launch",
+                    replica.proc.current_height,
+                    replica.proc.current_round,
+                    len(window),
+                )
             if (
                 begin is not None
                 and self.pipeline_split > 0
@@ -294,6 +304,8 @@ class DeviceTallyFlusher:
             np.array([proc.f], dtype=np.int32),
         )
         self.launches += 1
+        if self.obs is not NULL_BOUND:
+            self.obs.emit("tally.launch", h, st.current_round, len(idx))
         view = TallyView(
             0, self._height, counts, R, tmap, int(l28_slot[0]), l28_val,
             dirty=dirty,
@@ -301,3 +313,7 @@ class DeviceTallyFlusher:
         if self.tally_check is not None:
             view = self.tally_check(view, proc)
         replica.ingest_cascade_window(plan, view)
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "flush.settle", proc.current_height, proc.current_round
+            )

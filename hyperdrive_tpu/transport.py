@@ -84,8 +84,16 @@ class TcpNode:
     may start in any order.
     """
 
-    def __init__(self, listen_port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, listen_port: int = 0, host: str = "127.0.0.1",
+                 obs=None):
+        from hyperdrive_tpu.obs.recorder import NULL_BOUND
+
         self._host = host
+        #: Flight-recorder handle for wire anomalies (oversize frames,
+        #: malformed envelopes, shed backlog). The node is multithreaded,
+        #: so callers must pass a handle bound to a threadsafe Recorder.
+        self.obs = obs if obs is not None else NULL_BOUND
+        self._obs_null = NULL_BOUND
         self._replicas: list = []
         #: peer key -> outbound frame queue, drained by a dedicated sender
         #: thread per peer — a dead or slow peer can never stall the
@@ -177,6 +185,9 @@ class TcpNode:
                         return
                     (length,) = _LEN.unpack(head)
                     if length > _MAX_FRAME:
+                        if self.obs is not self._obs_null:
+                            self.obs.emit("wire.frame.oversize", -1, -1,
+                                          length)
                         return  # framing attack: drop the connection
                     payload = _recv_exact(conn, length)
                     if payload is None:
@@ -186,6 +197,9 @@ class TcpNode:
                 try:
                     msg = unmarshal_message(Reader(payload))
                 except SerdeError:
+                    if self.obs is not self._obs_null:
+                        self.obs.emit("wire.frame.malformed", -1, -1,
+                                      len(payload))
                     continue  # malformed envelope: drop the frame
                 if self._stop.is_set():
                     return
@@ -256,6 +270,8 @@ class TcpNode:
                 except queue.Full:
                     try:
                         q.get_nowait()  # shed the oldest frame
+                        if self.obs is not self._obs_null:
+                            self.obs.emit("wire.frame.shed", -1, -1)
                     except queue.Empty:
                         pass
 

@@ -71,8 +71,13 @@ class Histogram:
         if len(self._samples) < self._max_samples:
             self._samples.append(v)
         else:
-            # Reservoir-less ring overwrite: cheap, recent-biased.
-            self._samples[self.total % self._max_samples] = v
+            # Reservoir-less ring overwrite: cheap, recent-biased. This
+            # observation is number ``total`` (post-increment), so it
+            # lands in slot ``total - 1`` — keeping the retained window
+            # exactly the most recent ``max_samples`` observations. (The
+            # previous ``total % max`` indexing lagged the write slot by
+            # one, so the oldest sample survived a full extra lap.)
+            self._samples[(self.total - 1) % self._max_samples] = v
 
     def quantile(self, q: float) -> float:
         """Exact quantile over the retained sample window (0 if empty)."""

@@ -1,0 +1,48 @@
+"""HD005 fixture: every dynamic-metric-name shape the rule must catch."""
+
+_MSG_METRIC = {"prevote": "replica.msg.prevote"}
+
+
+class Replica:
+    def __init__(self, tracer, obs):
+        self.tracer = tracer
+        self.obs = obs
+
+    def bad_fstring(self, kind):
+        self.tracer.count(f"replica.caught.{kind}")  # BAD: f-string name
+
+    def bad_concat(self, stage):
+        self.tracer.observe("sim." + stage, 1.0)  # BAD: concatenated name
+
+    def bad_format(self, kind):
+        self.obs.emit("round.{}".format(kind), 1, 0)  # BAD: call result
+
+    def bad_uppercase(self):
+        self.tracer.count("Replica.Msg.Prevote")  # BAD: not lowercase dotted
+
+    def bad_fstring_emit(self, why):
+        self.obs.emit(f"fetch.{why}", -1, -1)  # BAD: f-string event kind
+
+    def good_literal(self):
+        self.tracer.count("replica.msg.prevote")
+
+    def good_single_word(self):
+        self.obs.emit("commit", 5, 0)
+
+    def good_table_lookup(self, t):
+        self.tracer.count(_MSG_METRIC[t])
+
+    def good_get_lookup(self, t):
+        self.tracer.count(_MSG_METRIC.get(t, "replica.msg.other"))
+
+    def good_ifexp(self, fast):
+        self.tracer.count("sim.path.fast" if fast else "sim.path.slow")
+
+    def good_name_passthrough(self, name):
+        # A bare name is a lookup whose literals live at the definition
+        # site; flagging it would outlaw every table-driven emitter.
+        self.tracer.observe(name, 0.5)
+
+    def good_unrelated_receiver(self, kind):
+        # Not a tracer/obs/recorder: .emit on anything else is out of scope.
+        self.bus.emit(f"signal.{kind}")

@@ -75,3 +75,31 @@ def test_identical_seeds_produce_identical_digests():
     b = Simulation(n=4, target_height=3, seed=91).run()
     assert a.completed and b.completed
     assert a.commit_digest() == b.commit_digest()
+
+
+def test_identical_seeds_produce_identical_event_journals():
+    """The flight-recorder analogue of the commit-digest spec: the whole
+    observed event stream — timestamps (VirtualClock), causality keys,
+    ring bookkeeping — must be byte-identical across fixed-seed runs.
+    Any hash-order iteration or wall-clock leak in an emit site lands
+    here as a digest mismatch."""
+    sims = [
+        Simulation(
+            n=4, target_height=3, seed=91, delivery_cost=0.001, observe=True
+        )
+        for _ in range(2)
+    ]
+    results = [s.run() for s in sims]
+    assert all(r.completed for r in results)
+    a, b = sims
+    assert len(a.obs) > 0
+    assert a.obs.digest() == b.obs.digest()
+    assert a.obs.journal() == b.obs.journal()
+
+
+def test_observed_run_commits_match_unobserved_run():
+    # Recording must be a pure tap: enabling it cannot perturb the
+    # consensus outcome of the same seeded scenario.
+    plain = Simulation(n=4, target_height=3, seed=91).run()
+    observed = Simulation(n=4, target_height=3, seed=91, observe=True).run()
+    assert plain.commit_digest() == observed.commit_digest()

@@ -69,6 +69,24 @@ def test_hd004_fixture_flags_wide_literals_without_dtype_pin():
     assert len(findings) == 3
 
 
+def test_hd005_fixture_flags_dynamic_names_not_table_lookups():
+    path = os.path.join(FIXTURES, "hd005_metric_names.py")
+    findings = run_on(path)
+    assert {f.rule for f in findings} == {"HD005"}
+    # f-string count, concat observe, .format emit, uppercase literal,
+    # f-string emit — and none of the GOOD lookup/literal/IfExp forms.
+    assert len(findings) == 5
+    src = open(path).read()
+    bad_lines = {
+        i + 1 for i, text in enumerate(src.splitlines()) if "# BAD" in text
+    }
+    assert set(lines_of(findings, "HD005")) == bad_lines
+    msgs = " | ".join(f.message for f in findings)
+    assert "f-string" in msgs
+    assert "concatenated" in msgs
+    assert "not lowercase dotted" in msgs
+
+
 def test_suppressed_fixture_is_clean_even_in_strict():
     path = os.path.join(FIXTURES, "suppressed_clean.py")
     assert run_on(path) == []
@@ -171,7 +189,7 @@ def test_suppression_on_preceding_line_covers_next_line():
 
 
 def test_rule_catalog_is_complete():
-    assert set(ALL_RULES) == {"HD001", "HD002", "HD003", "HD004"}
+    assert set(ALL_RULES) == {"HD001", "HD002", "HD003", "HD004", "HD005"}
     for cls in ALL_RULES.values():
         assert cls.summary and cls.name
 

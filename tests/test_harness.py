@@ -592,7 +592,7 @@ def test_fused_engages_when_network_exceeds_per_sender_capacity():
     assert fres.completed, f"stalled at {fres.heights}"
     fres.assert_safety()
     hists = fused.tracer.snapshot()["histograms"]
-    assert hists.get("sim.fused.sync_s", {}).get("count", 0) > 0, (
+    assert hists.get("sim.fused.sync.latency", {}).get("count", 0) > 0, (
         "capacity-capped lockstep settle never fused"
     )
     host = Simulation(**kw).run()
@@ -649,7 +649,7 @@ def test_fused_min_window_routes_every_settle_to_host():
     assert rres.completed, f"stalled at {rres.heights}"
     rres.assert_safety()
     hists = routed.tracer.snapshot()["histograms"]
-    assert "sim.fused.sync_s" not in hists, "a fused launch still fired"
+    assert "sim.fused.sync.latency" not in hists, "a fused launch still fired"
     assert hists["sim.settle.host_routed"]["count"] > 0
     host = Simulation(**kw).run()
     fused = Simulation(
@@ -701,7 +701,7 @@ def test_fused_min_window_partial_grid_poison_is_sound():
             # At this seed/size, threshold 3 leaves a genuine MIX: some
             # settles fused (grid engaged), some routed (grid poisoned) —
             # the combination the poison logic exists for.
-            assert hists["sim.fused.sync_s"]["count"] > 0
+            assert hists["sim.fused.sync.latency"]["count"] > 0
 
 
 def test_burst_signed_with_tpu_batch_verifier():

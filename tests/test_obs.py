@@ -1,0 +1,378 @@
+"""Flight recorder, anatomy report, Perfetto exporter, and obs CLI.
+
+The sim-integration half (observed runs, digest stability) lives in
+tests/analysis/test_digest_stability.py; this module specs the obs
+package itself on synthetic journals plus one real observed run for the
+acceptance-shaped trace checks.
+"""
+
+import json
+
+import pytest
+
+from hyperdrive_tpu.obs import __main__ as obs_cli
+from hyperdrive_tpu.obs.perfetto import PID, export, to_trace_events
+from hyperdrive_tpu.obs.recorder import (
+    EVENT_KINDS,
+    NULL_BOUND,
+    Event,
+    Recorder,
+    load_journal,
+)
+from hyperdrive_tpu.obs.report import anatomy, phase_summary, render_table
+
+
+# ------------------------------------------------------------------ recorder
+
+
+def test_recorder_orders_events_and_binds_scopes():
+    rec = Recorder(capacity=16)
+    r0 = rec.scoped(0)
+    r1 = rec.scoped(1)
+    r0.emit("round.start", 1, 0)
+    r1.emit("round.start", 1, 0)
+    r0.emit("commit", 1, 0, "aa")
+    evs = rec.snapshot()
+    assert [e.kind for e in evs] == ["round.start", "round.start", "commit"]
+    assert [e.replica for e in evs] == [0, 1, 0]
+    assert evs[2].detail == "aa"
+    # The fallback clock is strictly increasing.
+    assert evs[0].ts < evs[1].ts < evs[2].ts
+    assert len(rec) == 3 and rec.dropped == 0
+
+
+def test_recorder_ring_keeps_newest_and_counts_drops():
+    rec = Recorder(capacity=4)
+    for i in range(11):
+        rec.emit("commit", 0, i, 0)
+    assert len(rec) == 4
+    assert rec.total == 11
+    assert rec.dropped == 7
+    assert [e.height for e in rec.snapshot()] == [7, 8, 9, 10]
+
+
+def test_recorder_rejects_nonpositive_capacity():
+    with pytest.raises(ValueError):
+        Recorder(capacity=0)
+
+
+def test_recorder_injected_clock_stamps_events():
+    now = [2.5]
+    rec = Recorder(capacity=8, time_fn=lambda: now[0])
+    rec.emit("commit", 0, 1, 0)
+    now[0] = 3.75
+    rec.emit("commit", 0, 2, 0)
+    assert [e.ts for e in rec.snapshot()] == [2.5, 3.75]
+
+
+def test_threadsafe_recorder_inserts_under_lock():
+    rec = Recorder(capacity=8, threadsafe=True)
+    rec.scoped(3).emit("wire.frame.shed", -1, -1)
+    assert rec.snapshot()[0].replica == 3
+
+
+def test_journal_save_load_round_trip(tmp_path):
+    rec = Recorder(capacity=8)
+    rec.emit("round.start", 0, 1, 0)
+    rec.emit("commit", 0, 1, 0, "beef")
+    path = tmp_path / "j.json"
+    rec.save(path)
+    journal = load_journal(path)
+    assert journal["version"] == 1
+    assert journal["total"] == 2 and journal["dropped"] == 0
+    assert [e.kind for e in journal["events"]] == ["round.start", "commit"]
+    assert isinstance(journal["events"][0], Event)
+    # The digest is a function of the events alone: recomputing over the
+    # reloaded journal must agree with the live recorder.
+    reloaded = json.dumps(
+        [list(e) for e in journal["events"]], separators=(",", ":")
+    )
+    live = json.dumps(
+        [list(e) for e in rec.snapshot()], separators=(",", ":")
+    )
+    assert reloaded == live
+
+
+def test_load_journal_rejects_unknown_version(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text('{"version": 99, "events": []}')
+    with pytest.raises(ValueError, match="version"):
+        load_journal(p)
+
+
+def test_emitted_kinds_stay_inside_the_documented_taxonomy():
+    # Every kind the wired call sites emit must be in the closed set the
+    # docs/report/exporter key on. Greps the package so a new emit site
+    # cannot silently extend the taxonomy.
+    import os
+    import re
+
+    import hyperdrive_tpu
+
+    root = os.path.dirname(hyperdrive_tpu.__file__)
+    emitted = set()
+    pat = re.compile(r'\.emit\(\s*"([a-z0-9_.]+)"')
+    for dirpath, _, names in os.walk(root):
+        for n in names:
+            if n.endswith(".py"):
+                with open(os.path.join(dirpath, n)) as fh:
+                    emitted.update(pat.findall(fh.read()))
+    assert emitted, "sanity: the grep found the wired emit sites"
+    assert emitted <= EVENT_KINDS, emitted - EVENT_KINDS
+
+
+# ------------------------------------------------------------------- report
+
+
+def _ev(ts, replica, height, round_, kind, detail=None):
+    return Event((ts, replica, height, round_, kind, detail))
+
+
+def test_anatomy_decomposes_multi_round_commit_with_flags():
+    events = [
+        _ev(0.0, 0, 1, 0, "round.start"),
+        _ev(0.1, 0, 1, 0, "step.prevoting"),
+        _ev(0.2, 0, 1, 0, "timeout.precommit.fired"),
+        _ev(0.3, 0, 1, 1, "round.start"),
+        _ev(0.4, 0, 1, 1, "step.prevoting"),
+        _ev(0.6, 0, 1, 1, "step.precommitting"),
+        _ev(0.9, 0, 1, 1, "commit", "aa"),
+        # A second replica commits height 1 in one clean round.
+        _ev(0.0, 1, 1, 0, "round.start"),
+        _ev(0.1, 1, 1, 0, "step.prevoting"),
+        _ev(0.2, 1, 1, 0, "step.precommitting"),
+        _ev(0.3, 1, 1, 0, "commit", "aa"),
+        # An uncommitted height must not produce a row.
+        _ev(1.0, 0, 2, 0, "round.start"),
+    ]
+    rows = anatomy(events)
+    assert [(r["replica"], r["height"]) for r in rows] == [(0, 1), (1, 1)]
+    slow = rows[0]
+    assert slow["rounds"] == 2
+    assert slow["propose_s"] == pytest.approx(0.1)
+    assert slow["prevote_s"] == pytest.approx(0.2)
+    assert slow["precommit_s"] == pytest.approx(0.3)
+    assert slow["stall_s"] == pytest.approx(0.3)
+    assert slow["total_s"] == pytest.approx(0.9)
+    assert "extra-rounds" in slow["flags"]
+    assert "timeout-driven" in slow["flags"]
+    clean = rows[1]
+    assert clean["rounds"] == 1 and clean["stall_s"] == 0.0
+    assert clean["flags"] == []
+
+
+def test_anatomy_flags_slow_and_equivocation_outliers():
+    events = []
+    for h in range(1, 6):
+        t0 = float(h)
+        events += [
+            _ev(t0, 0, h, 0, "round.start"),
+            _ev(t0 + 0.01, 0, h, 0, "step.prevoting"),
+            _ev(t0 + 0.02, 0, h, 0, "step.precommitting"),
+            # Height 5 takes 10x the median commit time.
+            _ev(t0 + (1.0 if h == 5 else 0.1), 0, h, 0, "commit"),
+        ]
+    events.append(_ev(3.005, 0, 3, 0, "equivocation", "double_prevote"))
+    by_height = {r["height"]: r for r in anatomy(events)}
+    assert "slow" in by_height[5]["flags"]
+    assert "equivocation" in by_height[3]["flags"]
+    assert by_height[2]["flags"] == []
+
+
+def test_phase_summary_empty_and_populated():
+    assert phase_summary([]) == {"commits": 0}
+    events = [
+        _ev(0.0, 0, 1, 0, "round.start"),
+        _ev(0.1, 0, 1, 0, "step.prevoting"),
+        _ev(0.3, 0, 1, 0, "step.precommitting"),
+        _ev(0.6, 0, 1, 0, "commit"),
+    ]
+    s = phase_summary(events)
+    assert s["commits"] == 1
+    assert s["mean_rounds"] == 1.0
+    assert s["mean_propose_s"] == pytest.approx(0.1)
+    assert s["mean_prevote_s"] == pytest.approx(0.2)
+    assert s["mean_precommit_s"] == pytest.approx(0.3)
+    assert s["mean_total_s"] == pytest.approx(0.6)
+    assert s["timeout_driven"] == 0
+
+
+def test_render_table_aligns_and_marks_missing():
+    rows = anatomy([
+        _ev(0.0, 0, 1, 0, "round.start"),
+        _ev(0.5, 0, 1, 0, "commit"),
+    ])
+    text = render_table(rows)
+    lines = text.splitlines()
+    assert lines[0].split() == [
+        "ht", "rep", "rnds", "propose", "prevote", "precommit",
+        "stall", "total", "t/o", "flags",
+    ]
+    assert set(lines[1]) <= {"-", " "}
+    # Phases without step markers render as '-', the total still appears.
+    assert "-" in lines[2] and "0.5000" in lines[2]
+
+
+# ----------------------------------------------------------------- perfetto
+
+
+def _tracks(trace):
+    by_tid = {}
+    for ev in trace:
+        if ev["ph"] in ("B", "E", "i"):
+            by_tid.setdefault(ev["tid"], []).append(ev)
+    return by_tid
+
+
+def test_trace_events_are_schema_valid_and_monotonic_per_track():
+    events = [
+        _ev(0.0, 0, 1, 0, "round.start"),
+        _ev(0.1, 0, 1, 0, "step.prevoting"),
+        _ev(0.2, 0, 1, 0, "step.precommitting"),
+        _ev(0.2, 0, 1, 0, "timeout.precommit.fired"),
+        _ev(0.3, 0, 1, 0, "commit", "aa"),
+        _ev(0.05, -1, -1, -1, "fetch.sync", "tally"),
+    ]
+    trace = to_trace_events(events)
+    for ev in trace:
+        assert ev["ph"] in ("B", "E", "i", "M")
+        assert ev["pid"] == PID
+        if ev["ph"] != "M":
+            assert ev["ts"] >= 0.0
+            assert "tid" in ev
+        if ev["ph"] in ("B", "i"):
+            assert ev["name"]
+    for tid, evs in _tracks(trace).items():
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts), f"tid {tid} timestamps regress"
+    # Spans balance per track: every B has its E.
+    for tid, evs in _tracks(trace).items():
+        depth = 0
+        for e in evs:
+            if e["ph"] == "B":
+                depth += 1
+            elif e["ph"] == "E":
+                depth -= 1
+                assert depth >= 0
+        assert depth == 0, f"tid {tid} leaves open spans"
+    # Track metadata labels replicas and the sim-global lane.
+    names = {
+        ev["tid"]: ev["args"]["name"]
+        for ev in trace
+        if ev["ph"] == "M" and ev["name"] == "thread_name"
+    }
+    assert names[0] == "replica 0"
+    assert names[-1] == "sim"
+
+
+def test_trace_instants_carry_height_round_and_detail():
+    trace = to_trace_events([
+        _ev(0.1, 2, 4, 1, "equivocation", "double_prevote"),
+    ])
+    inst = [e for e in trace if e["ph"] == "i"]
+    assert len(inst) == 1
+    assert inst[0]["s"] == "t"
+    assert inst[0]["args"] == {
+        "height": 4, "round": 1, "detail": "double_prevote",
+    }
+
+
+def test_export_writes_loadable_doc(tmp_path):
+    path = tmp_path / "trace.json"
+    doc = export([_ev(0.0, 0, 1, 0, "commit")], path)
+    on_disk = json.loads(path.read_text())
+    assert on_disk == doc
+    assert on_disk["displayTimeUnit"] == "ms"
+
+
+# ---------------------------------------------- observed sim (acceptance)
+
+
+@pytest.fixture(scope="module")
+def observed_sim():
+    from hyperdrive_tpu.harness import Simulation
+
+    sim = Simulation(
+        n=4, target_height=3, seed=91, timeout=20.0,
+        delivery_cost=0.001, observe=True,
+    )
+    res = sim.run()
+    assert res.completed
+    return sim
+
+
+def test_observed_run_trace_has_round_phase_spans_and_commits(observed_sim):
+    events = observed_sim.obs.snapshot()
+    trace = to_trace_events(events)
+    rounds = [e for e in trace if e["ph"] == "B" and e["cat"] == "round"]
+    phases = {e["name"] for e in trace if e["ph"] == "B" and e["cat"] == "phase"}
+    commits = [e for e in trace if e["ph"] == "i" and e["name"] == "commit"]
+    assert phases == {"propose", "prevote", "precommit"}
+    # Every replica opens round spans for multiple heights and commits
+    # at least once — the 4-replica multi-height acceptance shape.
+    for tid in range(4):
+        assert sum(1 for e in rounds if e["tid"] == tid) >= 3
+        assert any(e["tid"] == tid for e in commits)
+
+
+def test_offline_proposer_run_records_timeout_instants():
+    from hyperdrive_tpu.harness import Simulation
+
+    sim = Simulation(
+        n=4, target_height=2, seed=7, timeout=1.0,
+        offline={1}, observe=True,
+    )
+    sim.run(max_steps=20000)
+    events = sim.obs.snapshot()
+    fired = {e.kind for e in events if e.kind.startswith("timeout.")}
+    assert any(k.endswith(".fired") for k in fired), fired
+    trace = to_trace_events(events)
+    assert any(
+        e["ph"] == "i" and e["name"].startswith("timeout") for e in trace
+    )
+
+
+def test_disabled_recording_leaves_replica_on_null_bound():
+    from hyperdrive_tpu.harness import Simulation
+
+    sim = Simulation(n=4, target_height=1, seed=91)
+    assert sim._obs_sim is NULL_BOUND
+    assert sim.replicas[0].obs is NULL_BOUND
+    assert sim.replicas[0].proc.obs is NULL_BOUND
+    sim.run()
+    assert len(sim.obs) == 0
+
+
+# ---------------------------------------------------------------------- CLI
+
+
+def test_cli_record_report_export_round_trip(tmp_path, capsys):
+    journal = str(tmp_path / "journal.json")
+    trace = str(tmp_path / "trace.json")
+    assert obs_cli.main([
+        "record", "-o", journal, "--replicas", "4", "--heights", "2",
+    ]) == 0
+    rec_out = json.loads(capsys.readouterr().out)
+    assert rec_out["completed"] is True and rec_out["events"] > 0
+
+    assert obs_cli.main(["report", journal]) == 0
+    report_out = capsys.readouterr().out
+    assert "commits" in report_out and "mean rounds" in report_out
+
+    assert obs_cli.main(["report", journal, "--json"]) == 0
+    rows = json.loads(capsys.readouterr().out)
+    assert rows["summary"]["commits"] >= 8  # 4 replicas x 2 heights
+
+    assert obs_cli.main(["export", journal, "-o", trace]) == 0
+    exp_out = json.loads(capsys.readouterr().out)
+    assert exp_out["events"] > 0
+    assert json.loads(open(trace).read())["traceEvents"]
+
+
+def test_cli_report_empty_journal_exits_nonzero(tmp_path, capsys):
+    rec = Recorder(capacity=4)
+    rec.emit("round.start", 0, 1, 0)  # no commit: no anatomy rows
+    path = str(tmp_path / "empty.json")
+    rec.save(path)
+    assert obs_cli.main(["report", path]) == 1
+    assert "no committed heights" in capsys.readouterr().out

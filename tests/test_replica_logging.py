@@ -1,0 +1,84 @@
+"""Replica structured-log contract (utils/log.py): the driver actually
+logs what SURVEY.md §5 faulted the reference for never logging — commits,
+height resyncs, byzantine evidence — as grep-able key=value lines on the
+``hyperdrive_tpu`` logger, with configuration left to the embedding app.
+"""
+
+import hashlib
+import logging
+
+from hyperdrive_tpu.messages import Prevote
+from hyperdrive_tpu.replica import ResetHeight
+
+from test_replica import build_network
+
+
+def _messages(caplog, needle, level=None):
+    return [
+        r.getMessage()
+        for r in caplog.records
+        if needle in r.getMessage()
+        and (level is None or r.levelno == level)
+    ]
+
+
+def test_commit_logged_with_height_round_value_kv(caplog):
+    caplog.set_level(logging.INFO, logger="hyperdrive_tpu")
+    _, replicas, commits = build_network(4)
+    for r in replicas:
+        r.start()
+    assert commits[0], "sanity: loopback network committed"
+    lines = _messages(caplog, "commit ", logging.INFO)
+    assert lines, "committer instrumentation logged nothing"
+    # kv() renders height=/round=/value= with the value hex-abbreviated.
+    line = lines[0]
+    assert "height=" in line and "round=" in line and "value=" in line
+    assert not any(len(tok.split("=", 1)[1]) > 16
+                   for tok in line.split() if tok.startswith("value="))
+
+
+def test_height_resync_logged_with_from_to_kv(caplog):
+    caplog.set_level(logging.INFO, logger="hyperdrive_tpu")
+    sigs, replicas, _ = build_network(4)
+    r0 = replicas[0]
+    r0.start()
+    caplog.clear()
+    r0.handle(ResetHeight(height=100, signatories=tuple(sigs)))
+    lines = _messages(caplog, "reset height", logging.INFO)
+    assert len(lines) == 1
+    assert "to_height=100" in lines[0]
+    assert "from_height=" in lines[0]
+    assert "rotating=True" in lines[0]
+
+
+def test_equivocation_logged_as_warning_with_kind_and_sender(caplog):
+    caplog.set_level(logging.INFO, logger="hyperdrive_tpu")
+    sigs, replicas, _ = build_network(4)
+    r0 = replicas[0]
+    for r in replicas:
+        r.start()
+    caplog.clear()
+    h, rnd = r0.current_height(), r0.proc.current_round
+    # Two conflicting prevotes from one signatory at the same (h, r):
+    # whichever vote that sender already holds, at least one conflicts.
+    for tag in (b"fork-a", b"fork-b"):
+        r0.handle(Prevote(
+            height=h, round=rnd,
+            value=hashlib.sha256(tag).digest(), sender=sigs[1],
+        ))
+    lines = _messages(caplog, "byzantine evidence", logging.WARNING)
+    assert lines, "double prevote was not logged"
+    assert "kind=double_prevote" in lines[0]
+    assert f"sender={sigs[1].hex()[:16]}" in lines[0]
+
+
+def test_quiet_logger_costs_nothing_at_default_level(caplog):
+    # get_logger attaches only a NullHandler; at WARNING (the stdlib
+    # default), the INFO commit lines are never rendered — kv() is
+    # guarded by isEnabledFor at the call site.
+    caplog.set_level(logging.WARNING, logger="hyperdrive_tpu")
+    _, replicas, commits = build_network(4)
+    for r in replicas:
+        r.start()
+    assert commits[0]
+    assert _messages(caplog, "commit ", logging.INFO) == []

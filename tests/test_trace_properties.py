@@ -1,0 +1,159 @@
+"""Property specs for utils/trace.py: Histogram window + null overhead.
+
+hypothesis is not a local dependency (see test_columnar_parity.py), so
+the properties run as seeded random loops — replayable via
+HYPERDRIVE_TEST_SEED, wide enough to cross every bucket boundary and
+wrap the sample ring several times.
+"""
+
+import random
+
+from hyperdrive_tpu.obs.recorder import (
+    NULL_BOUND,
+    NULL_RECORDER,
+    NullBound,
+    NullRecorder,
+)
+from hyperdrive_tpu.utils.trace import NULL_TRACER, Histogram, NullTracer, Tracer
+
+
+def _random_values(rng, n):
+    # Log-uniform over the bucket range plus exact boundary hits: the
+    # bucket-placement property is only interesting at the edges.
+    out = []
+    for _ in range(n):
+        if rng.random() < 0.2:
+            out.append(rng.choice(Histogram.DEFAULT_BUCKETS))
+        else:
+            out.append(10.0 ** rng.uniform(-7, 3.5))
+    return out
+
+
+# ---------------------------------------------------------------- ring window
+
+
+def test_ring_window_is_exactly_the_most_recent_max_samples(rng):
+    for trial in range(20):
+        m = rng.randint(1, 64)
+        n = rng.randint(m + 1, 6 * m)  # always wraps at least once
+        h = Histogram(max_samples=m)
+        values = _random_values(rng, n)
+        for v in values:
+            h.observe(v)
+        # The retained sample multiset is the last m observations — the
+        # off-by-one this spec pins down kept the oldest sample alive
+        # for a full extra lap.
+        assert sorted(h._samples) == sorted(values[-m:]), (
+            f"trial {trial}: ring window drifted (m={m}, n={n})"
+        )
+        assert h.quantile(0.0) == min(values[-m:])
+        assert h.quantile(1.0) == max(values[-m:])
+
+
+def test_ring_window_below_capacity_keeps_everything(rng):
+    h = Histogram(max_samples=128)
+    values = _random_values(rng, 100)
+    for v in values:
+        h.observe(v)
+    assert sorted(h._samples) == sorted(values)
+
+
+# ------------------------------------------------------------------ quantiles
+
+
+def test_quantiles_are_monotone_and_within_sample_range(rng):
+    for _ in range(10):
+        h = Histogram(max_samples=256)
+        values = _random_values(rng, rng.randint(1, 400))
+        for v in values:
+            h.observe(v)
+        qs = sorted(rng.uniform(0.0, 1.0) for _ in range(9))
+        quants = [h.quantile(q) for q in qs]
+        assert quants == sorted(quants), "quantile must be monotone in q"
+        lo, hi = min(h._samples), max(h._samples)
+        assert all(lo <= x <= hi for x in quants)
+
+
+def test_quantile_of_empty_histogram_is_zero():
+    h = Histogram()
+    assert h.quantile(0.5) == 0.0
+    assert h.mean == 0.0
+
+
+# ----------------------------------------------------------- bucket invariants
+
+
+def test_bucket_counts_partition_total_and_sum_tracks_all(rng):
+    h = Histogram(max_samples=32)  # much smaller than n: ring can't help
+    values = _random_values(rng, 500)
+    for v in values:
+        h.observe(v)
+    # Bucket counts never drop, even though the raw-sample ring does:
+    # they partition the full observation count.
+    assert sum(h.counts) == h.total == len(values)
+    assert abs(h.sum - sum(values)) < 1e-6 * max(1.0, abs(sum(values)))
+    assert abs(h.mean - sum(values) / len(values)) < 1e-9 * h.mean
+
+
+def test_bucket_placement_is_bisect_left_on_boundaries():
+    h = Histogram(buckets=(1.0, 10.0), max_samples=8)
+    for v in (0.5, 1.0, 5.0, 10.0, 50.0):
+        h.observe(v)
+    # bisect_left: a value equal to a boundary lands in that boundary's
+    # bucket, not the next one up.
+    assert h.counts == [2, 2, 1]
+
+
+# -------------------------------------------------------------- null overhead
+
+
+def test_null_tracer_records_nothing():
+    assert isinstance(NULL_TRACER, NullTracer)
+    NULL_TRACER.count("x.y", 5)
+    NULL_TRACER.observe("x.y", 1.0)
+    with NULL_TRACER.span("x.y"):
+        pass
+    snap = NULL_TRACER.snapshot()
+    assert snap == {"counters": {}, "histograms": {}}
+
+
+def test_null_recorder_and_bound_are_inert_and_shared():
+    assert isinstance(NULL_RECORDER, NullRecorder)
+    assert isinstance(NULL_BOUND, NullBound)
+    # Every scoped() handle off the null recorder is the one shared
+    # singleton — the identity the hot-path guards key on.
+    assert NULL_RECORDER.scoped(0) is NULL_BOUND
+    assert NULL_RECORDER.scoped(7) is NULL_BOUND
+    NULL_BOUND.emit("commit", 1, 0)
+    NULL_RECORDER.emit("commit", 0, 1, 0)
+    assert len(NULL_RECORDER) == 0
+    assert NULL_RECORDER.dropped == 0
+
+
+def test_disabled_instrumentation_overhead_smoke():
+    """200k no-op emits/counts complete in interactive time.
+
+    Not a benchmark — a regression tripwire for someone adding real work
+    to the null objects. The generous bound absorbs CI-host noise; the
+    measured per-call figures live in OBSERVABILITY.md.
+    """
+    import time
+
+    t0 = time.perf_counter()
+    for _ in range(200_000):
+        NULL_BOUND.emit("commit", 1, 0)
+        NULL_TRACER.count("replica.msg.prevote")
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 5.0, f"null instrumentation took {elapsed:.2f}s"
+
+
+def test_live_tracer_snapshot_matches_observations(rng):
+    tr = Tracer(time_fn=None, threadsafe=rng.random() < 0.5)
+    tr.count("a.b", 3)
+    tr.count("a.b")
+    for v in (0.1, 0.2, 0.3):
+        tr.observe("lat.s", v)
+    snap = tr.snapshot()
+    assert snap["counters"]["a.b"] == 4
+    assert snap["histograms"]["lat.s"]["count"] == 3
+    assert abs(snap["histograms"]["lat.s"]["mean"] - 0.2) < 1e-12
