@@ -1,0 +1,37 @@
+"""Chaos engineering for the consensus stack.
+
+Seeded, replayable adversarial conditions as a first-class subsystem:
+
+- :mod:`~hyperdrive_tpu.chaos.plan` — the FaultPlan DSL (link faults,
+  scheduled partitions, crash-restarts) interpreted by the deterministic
+  harness (``Simulation(chaos=...)``).
+- :mod:`~hyperdrive_tpu.chaos.monitor` — the InvariantMonitor asserting
+  no-fork-across-restarts, commit-digest equality, and bounded rounds to
+  commit after every heal.
+- :mod:`~hyperdrive_tpu.chaos.proxy` — a fault-injecting TCP proxy for
+  real-socket partition/heal tests against TcpNode.
+- ``python -m hyperdrive_tpu.chaos soak`` — N seeded scenarios; any
+  violation dumps its ScenarioRecord + obs journal + checkpoints for
+  message-for-message replay.
+
+See ROBUSTNESS.md for the taxonomy, examples, and walkthrough.
+"""
+
+from hyperdrive_tpu.chaos.monitor import InvariantMonitor, InvariantViolation
+from hyperdrive_tpu.chaos.plan import (
+    CrashRestart,
+    FaultPlan,
+    LinkFault,
+    Partition,
+)
+from hyperdrive_tpu.chaos.proxy import ChaosProxy
+
+__all__ = [
+    "LinkFault",
+    "Partition",
+    "CrashRestart",
+    "FaultPlan",
+    "InvariantMonitor",
+    "InvariantViolation",
+    "ChaosProxy",
+]

@@ -1,0 +1,153 @@
+"""The chaos soak driver.
+
+Usage::
+
+    python -m hyperdrive_tpu.chaos soak [--scenarios N] [--seed S]
+        [--n N_REPLICAS] [--target H] [--out DIR] [--replay-every K]
+    python -m hyperdrive_tpu.chaos replay DUMP.bin
+
+``soak`` runs N seeded scenarios — each a fresh
+:meth:`~hyperdrive_tpu.chaos.plan.FaultPlan.seeded` draw (partition of
+up to f replicas with a heal, one crash-restart, a few lossy links) —
+under the :class:`~hyperdrive_tpu.chaos.monitor.InvariantMonitor`. Any
+violation dumps the ScenarioRecord, the obs journal, and the victims'
+checkpoints into ``--out`` and exits 1; the printed ``replay`` command
+reproduces the failure message-for-message. Every ``--replay-every``-th
+passing scenario is also replayed from its own record as a determinism
+self-check.
+
+Scenarios run unsigned (values are opaque digests; signature checking is
+orthogonal to fault handling), so the soak needs no accelerator and no
+jax import. HD_SANITIZE=1 in the environment arms the runtime sanitizer
+on every replica — CI runs the soak that way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+from hyperdrive_tpu.chaos.monitor import InvariantMonitor, InvariantViolation
+from hyperdrive_tpu.chaos.plan import FaultPlan
+from hyperdrive_tpu.harness.sim import ScenarioRecord, Simulation
+
+#: Spread scenario seeds so adjacent indices explore unrelated plans.
+_SEED_STRIDE = 9973
+
+
+def _build(scen_seed: int, n: int, target: int):
+    plan = FaultPlan.seeded(scen_seed, n)
+    sim = Simulation(
+        n=n,
+        target_height=target,
+        seed=scen_seed,
+        timeout=1.0,
+        # The reference harness paces deliveries at 1 ms
+        # (replica_test.go:291); partitions need the pacing to engage.
+        delivery_cost=1e-3,
+        chaos=plan,
+        observe=True,
+    )
+    return plan, sim
+
+
+def _dump_failure(out: str, scen_seed: int, sim, err) -> str:
+    os.makedirs(out, exist_ok=True)
+    base = os.path.join(out, f"chaos_seed_{scen_seed}")
+    sim.record.dump(base + ".bin")
+    sim.obs.save(base + ".journal.json")
+    sim._ckpt_store.dump(base + ".ckpt")
+    with open(base + ".txt", "w") as fh:
+        fh.write(f"seed={scen_seed}\nviolation={err}\n")
+    return base
+
+
+def soak(args) -> int:
+    rng = random.Random(args.seed)
+    failures = 0
+    for k in range(args.scenarios):
+        scen_seed = args.seed + k * _SEED_STRIDE
+        n = args.n if args.n else rng.choice([4, 7])
+        plan, sim = _build(scen_seed, n, args.target)
+        monitor = InvariantMonitor(sim)
+        try:
+            result = sim.run(max_steps=args.max_steps)
+            monitor.check_final(result)
+            if args.replay_every and k % args.replay_every == 0:
+                replayed = Simulation.replay(sim.record)
+                if replayed.commits != result.commits:
+                    raise InvariantViolation(
+                        "replay", "replayed commits diverge from live run"
+                    )
+        except (InvariantViolation, AssertionError) as err:
+            failures += 1
+            base = _dump_failure(args.out, scen_seed, sim, err)
+            print(
+                f"FAIL seed={scen_seed} n={n} {err}\n"
+                f"  dumped {base}.bin (+ journal, checkpoints)\n"
+                f"  reproduce: python -m hyperdrive_tpu.chaos replay "
+                f"{base}.bin",
+                file=sys.stderr,
+            )
+            if not args.keep_going:
+                return 1
+            continue
+        print(
+            f"ok seed={scen_seed} n={n} heights<= {max(result.heights)} "
+            f"steps={result.steps} crashes={len(monitor.crashes)} "
+            f"heals={len(monitor.heals)}"
+        )
+    if failures:
+        print(f"soak FAILED: {failures}/{args.scenarios}", file=sys.stderr)
+        return 1
+    print(f"soak ok: {args.scenarios} scenarios, 0 violations")
+    return 0
+
+
+def replay(args) -> int:
+    record = ScenarioRecord.load(args.dump)
+    result = Simulation.replay(record)
+    result.assert_safety()
+    print(
+        f"replayed seed={record.seed} n={record.n} "
+        f"target={record.target_height}: completed={result.completed} "
+        f"steps={result.steps} lifecycle_ops={len(record.lifecycle)} "
+        f"digest={result.commit_digest()[:16]}"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m hyperdrive_tpu.chaos")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("soak", help="run N seeded chaos scenarios")
+    p.add_argument("--scenarios", type=int, default=20)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument(
+        "--n", type=int, default=0, help="replica count (0 = mix of 4 and 7)"
+    )
+    p.add_argument("--target", type=int, default=8)
+    p.add_argument("--max-steps", type=int, default=500_000)
+    p.add_argument("--out", default="chaos_failures")
+    p.add_argument(
+        "--replay-every",
+        type=int,
+        default=5,
+        help="determinism self-check cadence (0 = off)",
+    )
+    p.add_argument("--keep-going", action="store_true")
+    p.set_defaults(fn=soak)
+
+    p = sub.add_parser("replay", help="replay a dumped ScenarioRecord")
+    p.add_argument("dump")
+    p.set_defaults(fn=replay)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""Safety/liveness invariant monitor for chaos runs.
+
+The monitor wraps a :class:`~hyperdrive_tpu.harness.sim.Simulation`'s
+commit callback — the same seam the HD_SANITIZE runtime sanitizer
+interposes (utils/sanitize.py) — and receives lifecycle notifications
+(crash/restore/heal) from the chaos engine. It checks, *while the run is
+still live* so the ScenarioRecord is intact at raise time:
+
+- **no-fork-across-restarts** — one committed value per height,
+  network-wide, forever: a restored replica re-committing a height must
+  agree with what the network committed, and no two replicas may ever
+  commit different values at the same height (safety under ≤ f faults,
+  paper Lemma: agreement).
+- **bounded rounds to commit after every heal** — after a partition
+  heals, each live replica's next commit must land within
+  ``max_rounds_after_heal`` rounds (liveness once synchrony resumes,
+  paper round-synchronization argument).
+
+and post-run via :meth:`check_final`:
+
+- **commit-digest equality among honest replicas** — byte-equality of
+  every overlapping commit, cross-checked against the obs journal's
+  commit events when the sim runs with ``observe=True``.
+- **completeness** — the run actually reached its target height.
+
+A violation raises :class:`InvariantViolation` (an ``AssertionError``
+subclass so plain pytest/soak harnesses catch it naturally); the soak
+CLI reacts by dumping the ScenarioRecord and obs journal for
+message-for-message replay.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from hyperdrive_tpu.harness.sim import Simulation, SimulationResult
+
+__all__ = ["InvariantMonitor", "InvariantViolation"]
+
+
+class InvariantViolation(AssertionError):
+    """A chaos invariant failed; ``kind`` names which one."""
+
+    def __init__(self, kind: str, detail: str) -> None:
+        super().__init__(f"{kind}: {detail}")
+        self.kind = kind
+
+
+class InvariantMonitor:
+    """Attach to a Simulation *before* ``run()``; it hooks the commit
+    callback and registers itself as ``sim._chaos_monitor`` so the chaos
+    engine reports crashes, restores, and heals as they happen."""
+
+    def __init__(
+        self,
+        sim: "Simulation",
+        *,
+        max_rounds_after_heal: int = 12,
+        honest: "set[int] | None" = None,
+    ) -> None:
+        self.sim = sim
+        self.max_rounds_after_heal = max_rounds_after_heal
+        self.honest = set(range(sim.n)) if honest is None else set(honest)
+        #: height -> committed value: the network-wide chain. Survives
+        #: crashes and restores by construction — it is never reset.
+        self.chain: dict[int, bytes] = {}
+        self.heals: list[float] = []
+        self.crashes: list[tuple[int, float]] = []
+        self.restores: list[tuple[int, int]] = []
+        self.commit_rounds_after_heal: list[int] = []
+        self._await_heal_commit: "set[int] | None" = None
+        self._orig_commit = sim._on_commit
+        sim._on_commit = self._commit
+        sim._chaos_monitor = self
+
+    # -- live hooks --------------------------------------------------
+
+    def _commit(self, i: int, height: int, value: bytes):
+        prev = self.chain.get(height)
+        if prev is not None and prev != value:
+            raise InvariantViolation(
+                "fork",
+                f"replica {i} committed {value.hex()[:16]} at height "
+                f"{height}; the network committed {prev.hex()[:16]}",
+            )
+        self.chain[height] = value
+        awaiting = self._await_heal_commit
+        if awaiting is not None and i in awaiting:
+            awaiting.discard(i)
+            rounds = self.sim.replicas[i].proc.current_round + 1
+            self.commit_rounds_after_heal.append(rounds)
+            if rounds > self.max_rounds_after_heal:
+                raise InvariantViolation(
+                    "liveness",
+                    f"replica {i} needed {rounds} rounds to commit "
+                    f"height {height} after heal "
+                    f"(bound {self.max_rounds_after_heal})",
+                )
+        return self._orig_commit(i, height, value)
+
+    def note_crash(self, victim: int, now: float) -> None:
+        self.crashes.append((victim, now))
+
+    def note_restore(self, victim: int, resync_height: int) -> None:
+        self.restores.append((victim, resync_height))
+
+    def note_heal(self, now: float) -> None:
+        self.heals.append(now)
+        sim = self.sim
+        self._await_heal_commit = {
+            i for i in range(sim.n) if sim.alive[i] and i in self.honest
+        }
+
+    # -- post-run ----------------------------------------------------
+
+    def check_final(self, result: "SimulationResult") -> "InvariantMonitor":
+        """Assert the post-run invariants; returns self for chaining."""
+        result.assert_safety()
+        for i in sorted(self.honest):
+            for height, value in result.commits[i].items():
+                want = self.chain.get(height)
+                if want is not None and value != want:
+                    raise InvariantViolation(
+                        "digest",
+                        f"replica {i} holds {value.hex()[:16]} at height "
+                        f"{height}; chain has {want.hex()[:16]}",
+                    )
+        self._check_journal()
+        # Post-heal liveness: a completed run IS the liveness proof —
+        # completion means every replica individually committed the
+        # target height, and the harness stops delivering the moment
+        # that happens, so a replica can legitimately end mid-height
+        # with its commit quorum still in flight. Only when the run
+        # STALLED (queue drained or max_steps without completing) does
+        # an unemptied awaiting set witness a real post-heal deadlock.
+        if (
+            not result.completed
+            and self.heals
+            and self._await_heal_commit
+        ):
+            raise InvariantViolation(
+                "liveness",
+                f"replicas {sorted(self._await_heal_commit)} never "
+                "committed after the last heal",
+            )
+        if not result.completed:
+            raise InvariantViolation(
+                "liveness",
+                f"run stalled below target; heights={result.heights}",
+            )
+        return self
+
+    def _check_journal(self) -> None:
+        """Cross-check the obs flight recorder against the chain: every
+        journalled commit event's value prefix must match what the
+        monitor saw at the callback seam (observe=True runs only)."""
+        snapshot = getattr(self.sim.obs, "snapshot", None)
+        if snapshot is None:
+            return
+        for ev in snapshot():
+            if ev.kind != "commit":
+                continue
+            want = self.chain.get(ev.height)
+            if want is None or ev.detail is None:
+                continue
+            if not want.hex().startswith(str(ev.detail)):
+                raise InvariantViolation(
+                    "journal",
+                    f"obs journal commit at height {ev.height} carries "
+                    f"{ev.detail}; chain has {want.hex()[:16]}",
+                )

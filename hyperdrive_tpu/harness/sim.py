@@ -30,7 +30,7 @@ import time
 from itertools import repeat
 
 import numpy as np
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Callable, Optional
 
 from hyperdrive_tpu.batch import WindowColumns
@@ -44,7 +44,12 @@ from hyperdrive_tpu.messages import (
     unmarshal_message,
 )
 from hyperdrive_tpu.obs.recorder import NULL_BOUND as _OBS_NULL
-from hyperdrive_tpu.replica import Replica, ReplicaOptions, merge_drain
+from hyperdrive_tpu.replica import (
+    Replica,
+    ReplicaOptions,
+    ResetHeight,
+    merge_drain,
+)
 from hyperdrive_tpu.testutil import (
     BroadcasterCallbacks,
     CatcherCallbacks,
@@ -117,15 +122,28 @@ class ScenarioRecord:
     #: cascade) or per-message dispatch — replay must match, or timeout
     #: schedules and evidence can diverge from the recorded run.
     batch_ingest: bool = True
+    #: Chaos lifecycle operations, ``(kind, pos, replica, aux)`` with
+    #: kind one of OP_CRASH / OP_RESTORE / OP_RESYNC, ``pos`` the
+    #: delivered-message count when the op fired (replay applies every op
+    #: with pos <= j before delivering message j), and ``aux`` the resync
+    #: height for RESTORE/RESYNC (0 for CRASH). Dropped/blocked/delayed
+    #: messages never enter the record, so replay needs no knowledge of
+    #: the FaultPlan — only of when replicas died, revived, and jumped.
+    lifecycle: list[tuple[int, int, int, int]] = field(default_factory=list)
+
+    OP_CRASH = 0
+    OP_RESTORE = 1
+    OP_RESYNC = 2
 
     #: Format magic+version; bump on any envelope/layout change so stale
     #: dumps are rejected with a clear error instead of desynchronizing.
     #: v3 appends the burst-size trailer (v2 dumps still load); v4 appends
     #: the batch_ingest flag. Pre-v4 dumps load as batch_ingest=False:
     #: batched ingestion did not exist then, so every old record was
-    #: captured under per-message dispatch.
+    #: captured under per-message dispatch. v5 appends the chaos
+    #: lifecycle-op trailer (pre-v5 dumps load with no lifecycle ops).
     MAGIC = 0x48594456  # "HYDV"
-    VERSION = 4
+    VERSION = 5
 
     def marshal(self, w: Writer) -> None:
         w.u32(self.MAGIC)
@@ -145,6 +163,12 @@ class ScenarioRecord:
         for b in self.bursts:
             w.u32(b)
         w.bool(self.batch_ingest)
+        w.u32(len(self.lifecycle))
+        for kind, pos, replica, aux in self.lifecycle:
+            w.u32(kind)
+            w.u32(pos)
+            w.u32(replica)
+            w.i64(aux)
 
     @classmethod
     def unmarshal(cls, r: Reader) -> "ScenarioRecord":
@@ -152,7 +176,7 @@ class ScenarioRecord:
         if magic != cls.MAGIC:
             raise SerdeError(f"not a scenario dump (magic {magic:#x})")
         version = r.u32()
-        if version not in (2, 3, cls.VERSION):
+        if version not in (2, 3, 4, cls.VERSION):
             raise SerdeError(
                 f"scenario dump version {version} unsupported "
                 f"(expected {cls.VERSION})"
@@ -191,6 +215,13 @@ class ScenarioRecord:
             rec.batch_ingest = r.bool()
         else:
             rec.batch_ingest = False
+        if version >= 5:
+            nops = r.u32()
+            if nops > 1 << 20:
+                raise SerdeError("lifecycle op count too large")
+            rec.lifecycle = [
+                (r.u32(), r.u32(), r.u32(), r.i64()) for _ in range(nops)
+            ]
         return rec
 
     def dump(self, path: str) -> None:
@@ -377,6 +408,7 @@ class Simulation:
         route_hysteresis: int = 32,
         observe: bool = False,
         obs_capacity: int = 65536,
+        chaos=None,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -710,6 +742,43 @@ class Simulation:
         self._pending_replicas = {i for i in range(n) if self.alive[i]}
         self.caught: list[tuple[str, int]] = []
 
+        #: Chaos engine (hyperdrive_tpu/chaos): a seeded FaultPlan
+        #: interpreted per delivery in the lock-step loop. Faults draw
+        #: from a dedicated RNG stream (not ``self.rng``) so enabling
+        #: chaos never perturbs the trajectory machinery existing seeds
+        #: pin down. The checkpoint store / capture set exist even
+        #: without a plan: replay of a chaos record restores crash
+        #: victims from checkpoints it re-derives at the recorded commit
+        #: points (identical delivery stream -> identical Process bytes).
+        self._chaos = chaos
+        self._chaos_monitor = None
+        from hyperdrive_tpu.utils.checkpoint import CheckpointStore
+
+        self._ckpt_store = CheckpointStore()
+        self._ckpt_capture: set[int] = set()
+        if chaos is not None:
+            if burst:
+                raise ValueError(
+                    "chaos faults apply per delivery; use lock-step mode "
+                    "(burst=False)"
+                )
+            chaos.validate(n)
+            if chaos.partitions and delivery_cost <= 0.0:
+                raise ValueError(
+                    "partitions are scheduled on the virtual clock, and "
+                    "without delivery pacing a busy network never "
+                    "advances it — pass delivery_cost > 0 (the reference "
+                    "harness paces at 1 ms)"
+                )
+            self._chaos_rng = random.Random((seed << 1) ^ 0x43484F53)
+            self._chaos_links = {
+                (lf.src, lf.dst): lf for lf in chaos.links
+            }
+            self._chaos_parts = [_PartitionRT(p) for p in chaos.partitions]
+            self._chaos_crashes = {c.replica: c for c in chaos.crashes}
+            self._chaos_restores: dict[int, int] = {}
+            self._ckpt_capture = set(self._chaos_crashes)
+
         byz_prop = byzantine_proposer or {}
         byz_val = byzantine_validator or {}
 
@@ -958,10 +1027,14 @@ class Simulation:
             if self._qhead >= len(self.queue):
                 # Network drained: advance virtual time to the next timeout.
                 if self.clock.pending() == 0:
+                    if self._chaos_rescue(steps):
+                        continue
                     break  # genuine stall — nothing can ever happen again
                 if self.clock.pending() > 65536:
                     self._prune_clock()
                     if self.clock.pending() == 0:
+                        if self._chaos_rescue(steps):
+                            continue
                         break
                 event, owner = self.clock.fire_next()
                 self.queue.append((owner, event))
@@ -982,6 +1055,11 @@ class Simulation:
                 self._qhead = 0
             steps += 1
 
+            if self._chaos is not None:
+                self._chaos_tick(steps)
+                msg = self._chaos_deliver(to, msg)
+                if msg is None:
+                    continue
             if self.drop_rate and not isinstance(msg, Timeout):
                 if self.rng.random() < self.drop_rate:
                     continue
@@ -999,6 +1077,15 @@ class Simulation:
                 self.clock.now += self.delivery_cost
             record_messages.append((to, msg))
             self.replicas[to].handle(msg)
+            if to in self._ckpt_capture:
+                # The reference's durability contract, taken literally:
+                # "State should be saved after every method call"
+                # (process/state.go:18-20). Scheduled crash victims
+                # snapshot their Process through the self-validating
+                # checkpoint envelope after every handled delivery, so
+                # the restore image is the exact mid-protocol state at
+                # the last message the process survived.
+                self._ckpt_store.save(to, self.replicas[to].proc)
 
         return SimulationResult(
             completed=self._completed(),
@@ -1175,6 +1262,282 @@ class Simulation:
         self.clock.prune(
             lambda ev: not isinstance(ev, Timeout) or ev.height >= min_h
         )
+
+    # ------------------------------------------------------------ chaos
+
+    def _chaos_tick(self, steps: int) -> None:
+        """Advance the FaultPlan's schedule: engage/heal partitions by
+        virtual time, crash and restore replicas by delivery step."""
+        now = self.clock.now
+        for p in self._chaos_parts:
+            if not p.engaged and not p.healed and now >= p.spec.at:
+                p.engaged = True
+                if self._obs_sim is not _OBS_NULL:
+                    self._obs_sim.emit(
+                        "chaos.partition", -1, -1, len(p.gid)
+                    )
+            if p.engaged and now >= p.spec.heal:
+                p.engaged = False
+                p.healed = True
+                self._chaos_heal(p)
+        for victim, c in list(self._chaos_crashes.items()):
+            if steps >= c.crash_at_step:
+                del self._chaos_crashes[victim]
+                if not self.alive[victim]:
+                    continue
+                self.alive[victim] = False
+                # Unlike kill_at_step's permanent kills, the victim
+                # STAYS in _pending_replicas: a restart is scheduled,
+                # so the run must not declare completion while it is
+                # down — the 2f+1 survivors keep consensus (and the
+                # delivery queue) busy until the restore step arrives.
+                self._chaos_restores[victim] = (
+                    steps + c.restart_after_steps
+                )
+                self._note_lifecycle(ScenarioRecord.OP_CRASH, victim, 0)
+                if self._obs_sim is not _OBS_NULL:
+                    self._obs_sim.emit("chaos.crash", -1, -1, victim)
+                m = self._chaos_monitor
+                if m is not None:
+                    m.note_crash(victim, now)
+        for victim, due in list(self._chaos_restores.items()):
+            if steps >= due:
+                del self._chaos_restores[victim]
+                target = self._net_height()
+                self._note_lifecycle(
+                    ScenarioRecord.OP_RESTORE, victim, target
+                )
+                self._apply_restore(victim, target)
+                if self._obs_sim is not _OBS_NULL:
+                    self._obs_sim.emit("chaos.restore", -1, -1, victim)
+                m = self._chaos_monitor
+                if m is not None:
+                    m.note_restore(victim, target)
+        # Laggard catch-up: a replica that loses a commit quorum to
+        # dropped votes falls off the network's height wavefront and —
+        # no retransmission — can never climb back by itself; the
+        # heal-time resync only rescues the partition case. Sweep
+        # periodically for any alive replica far enough behind the
+        # working height that its stream is unrecoverable, and jump it
+        # forward — the reference's application-driven catch-up
+        # (replica/replica.go:222-235) on a timer. Swept resyncs are
+        # recorded as RESYNC lifecycle ops like any other, so replay
+        # reproduces them without knowing the cadence.
+        if steps % _CATCHUP_EVERY == 0:
+            net = self._net_height()
+            if net > _CATCHUP_LAG + 1:
+                self._chaos_resync(net, lag=_CATCHUP_LAG)
+
+    def _chaos_deliver(self, to: int, msg):
+        """Apply the fault plan to one pending delivery. Returns the
+        message to deliver, or None when a fault swallowed it (dropped,
+        blocked by an active partition, or deferred on the clock).
+        Timeouts are local events — never faulted. Delayed/duplicated
+        copies ride a :class:`_ChaosEnvelope` so they are never
+        re-faulted, though partitions still apply at their eventual
+        delivery time."""
+        if isinstance(msg, Timeout):
+            return msg
+        immune = type(msg) is _ChaosEnvelope
+        if immune:
+            msg = msg.msg
+        src = self._order_pos.get(getattr(msg, "sender", None))
+        for p in self._chaos_parts:
+            if p.engaged and src is not None and p.blocks(src, to):
+                return None
+        if immune or src is None:
+            return msg
+        lf = self._chaos_links.get((src, to))
+        if lf is None:
+            return msg
+        rng = self._chaos_rng
+        if lf.drop and rng.random() < lf.drop:
+            return None
+        if lf.duplicate and rng.random() < lf.duplicate:
+            self.queue.append((to, _ChaosEnvelope(msg)))
+        if lf.delay and rng.random() < lf.delay:
+            self.clock.schedule(
+                rng.uniform(lf.delay_min, lf.delay_max),
+                _ChaosEnvelope(msg),
+                to,
+            )
+            return None
+        return msg
+
+    def _chaos_heal(self, p: "_PartitionRT") -> None:
+        if self._obs_sim is not _OBS_NULL:
+            self._obs_sim.emit("chaos.heal", -1, -1, len(p.gid))
+        m = self._chaos_monitor
+        if m is not None:
+            m.note_heal(self.clock.now)
+        if not p.spec.resync_on_heal:
+            return
+        # The protocol has no retransmission: whatever a replica missed
+        # while cut off — committed heights, or just enough dropped
+        # votes to lose a quorum — is gone for good, so a laggard can
+        # never finish a height the rest of the network abandoned. Jump
+        # every alive laggard to the network's current working height
+        # (the reference's catch-up path, replica/replica.go:222-235).
+        # The reset carries the signatory set, so the ResetHeight
+        # handler actively starts round 0 there — arming the propose
+        # timeout, or proposing — where a bare reset would leave the
+        # replica passive, which deadlocks when the height's proposer
+        # is itself a rejoiner. The active join is equivocation-free: a
+        # replica below the target height never voted at it.
+        self._chaos_resync(self._net_height())
+
+    def _chaos_resync(self, target: Height, lag: int = 0) -> int:
+        """Jump every alive replica more than ``lag`` heights below
+        ``target`` to an active join of it (see :meth:`_chaos_heal` for
+        why active, and why the in-flight height rather than a future
+        one: the join keeps the height at full strength, and rejoiners
+        catch up through the next round's fresh propose). ``lag > 0``
+        (the periodic sweep) tolerates the normal commit wavefront —
+        only a replica the network has demonstrably left behind is
+        rescued."""
+        sigs = tuple(self.signatories)
+        resynced = 0
+        for i in range(self.n):
+            r = self.replicas[i]
+            if self.alive[i] and target - r.proc.current_height > lag:
+                self._note_lifecycle(ScenarioRecord.OP_RESYNC, i, target)
+                r.handle(ResetHeight(height=target, signatories=sigs))
+                resynced += 1
+        return resynced
+
+    def _chaos_rescue(self, steps: int) -> bool:
+        """The delivery queue AND the virtual clock drained mid-run.
+
+        Both chaos timelines are delivery-driven — virtual time advances
+        on delivery cost and timeout firings, the step counter on
+        deliveries — so a deadlocked network (say the majority group
+        one crashed member short of a precommit quorum, every survivor
+        parked mid-step with no timeout armed) freezes the FaultPlan's
+        remaining schedule forever: the heal or restore that would end
+        the deadlock can never come due. Real time does not stop for a
+        stalled process. Jump to the next scheduled event — the nearest
+        partition boundary in virtual time first, then any frozen
+        step-scheduled crash/restore pulled to the present — and
+        re-tick; with no schedule left, resync stranded laggards as a
+        last resort. Returns True when anything was applied, so the
+        delivery loop keeps going instead of declaring a genuine stall.
+
+        Termination: partitions engage and heal monotonically, crashes
+        and restores are consumed when applied, and a laggard resync
+        lifts a replica to the working height (it cannot re-fire for
+        that replica until the network commits further) — every rescue
+        strictly consumes schedule or raises a height, so a run that
+        cannot make progress still reaches ``False`` and stops.
+        Lifecycle ops recorded here carry the current delivered-message
+        position like any other, so replay reproduces rescue-applied
+        events with no knowledge of the stall."""
+        if self._chaos is None:
+            return False
+        boundary = None
+        for p in self._chaos_parts:
+            if p.healed:
+                continue
+            b = p.spec.heal if p.engaged else p.spec.at
+            if boundary is None or b < boundary:
+                boundary = b
+        if boundary is not None:
+            if boundary > self.clock.now:
+                self.clock.now = boundary
+            self._chaos_tick(steps)
+            return True
+        if self._chaos_crashes:
+            victim = min(
+                self._chaos_crashes,
+                key=lambda v: self._chaos_crashes[v].crash_at_step,
+            )
+            c = self._chaos_crashes[victim]
+            if c.crash_at_step > steps:
+                self._chaos_crashes[victim] = replace(
+                    c, crash_at_step=steps
+                )
+            self._chaos_tick(steps)
+            return True
+        if self._chaos_restores:
+            victim = min(
+                self._chaos_restores, key=self._chaos_restores.get
+            )
+            if self._chaos_restores[victim] > steps:
+                self._chaos_restores[victim] = steps
+            self._chaos_tick(steps)
+            return True
+        return self._chaos_resync(self._net_height()) > 0
+
+    def _net_height(self) -> Height:
+        """The network's next height: one past the best commit any
+        replica has recorded — the resync target for rejoiners."""
+        best = 0
+        for c in self.commits:
+            if c:
+                m = max(c)
+                if m > best:
+                    best = m
+        return best + 1
+
+    def _apply_restore(self, victim: int, net_height: Height) -> None:
+        """The revive path, shared by the live chaos engine and replay:
+        restore the Process from the victim's latest checkpoint (None =
+        crashed before its first commit -> genesis state), then rejoin.
+
+        Two cases, keyed on whether the network committed past the
+        checkpoint while the victim was down (``net_height`` is the
+        network's current working height at restore time):
+
+        - It did not (the victim's height is still live — possibly the
+          network is even stalled waiting for its vote): resume in
+          place. :meth:`Process.resume` re-arms the current step's
+          timeout and broadcasts nothing, so the checkpoint's restored
+          locked/valid values steer the victim's next votes and it
+          cannot equivocate against its pre-crash self.
+        - It did: the victim's finished heights will never be re-sent,
+          so it actively joins the network's in-flight height instead
+          (a signatory-carrying ResetHeight, exactly the heal-resync
+          path — see :meth:`_chaos_resync`). Safe for the same reason:
+          a victim restored below ``net_height`` never voted there.
+
+        Both branches are pure functions of the restored Process state
+        and ``net_height``, which replay reproduces exactly (identical
+        delivery stream -> identical checkpoints and commits), so the
+        recorded RESTORE op only needs to carry ``net_height``."""
+        r = self.replicas[victim]
+        r.restore(self._ckpt_store.latest(victim))
+        self.alive[victim] = True
+        if net_height > r.proc.current_height:
+            r.handle(
+                ResetHeight(
+                    height=net_height,
+                    signatories=tuple(self.signatories),
+                )
+            )
+        else:
+            r.proc.resume()
+        if not any(
+            h >= self.target_height for h in self.commits[victim]
+        ):
+            self._pending_replicas.add(victim)
+
+    def _note_lifecycle(self, kind: int, replica: int, aux: int) -> None:
+        if self._record_on:
+            self.record.lifecycle.append(
+                (kind, len(self.record.messages), replica, aux)
+            )
+
+    def _replay_lifecycle(self, op: tuple[int, int, int, int]) -> None:
+        kind, _, replica, aux = op
+        if kind == ScenarioRecord.OP_CRASH:
+            self.alive[replica] = False
+        elif kind == ScenarioRecord.OP_RESTORE:
+            self._apply_restore(replica, aux)
+        else:  # OP_RESYNC
+            self.replicas[replica].handle(
+                ResetHeight(
+                    height=aux, signatories=tuple(self.signatories)
+                )
+            )
 
     def _settle(self, shared: "list | None" = None) -> None:
         """Drain every live replica's window, verify ALL windows in one
@@ -2071,6 +2434,18 @@ class Simulation:
         sim.queue.clear()
         sim._qhead = 0
         steps = 0
+        # Chaos records carry a lifecycle trailer (crash/restore/resync
+        # ops pinned to delivery positions). Replay re-derives each
+        # victim's checkpoint at its recorded commits — an identical
+        # delivery stream produces identical Process bytes — so the
+        # restore image never needs to be stored in the dump.
+        ops = record.lifecycle
+        optr = 0
+        sim._ckpt_capture = {
+            rep
+            for kind, _, rep, _ in ops
+            if kind == ScenarioRecord.OP_RESTORE
+        }
         if record.bursts:
             idx = 0
             for b in record.bursts:
@@ -2083,11 +2458,19 @@ class Simulation:
                 sim._qhead = 0
                 sim._settle()
         else:
-            for to, msg in record.messages:
+            for j, (to, msg) in enumerate(record.messages):
+                while optr < len(ops) and ops[optr][1] <= j:
+                    sim._replay_lifecycle(ops[optr])
+                    optr += 1
                 if not sim.alive[to]:
                     continue
                 sim.replicas[to].handle(msg)
+                if to in sim._ckpt_capture:
+                    sim._ckpt_store.save(to, sim.replicas[to].proc)
                 steps += 1
+            while optr < len(ops):
+                sim._replay_lifecycle(ops[optr])
+                optr += 1
         return SimulationResult(
             completed=sim._completed(),
             steps=steps,
@@ -2132,6 +2515,50 @@ class _PayloadValidator:
 
     def valid_propose(self, propose):
         return propose.payload == self._sim._bundle_for_value(propose.value)
+
+
+#: Laggard catch-up sweep cadence (delivery steps) and tolerated height
+#: lag. A height takes a few dozen deliveries, so 256 steps bounds how
+#: long a dropped-off replica free-falls; lag 2 tolerates the normal
+#: commit wavefront (replicas briefly straddle adjacent heights) while
+#: anything further behind has provably missed messages it will never
+#: see again.
+_CATCHUP_EVERY = 256
+_CATCHUP_LAG = 2
+
+
+class _ChaosEnvelope:
+    """Marks a delayed or duplicated delivery that already passed the
+    link-fault stage, so re-delivery applies partitions only (a delayed
+    frame must not be re-delayed or re-duplicated forever). Not a
+    Timeout, so a pending delayed delivery survives ``_prune_clock``."""
+
+    __slots__ = ("msg",)
+
+    def __init__(self, msg):
+        self.msg = msg
+
+
+class _PartitionRT:
+    """Runtime state for one scheduled :class:`~hyperdrive_tpu.chaos.plan.
+    Partition`: group membership resolved to a dict, plus the
+    engaged/healed latch (each partition fires exactly once)."""
+
+    __slots__ = ("spec", "engaged", "healed", "gid")
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.engaged = False
+        self.healed = False
+        self.gid: dict[int, int] = {}
+        for g, members in enumerate(spec.groups):
+            for m in members:
+                self.gid[m] = g
+
+    def blocks(self, a: int, b: int) -> bool:
+        # Replicas absent from every listed group share the implicit
+        # remainder group (-1).
+        return self.gid.get(a, -1) != self.gid.get(b, -1)
 
 
 class _OwnedClock:

@@ -315,3 +315,14 @@ class MessageQueue:
             if i:
                 del q[:i]
                 self._register_head(sender)
+
+    def clear(self) -> None:
+        """Forget every queued message — the crash-restart revive path
+        (Replica.restore): buffered messages are volatile state that
+        died with the process. The ``_order`` tie-break map is kept: it
+        is derived from the whitelist registration order at construction,
+        not from traffic, and a restored replica must keep draining in
+        the same deterministic order as the rest of the network."""
+        self._queues.clear()
+        self._heads.clear()
+        self._head_key.clear()

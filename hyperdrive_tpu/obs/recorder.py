@@ -67,6 +67,11 @@ EVENT_KINDS = frozenset(
         "wire.frame.malformed",
         "wire.frame.oversize",
         "wire.frame.shed",
+        "transport.peer.dropped",
+        "chaos.partition",
+        "chaos.heal",
+        "chaos.crash",
+        "chaos.restore",
     }
 )
 

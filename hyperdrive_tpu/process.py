@@ -598,6 +598,38 @@ class Process:
         self.scheduler = scheduler
         self.start_round(0)
 
+    def resume(self) -> None:
+        """Re-arm the current step's timeout after a crash-restore.
+
+        A restored Process re-enters consensus mid-round with whatever
+        its checkpoint held — locked/valid values, vote logs, once-flags
+        — but the timer it had armed died with the old process, and
+        without a deadline the replica could wait forever on a quorum
+        that already moved on. Re-arming is safe where re-running
+        ``start_round`` would not be: no message is broadcast (a re-sent
+        propose or vote after restore is exactly the double-send the
+        catcher flags as equivocation), and a duplicate timeout is
+        harmless — every on_timeout_* height/round/step-guards itself.
+        """
+        if self.timer is None:
+            return
+        h = self.state.current_height
+        r = self.state.current_round
+        step = self.state.current_step
+        obs = self.obs
+        if step == Step.PROPOSING:
+            self.timer.timeout_propose(h, r)
+            if obs is not NULL_BOUND:
+                obs.emit("timeout.propose.scheduled", h, r)
+        elif step == Step.PREVOTING:
+            self.timer.timeout_prevote(h, r)
+            if obs is not NULL_BOUND:
+                obs.emit("timeout.prevote.scheduled", h, r)
+        else:
+            self.timer.timeout_precommit(h, r)
+            if obs is not NULL_BOUND:
+                obs.emit("timeout.precommit.scheduled", h, r)
+
     def start_round(self, round: Round) -> None:
         """L11: begin a new round at the current height.
 

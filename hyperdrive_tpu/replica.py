@@ -331,6 +331,43 @@ class Replica:
         """Start the underlying Process (round 0 of the starting height)."""
         self.proc.start()
 
+    def restore(self, checkpoint: "bytes | None" = None) -> None:
+        """Crash-restart revive path: restore the Process from a
+        checkpoint envelope (utils/checkpoint.py) and reset every
+        volatile buffer — the sorted queue, the burst fast lane, and any
+        reentrant backlog died with the old process; only the checkpoint
+        survives a crash. The queue's per-sender tie-break order map is
+        kept (it derives from the signatory whitelist, not from traffic,
+        and must match the network's for deterministic drains).
+
+        ``checkpoint=None`` models a replica that crashed before its
+        first checkpoint: the Process restarts from the default state at
+        ``opts.starting_height`` (genesis recovery). Callers then rejoin
+        via ResetHeight (network moved on) or ``proc.resume()`` (same
+        height — re-arm the current step's timeout, broadcast nothing).
+        """
+        if checkpoint is not None:
+            from hyperdrive_tpu.utils.checkpoint import restore_bytes
+
+            restore_bytes(self.proc, checkpoint)
+        else:
+            self.proc.state = State.default_with_height(
+                self.opts.starting_height
+            )
+        self.mq.clear()
+        self._lane.clear()
+        self._lane_counts.clear()
+        self._pending.clear()
+        self._last_commit_time = None
+        self.logger.info(
+            "restored %s",
+            _kv(
+                height=self.proc.current_height,
+                round=self.proc.current_round,
+                from_checkpoint=checkpoint is not None,
+            ),
+        )
+
     def handle(self, msg) -> None:
         """Synchronously handle one input message, then flush the queue.
 

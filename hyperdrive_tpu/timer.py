@@ -20,6 +20,7 @@ Two implementations are provided:
 
 from __future__ import annotations
 
+import random
 import threading
 from typing import Callable, Optional
 
@@ -36,6 +37,42 @@ DEFAULT_TIMEOUT_SCALING = 0.5
 TimeoutHandler = Callable[[Timeout], None]
 
 
+def _shaped_duration(
+    timeout: float,
+    scaling: float,
+    round: Round,
+    max_timeout: "float | None",
+    jitter: float,
+    rng: "random.Random | None",
+) -> float:
+    """The shared duration policy behind both timer implementations.
+
+    Base law: ``timeout * (1 + round * scaling)`` (reference:
+    timer/timer.go:120-122). Two optional shapers, both OFF by default
+    so existing deployments and every recorded sim trajectory are
+    untouched:
+
+    - ``max_timeout`` caps the linear growth — unbounded, a long stall
+      (a partition lasting many rounds) leaves replicas waiting
+      arbitrarily long after conditions recover.
+    - ``jitter`` stretches each duration by a uniform factor in
+      ``[1, 1 + jitter)`` — identical deterministic timeouts expire in
+      lockstep across replicas, synchronizing their round changes and
+      re-proposals into colliding bursts; per-replica jitter (pass each
+      replica its own seeded ``rng``) desynchronizes them.
+
+    The cap applies BEFORE jitter, so the effective ceiling is
+    ``max_timeout * (1 + jitter)`` and jitter keeps working (stays
+    non-lockstep) even for capped rounds.
+    """
+    d = timeout + timeout * round * scaling
+    if max_timeout is not None and d > max_timeout:
+        d = max_timeout
+    if jitter:
+        d += d * jitter * (rng or random).random()
+    return d
+
+
 class LinearTimer:
     """Wall-clock timer: spawns a daemon thread per scheduled timeout."""
 
@@ -46,17 +83,31 @@ class LinearTimer:
         handle_timeout_precommit: Optional[TimeoutHandler] = None,
         timeout: float = DEFAULT_TIMEOUT,
         timeout_scaling: float = DEFAULT_TIMEOUT_SCALING,
+        max_timeout: "float | None" = None,
+        jitter: float = 0.0,
+        rng: "random.Random | None" = None,
     ):
         self._handle_propose = handle_timeout_propose
         self._handle_prevote = handle_timeout_prevote
         self._handle_precommit = handle_timeout_precommit
         self.timeout = timeout
         self.timeout_scaling = timeout_scaling
+        self.max_timeout = max_timeout
+        self.jitter = jitter
+        self._rng = rng
 
     def duration_at(self, height: Height, round: Round) -> float:
         """Timeout duration for a (height, round)
-        (reference: timer/timer.go:120-122)."""
-        return self.timeout + self.timeout * round * self.timeout_scaling
+        (reference: timer/timer.go:120-122), optionally capped and
+        jittered — see :func:`_shaped_duration`."""
+        return _shaped_duration(
+            self.timeout,
+            self.timeout_scaling,
+            round,
+            self.max_timeout,
+            self.jitter,
+            self._rng,
+        )
 
     def _spawn(self, handler: TimeoutHandler, ty: MessageType, h: Height, r: Round):
         t = threading.Timer(
@@ -95,14 +146,30 @@ class VirtualTimer:
         handler: Optional[TimeoutHandler] = None,
         timeout: float = 1.0,
         timeout_scaling: float = DEFAULT_TIMEOUT_SCALING,
+        max_timeout: "float | None" = None,
+        jitter: float = 0.0,
+        rng: "random.Random | None" = None,
     ):
         self._clock = clock
         self._handler = handler
         self.timeout = timeout
         self.timeout_scaling = timeout_scaling
+        self.max_timeout = max_timeout
+        self.jitter = jitter
+        #: Jittered virtual timers MUST get a seeded per-replica rng or
+        #: the harness's determinism (record/replay, fixed-seed digests)
+        #: breaks; the harness owns that wiring.
+        self._rng = rng
 
     def duration_at(self, height: Height, round: Round) -> float:
-        return self.timeout + self.timeout * round * self.timeout_scaling
+        return _shaped_duration(
+            self.timeout,
+            self.timeout_scaling,
+            round,
+            self.max_timeout,
+            self.jitter,
+            self._rng,
+        )
 
     def _schedule(self, ty: MessageType, h: Height, r: Round) -> None:
         self._clock.schedule(

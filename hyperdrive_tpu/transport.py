@@ -37,6 +37,7 @@ from hyperdrive_tpu.messages import (
     marshal_message,
     unmarshal_message,
 )
+from hyperdrive_tpu.utils.log import get_logger, kv as _kv
 
 __all__ = [
     "TcpBroadcaster",
@@ -99,6 +100,14 @@ class TcpNode:
         #: thread per peer — a dead or slow peer can never stall the
         #: broadcasting replica threads or the other peers.
         self._peer_queues: dict[tuple[str, int], queue.Queue] = {}
+        #: peer key -> frames shed from that peer's backlog (``_PEER_QUEUE``
+        #: overflow). Best-effort delivery makes shedding legitimate, but a
+        #: silently starving peer is an operational blind spot: the count is
+        #: inspectable here, exported via obs (``transport.peer.dropped``,
+        #: detail = running count), and the FIRST drop per peer logs at
+        #: WARNING. Guarded by ``_lock`` (any replica thread may broadcast).
+        self.dropped_frames: dict[tuple[str, int], int] = {}
+        self._log = get_logger("hyperdrive_tpu.transport")
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._accepted: list[socket.socket] = []
@@ -262,7 +271,7 @@ class TcpNode:
         drops the oldest frame — see _PEER_QUEUE)."""
         self._deliver(msg)
         frame = encode_frame(msg)
-        for q in self._peer_queues.values():
+        for key, q in self._peer_queues.items():
             while True:
                 try:
                     q.put_nowait(frame)
@@ -270,10 +279,22 @@ class TcpNode:
                 except queue.Full:
                     try:
                         q.get_nowait()  # shed the oldest frame
-                        if self.obs is not self._obs_null:
-                            self.obs.emit("wire.frame.shed", -1, -1)
                     except queue.Empty:
-                        pass
+                        continue
+                    with self._lock:
+                        count = self.dropped_frames.get(key, 0) + 1
+                        self.dropped_frames[key] = count
+                    if count == 1:
+                        self._log.warning(
+                            "peer backlog overflow %s",
+                            _kv(peer=f"{key[0]}:{key[1]}",
+                                capacity=_PEER_QUEUE),
+                        )
+                    if self.obs is not self._obs_null:
+                        self.obs.emit("wire.frame.shed", -1, -1)
+                        self.obs.emit(
+                            "transport.peer.dropped", -1, -1, count
+                        )
 
 
 class FlightRecorder:

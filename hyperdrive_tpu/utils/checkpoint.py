@@ -18,7 +18,13 @@ import zlib
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.process import Process
 
-__all__ = ["save_process", "restore_process", "checkpoint_bytes", "restore_bytes"]
+__all__ = [
+    "save_process",
+    "restore_process",
+    "checkpoint_bytes",
+    "restore_bytes",
+    "CheckpointStore",
+]
 
 _MAGIC = 0x48594350  # "HYCP"
 _VERSION = 1
@@ -82,3 +88,47 @@ def restore_process(proc: Process, path: str) -> None:
     """Restore ``proc`` in place from a checkpoint file."""
     with open(path, "rb") as fh:
         restore_bytes(proc, fh.read())
+
+
+class CheckpointStore:
+    """Latest-checkpoint-per-key store over the same self-validating
+    envelope the file layer writes.
+
+    The chaos engine's stand-in for each replica's checkpoint file:
+    :meth:`save` snapshots a Process after every handled delivery (the
+    reference's "save after every method call" contract), :meth:`latest`
+    / :meth:`restore` hand the newest envelope back on crash-restart, and
+    :meth:`dump` writes each entry to ``<dir>/replica_<key>.ckpt`` for
+    post-mortem inspection alongside a ScenarioRecord dump.
+    """
+
+    def __init__(self) -> None:
+        self._latest: dict[object, bytes] = {}
+
+    def save(self, key, proc: Process) -> None:
+        self._latest[key] = checkpoint_bytes(proc)
+
+    def latest(self, key) -> "bytes | None":
+        return self._latest.get(key)
+
+    def restore(self, key, proc: Process) -> bool:
+        """Restore ``proc`` from the newest checkpoint under ``key``;
+        returns False (proc untouched) when none was ever saved."""
+        data = self._latest.get(key)
+        if data is None:
+            return False
+        restore_bytes(proc, data)
+        return True
+
+    def __len__(self) -> int:
+        return len(self._latest)
+
+    def dump(self, dirpath: str) -> list[str]:
+        os.makedirs(dirpath, exist_ok=True)
+        paths = []
+        for key in sorted(self._latest, key=str):
+            path = os.path.join(dirpath, f"replica_{key}.ckpt")
+            with open(path, "wb") as fh:
+                fh.write(self._latest[key])
+            paths.append(path)
+        return paths

@@ -1,0 +1,455 @@
+"""Chaos engine: partitions, crash-restart recovery, invariant monitor.
+
+The deterministic side (FaultPlan interpreted by the Simulation, with
+lifecycle-op record/replay) and the real-socket side (ChaosProxy in
+front of TcpNode) of hyperdrive_tpu/chaos — plus the ISSUE acceptance
+scenario: partition f replicas, crash one and restore it from its
+checkpoint mid-run, heal, and watch every honest replica commit the
+same values within bounded rounds, asserted by the InvariantMonitor,
+with the dump replaying message-for-message.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from hyperdrive_tpu.chaos import (
+    ChaosProxy,
+    CrashRestart,
+    FaultPlan,
+    InvariantMonitor,
+    InvariantViolation,
+    LinkFault,
+    Partition,
+)
+from hyperdrive_tpu.harness.sim import ScenarioRecord, Simulation
+
+# ----------------------------------------------------------- acceptance
+
+
+def _chaos_sim(plan, n=7, target=10, seed=2024, **kw):
+    kw.setdefault("timeout", 1.0)
+    kw.setdefault("delivery_cost", 1e-3)
+    kw.setdefault("observe", True)
+    return Simulation(
+        n=n, target_height=target, seed=seed, chaos=plan, **kw
+    )
+
+
+def test_partition_crash_restore_heal_commits_everywhere(tmp_path):
+    # The ISSUE acceptance scenario: isolate f=2 replicas, crash one of
+    # them mid-run, restore it from its checkpoint while still cut off,
+    # heal — every honest replica commits the same value at every
+    # overlapping height, within the monitor's round bound, and the
+    # dumped record replays deterministically.
+    plan = FaultPlan(
+        partitions=(Partition(at=0.3, heal=2.5, groups=((5, 6),)),),
+        crashes=(
+            CrashRestart(
+                replica=6, crash_at_step=420, restart_after_steps=300
+            ),
+        ),
+        links=(
+            LinkFault(
+                src=0, dst=3, drop=0.05, duplicate=0.05, delay=0.1,
+                delay_min=0.01, delay_max=0.1,
+            ),
+        ),
+    )
+    sim = _chaos_sim(plan)
+    monitor = InvariantMonitor(sim)
+    result = sim.run(max_steps=500_000)
+
+    assert result.completed
+    monitor.check_final(result)  # safety + digest + journal + liveness
+    # The scenario actually happened: a crash, a checkpoint restore,
+    # and a heal, all observable through the monitor's lifecycle log.
+    assert monitor.crashes and monitor.restores and monitor.heals
+    assert [v for v, _ in monitor.crashes] == [6]
+    # Post-heal commits landed within the round bound.
+    assert monitor.commit_rounds_after_heal
+    assert max(monitor.commit_rounds_after_heal) <= 12
+    # Commit-digest equality on every overlapping height, network-wide.
+    for i in range(sim.n):
+        for h, v in result.commits[i].items():
+            assert monitor.chain[h] == v
+    # A 2f+1 quorum committed the target height itself.
+    at_target = [
+        i for i in range(sim.n)
+        if result.commits[i].get(sim.target_height) is not None
+    ]
+    assert len(at_target) >= 2 * sim.f + 1
+
+    # The chaos lifecycle rode the record: dump -> load -> replay
+    # reproduces the live run's commits byte-for-byte.
+    path = str(tmp_path / "acceptance.bin")
+    sim.record.dump(path)
+    loaded = ScenarioRecord.load(path)
+    assert loaded.lifecycle == sim.record.lifecycle
+    kinds = {k for k, _, _, _ in loaded.lifecycle}
+    assert ScenarioRecord.OP_CRASH in kinds
+    assert ScenarioRecord.OP_RESTORE in kinds
+    replayed = Simulation.replay(loaded)
+    assert replayed.commits == result.commits
+
+
+def test_chaos_run_emits_lifecycle_events():
+    plan = FaultPlan(
+        partitions=(Partition(at=0.2, heal=1.8, groups=((3,),)),),
+        crashes=(
+            CrashRestart(
+                replica=3, crash_at_step=150, restart_after_steps=200
+            ),
+        ),
+    )
+    sim = _chaos_sim(plan, n=4, target=6, seed=11)
+    InvariantMonitor(sim)
+    result = sim.run(max_steps=200_000)
+    assert result.completed
+    kinds = {ev.kind for ev in sim.obs.snapshot()}
+    assert {
+        "chaos.partition", "chaos.heal", "chaos.crash", "chaos.restore"
+    } <= kinds
+
+
+def test_same_plan_same_seed_is_bit_deterministic():
+    plan = FaultPlan(
+        links=(
+            LinkFault(src=0, dst=2, drop=0.1, duplicate=0.1),
+            LinkFault(src=3, dst=1, delay=0.2, delay_min=0.01,
+                      delay_max=0.05),
+        ),
+        partitions=(Partition(at=0.4, heal=1.6, groups=((2,),)),),
+    )
+    runs = []
+    for _ in range(2):
+        sim = _chaos_sim(plan, n=4, target=6, seed=99, observe=False)
+        res = sim.run(max_steps=200_000)
+        res.assert_safety()
+        runs.append((res.commits, res.steps, res.commit_digest()))
+    assert runs[0] == runs[1]
+
+
+def test_crash_before_any_checkpoint_restarts_from_genesis():
+    # A victim crashed on the very first delivery has no checkpoint;
+    # restore falls back to the default genesis state and the replica
+    # still rejoins and the network completes.
+    plan = FaultPlan(
+        crashes=(
+            CrashRestart(
+                replica=2, crash_at_step=1, restart_after_steps=120
+            ),
+        ),
+    )
+    sim = _chaos_sim(plan, n=4, target=5, seed=5)
+    monitor = InvariantMonitor(sim)
+    result = sim.run(max_steps=200_000)
+    assert result.completed
+    monitor.check_final(result)
+    assert monitor.restores
+
+
+def test_seeded_plans_are_reproducible_and_valid():
+    for seed in range(0, 40):
+        for n in (4, 7):
+            a = FaultPlan.seeded(seed, n)
+            b = FaultPlan.seeded(seed, n)
+            assert a == b
+            a.validate(n)  # seeded() already validates; must not raise
+
+
+# ------------------------------------------------------------ plan DSL
+
+
+@pytest.mark.parametrize(
+    "plan",
+    [
+        FaultPlan(links=(LinkFault(src=0, dst=9),)),
+        FaultPlan(links=(LinkFault(src=0, dst=1, drop=1.5),)),
+        FaultPlan(
+            links=(LinkFault(src=0, dst=1, delay_min=0.5, delay_max=0.1),)
+        ),
+        FaultPlan(partitions=(Partition(at=2.0, heal=1.0, groups=()),)),
+        FaultPlan(partitions=(Partition(at=0.0, heal=1.0, groups=((9,),)),)),
+        FaultPlan(
+            partitions=(Partition(at=0.0, heal=1.0, groups=((1,), (1, 2))),)
+        ),
+        FaultPlan(
+            crashes=(
+                CrashRestart(replica=0, crash_at_step=5),
+                CrashRestart(replica=0, crash_at_step=9),
+            )
+        ),
+        FaultPlan(crashes=(CrashRestart(replica=1, crash_at_step=0),)),
+        FaultPlan(
+            crashes=(
+                CrashRestart(
+                    replica=1, crash_at_step=5, restart_after_steps=0
+                ),
+            )
+        ),
+    ],
+)
+def test_faultplan_validate_rejects(plan):
+    with pytest.raises(ValueError):
+        plan.validate(4)
+
+
+def test_chaos_requires_lockstep_mode():
+    with pytest.raises(ValueError, match="lock-step"):
+        Simulation(
+            n=4, target_height=3, seed=1, burst=True, chaos=FaultPlan()
+        )
+
+
+def test_partitions_require_delivery_pacing():
+    plan = FaultPlan(
+        partitions=(Partition(at=0.1, heal=1.0, groups=((0,),)),)
+    )
+    with pytest.raises(ValueError, match="delivery_cost"):
+        Simulation(n=4, target_height=3, seed=1, chaos=plan)
+
+
+# ------------------------------------------------------------- monitor
+
+
+def test_monitor_raises_on_fork():
+    sim = _chaos_sim(FaultPlan(), n=4, target=3, seed=1, observe=False)
+    monitor = InvariantMonitor(sim)
+    monitor._commit(0, 1, b"\xaa" * 32)
+    with pytest.raises(InvariantViolation, match="fork") as ei:
+        monitor._commit(1, 1, b"\xbb" * 32)
+    assert ei.value.kind == "fork"
+    # Agreement on the same value is never a fork.
+    monitor._commit(2, 1, b"\xaa" * 32)
+
+
+def test_monitor_enforces_round_bound_after_heal():
+    sim = _chaos_sim(FaultPlan(), n=4, target=3, seed=1, observe=False)
+    monitor = InvariantMonitor(sim, max_rounds_after_heal=0)
+    monitor.note_heal(0.5)
+    assert monitor._await_heal_commit == {0, 1, 2, 3}
+    with pytest.raises(InvariantViolation, match="liveness"):
+        monitor._commit(0, 1, b"\xcc" * 32)
+
+
+def test_monitor_flags_stalled_run():
+    # 2f replicas dead from the start: the network can never commit,
+    # and check_final must say so instead of passing vacuously.
+    sim = Simulation(
+        n=4, target_height=3, seed=3, offline={2, 3}, chaos=FaultPlan()
+    )
+    monitor = InvariantMonitor(sim)
+    result = sim.run(max_steps=20_000)
+    assert not result.completed
+    with pytest.raises(InvariantViolation, match="liveness"):
+        monitor.check_final(result)
+
+
+# ------------------------------------------------------ record trailer
+
+
+def test_lifecycle_trailer_roundtrips(tmp_path):
+    rec = ScenarioRecord(seed=7, n=4, f=1, target_height=5)
+    rec.signatories = [bytes([i]) * 32 for i in range(4)]
+    rec.lifecycle = [
+        (ScenarioRecord.OP_CRASH, 10, 2, 0),
+        (ScenarioRecord.OP_RESTORE, 40, 2, 3),
+        (ScenarioRecord.OP_RESYNC, 55, 1, 4),
+    ]
+    path = str(tmp_path / "trailer.bin")
+    rec.dump(path)
+    loaded = ScenarioRecord.load(path)
+    assert loaded.lifecycle == rec.lifecycle
+    assert loaded.signatories == rec.signatories
+
+
+# ----------------------------------------------------------- soak CLI
+
+
+def test_soak_cli_passes_and_replays(tmp_path, capsys):
+    from hyperdrive_tpu.chaos.__main__ import main
+
+    rc = main([
+        "soak", "--scenarios", "2", "--seed", "7", "--n", "4",
+        "--target", "5", "--replay-every", "1",
+        "--out", str(tmp_path / "failures"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "soak ok: 2 scenarios" in out
+    assert not (tmp_path / "failures").exists()
+
+
+def test_replay_cli_reproduces_dump(tmp_path, capsys):
+    from hyperdrive_tpu.chaos.__main__ import main
+
+    plan = FaultPlan.seeded(3, 4)
+    sim = _chaos_sim(plan, n=4, target=5, seed=3, observe=False)
+    result = sim.run(max_steps=200_000)
+    assert result.completed
+    path = str(tmp_path / "scenario.bin")
+    sim.record.dump(path)
+    rc = main(["replay", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "completed=True" in out
+
+
+# -------------------------------------------------------- chaos proxy
+
+
+def _signed_prevote(idx=0, height=1):
+    from hyperdrive_tpu.crypto.keys import KeyRing
+    from hyperdrive_tpu.messages import Prevote
+
+    ring = KeyRing.deterministic(max(idx + 1, 1), namespace=b"chaosprox")
+    return ring[idx].sign_message(
+        Prevote(
+            height=height, round=0, value=b"\x07" * 32,
+            sender=ring[idx].public,
+        )
+    )
+
+
+def _sink_node():
+    from hyperdrive_tpu.transport import TcpNode
+
+    received = []
+
+    class _Sink:
+        def propose(self, m, stop=None):
+            received.append(m)
+
+        prevote = precommit = timeout = propose
+
+    node = TcpNode()
+    node.add_replica(_Sink())
+    node.start()
+    return node, received
+
+
+def _await(predicate, deadline_s=5.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_proxy_forwards_then_blackholes_then_heals():
+    from hyperdrive_tpu.transport import encode_frame
+
+    node, received = _sink_node()
+    proxy = ChaosProxy("127.0.0.1", node.port).start()
+    try:
+        pv = _signed_prevote()
+        with socket.create_connection(("127.0.0.1", proxy.port)) as s:
+            s.sendall(encode_frame(pv))
+            assert _await(lambda: len(received) == 1)
+
+            proxy.partition()
+            s.sendall(encode_frame(pv))
+            assert _await(lambda: proxy.dropped == 1)
+            assert len(received) == 1  # black-holed, connection alive
+
+            proxy.heal()
+            s.sendall(encode_frame(pv))
+            assert _await(lambda: len(received) == 2)
+        assert proxy.forwarded == 2
+    finally:
+        proxy.stop()
+        node.stop()
+
+
+def test_proxy_drop_all_counts_every_frame():
+    from hyperdrive_tpu.transport import encode_frame
+
+    node, received = _sink_node()
+    proxy = ChaosProxy("127.0.0.1", node.port, drop=1.0, seed=4).start()
+    try:
+        pv = _signed_prevote()
+        with socket.create_connection(("127.0.0.1", proxy.port)) as s:
+            for _ in range(5):
+                s.sendall(encode_frame(pv))
+            assert _await(lambda: proxy.dropped == 5)
+        assert proxy.forwarded == 0
+        assert received == []
+    finally:
+        proxy.stop()
+        node.stop()
+
+
+def test_proxy_duplicate_delivers_twice():
+    from hyperdrive_tpu.transport import encode_frame
+
+    node, received = _sink_node()
+    proxy = ChaosProxy(
+        "127.0.0.1", node.port, duplicate=1.0, seed=4
+    ).start()
+    try:
+        pv = _signed_prevote()
+        with socket.create_connection(("127.0.0.1", proxy.port)) as s:
+            s.sendall(encode_frame(pv))
+            assert _await(lambda: len(received) == 2)
+        assert proxy.forwarded == 2
+    finally:
+        proxy.stop()
+        node.stop()
+
+
+def test_transparent_proxy_consensus_smoke():
+    # Four single-replica nodes over real sockets, with every inbound
+    # frame to node 3 routed through a faultless ChaosProxy: the proxy
+    # is transparent to consensus, and all four commit the same chain.
+    import os
+    import sys
+
+    from hyperdrive_tpu.crypto.keys import KeyRing
+    from hyperdrive_tpu.transport import TcpNode
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from transport_worker import commits_digest, run_local_replicas
+
+    ring = KeyRing.deterministic(4, namespace=b"tcp-demo")
+    nodes = [TcpNode() for _ in range(4)]
+    proxy = ChaosProxy("127.0.0.1", nodes[3].port).start()
+    ports = [n.port for n in nodes[:3]] + [proxy.port]
+    try:
+        for a in range(4):
+            for b in range(4):
+                if a != b:
+                    nodes[a].add_peer("127.0.0.1", ports[b])
+
+        target = 5
+        results = [None] * 4
+        errors = []
+
+        def drive(i):
+            try:
+                results[i] = run_local_replicas(
+                    nodes[i], ring, (i,), target, deadline_s=90.0
+                )
+            except Exception as e:  # pragma: no cover - failure report
+                errors.append((i, e))
+
+        drivers = [
+            threading.Thread(target=drive, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in drivers:
+            t.start()
+        for t in drivers:
+            t.join(timeout=120.0)
+        assert not errors, errors
+        assert all(r is not None for r in results)
+        digests = [commits_digest(r) for r in results]
+        assert len(set(digests)) == 1, "chains diverged through proxy"
+        assert proxy.forwarded > 0
+    finally:
+        proxy.stop()
+        for n in nodes:
+            n.stop()

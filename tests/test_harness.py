@@ -9,7 +9,11 @@ import os
 
 import pytest
 
-from hyperdrive_tpu.harness import ScenarioRecord, Simulation
+from hyperdrive_tpu.harness import (
+    ScenarioRecord,
+    Simulation,
+    VirtualClock,
+)
 
 
 def test_honest_network_reaches_target_height():
@@ -972,3 +976,57 @@ def test_record_false_runs_without_recorder():
     b_on, b_off = bon.run(), boff.run()
     assert b_off.commits == b_on.commits
     assert b_off.record is None and not boff.record.bursts
+
+
+# ----------------------------------------------------------------- clock
+
+
+class TestVirtualClockPrune:
+    # Edge cases of the heap pruning the driver leans on during long
+    # runs (ISSUE 5 satellite). Events here are plain strings; prune's
+    # predicate sees the event, never the deadline.
+
+    def test_prune_empty_heap_is_a_noop(self):
+        clock = VirtualClock()
+        assert clock.prune(lambda e: True) == 0
+        assert clock.prune(lambda e: False) == 0
+        assert clock.pending() == 0 and clock.now == 0.0
+
+    def test_prune_keep_all_drops_nothing(self):
+        clock = VirtualClock()
+        for delay, name in [(1.0, "a"), (2.0, "b"), (3.0, "c")]:
+            clock.schedule(delay, name, None)
+        assert clock.prune(lambda e: True) == 0
+        assert clock.pending() == 3
+        event, _ = clock.fire_next()
+        assert event == "a" and clock.now == 1.0
+
+    def test_partial_prune_preserves_heap_order(self):
+        clock = VirtualClock()
+        for delay, name in [(1.0, "a"), (2.0, "b"), (3.0, "c")]:
+            clock.schedule(delay, name, None)
+        event, _ = clock.fire_next()
+        assert event == "a"
+        assert clock.prune(lambda e: e != "b") == 1
+        assert clock.pending() == 1
+        event, _ = clock.fire_next()
+        assert event == "c" and clock.now == 3.0
+
+    def test_prune_everything_empties_the_heap(self):
+        clock = VirtualClock()
+        for i in range(17):
+            clock.schedule(float(i + 1), f"ev{i}", None)
+        assert clock.prune(lambda e: False) == 17
+        assert clock.pending() == 0
+        # The clock stays usable: schedule after a full prune works and
+        # deadlines are still relative to the unchanged `now`.
+        clock.schedule(0.5, "fresh", None)
+        event, _ = clock.fire_next()
+        assert event == "fresh" and clock.now == 0.5
+
+    def test_fire_never_moves_time_backwards(self):
+        clock = VirtualClock()
+        clock.schedule(1.0, "late", None)
+        clock.now = 5.0  # delivery pacing overtook the deadline
+        event, _ = clock.fire_next()
+        assert event == "late" and clock.now == 5.0

@@ -3,6 +3,7 @@
 Mirrors timer/timer_test.go (scaled down: millisecond timeouts).
 """
 
+import random
 import threading
 import time
 
@@ -137,3 +138,88 @@ class TestRealClockFiring:
             t.timeout_precommit(1, r)
         time.sleep(0.3)
         assert sorted(e.round for e in fired) == list(range(8))
+
+
+class TestTimeoutShaping:
+    # The optional max-cap and jitter shapers (ISSUE 5 satellite). Both
+    # default OFF: the bare linear law must be bit-identical to before.
+
+    def test_defaults_reproduce_linear_law_exactly(self):
+        t = LinearTimer(timeout=2.0, timeout_scaling=0.5)
+        assert t.max_timeout is None and t.jitter == 0.0
+        for r in range(64):
+            assert t.duration_at(1, r) == 2.0 * (1 + 0.5 * r)
+
+    def test_max_timeout_caps_linear_growth(self):
+        t = LinearTimer(timeout=2.0, timeout_scaling=0.5, max_timeout=5.0)
+        # d = 2 + r: rounds 0..3 are under the cap and untouched...
+        assert t.duration_at(1, 0) == 2.0
+        assert t.duration_at(1, 3) == 5.0  # == cap, NOT capped
+        # ...every later round clamps to the cap instead of growing.
+        for r in range(4, 40):
+            assert t.duration_at(1, r) == 5.0
+
+    def test_jitter_stays_in_band(self):
+        rng = random.Random(99)
+        t = LinearTimer(
+            timeout=2.0, timeout_scaling=0.5, jitter=0.25, rng=rng
+        )
+        for r in range(50):
+            base = 2.0 + r
+            d = t.duration_at(1, r)
+            assert base <= d < base * 1.25
+
+    def test_seeded_jitter_is_deterministic(self):
+        mk = lambda: LinearTimer(
+            timeout=1.0,
+            timeout_scaling=0.5,
+            jitter=0.3,
+            rng=random.Random(4242),
+        )
+        a, b = mk(), mk()
+        seq_a = [a.duration_at(1, r) for r in range(20)]
+        seq_b = [b.duration_at(1, r) for r in range(20)]
+        assert seq_a == seq_b
+        # And jitter actually varies the durations (not a constant offset).
+        assert len({round(d - (1.0 + 0.5 * r), 9)
+                    for r, d in enumerate(seq_a)}) > 1
+
+    def test_cap_applies_before_jitter(self):
+        # A near-1.0 draw on a capped round must land in
+        # [cap, cap*(1+jitter)), not [uncapped, uncapped*(1+jitter)).
+        class TopRng:
+            def random(self):
+                return 0.999
+
+        t = LinearTimer(
+            timeout=2.0,
+            timeout_scaling=0.5,
+            max_timeout=5.0,
+            jitter=0.2,
+            rng=TopRng(),
+        )
+        d = t.duration_at(1, 20)  # uncapped law would give 12.0
+        assert 5.0 <= d < 6.0
+
+    def test_virtual_timer_honors_cap_and_jitter(self):
+        class FakeClock:
+            def __init__(self):
+                self.scheduled = []
+
+            def schedule(self, delay, event, handler):
+                self.scheduled.append((delay, event))
+
+        clock = FakeClock()
+        vt = VirtualTimer(
+            clock,
+            timeout=1.0,
+            timeout_scaling=1.0,
+            max_timeout=3.0,
+            jitter=0.5,
+            rng=random.Random(7),
+        )
+        vt.timeout_propose(1, 9)  # uncapped law: 10.0 -> capped 3.0
+        vt.timeout_prevote(1, 0)  # base 1.0, under the cap
+        (d1, e1), (d2, e2) = clock.scheduled
+        assert 3.0 <= d1 < 4.5 and e1.message_type == MessageType.PROPOSE
+        assert 1.0 <= d2 < 1.5 and e2.message_type == MessageType.PREVOTE

@@ -380,3 +380,48 @@ def test_writer_frame_is_parseable_by_reader():
     w = Writer()
     marshal_message(pv, w)
     assert encode_frame(pv)[4:] == w.data()
+
+
+def test_peer_backlog_overflow_counts_drops(caplog):
+    # ISSUE 5 satellite: _PEER_QUEUE overflow sheds the oldest frame and
+    # must be observable — per-peer counter, obs event, and a WARNING on
+    # the FIRST drop only. The node is never start()ed, so no sender
+    # thread drains the queue and the overflow is deterministic.
+    import logging
+
+    from hyperdrive_tpu.obs.recorder import Recorder
+    from hyperdrive_tpu.transport import _PEER_QUEUE
+
+    rec = Recorder(threadsafe=True)
+    node = TcpNode(obs=rec.scoped(-1))
+    (dead_port,) = _free_ports(1)
+    try:
+        node.add_peer("127.0.0.1", dead_port)
+        pv = Prevote(
+            height=1, round=0, value=b"\x05" * 32, sender=b"\x01" * 32
+        )
+        with caplog.at_level(
+            logging.WARNING, logger="hyperdrive_tpu.transport"
+        ):
+            for _ in range(_PEER_QUEUE + 3):
+                node.broadcast(pv)
+        key = ("127.0.0.1", dead_port)
+        assert node.dropped_frames == {key: 3}
+        kinds = [e.kind for e in rec.snapshot()]
+        assert kinds.count("transport.peer.dropped") == 3
+        # Running count rides the event detail.
+        details = [
+            e.detail
+            for e in rec.snapshot()
+            if e.kind == "transport.peer.dropped"
+        ]
+        assert details == [1, 2, 3]
+        overflow_logs = [
+            r
+            for r in caplog.records
+            if "peer backlog overflow" in r.getMessage()
+        ]
+        assert len(overflow_logs) == 1  # first drop only
+        assert f"127.0.0.1:{dead_port}" in overflow_logs[0].getMessage()
+    finally:
+        node.stop()

@@ -270,3 +270,131 @@ def test_checkpoint_semantic_corruption_leaves_proc_untouched():
     assert target.whoami == b"\x11" * 32
     assert target.f == 9
     assert target.state == before_state
+
+
+def test_checkpoint_store_roundtrip(tmp_path):
+    from hyperdrive_tpu.utils.checkpoint import CheckpointStore
+
+    store = CheckpointStore()
+    assert len(store) == 0
+    assert store.latest(0) is None
+    target = _make_proc(11)
+    before = target.state.clone()
+    assert store.restore(0, target) is False
+    assert target.state == before  # untouched on a miss
+
+    a, b = _make_proc(12), _make_proc(13)
+    store.save(0, a)
+    store.save(0, b)  # latest-wins per key
+    assert len(store) == 1
+    restored = Process(whoami=b"\x00" * 32, f=0)
+    assert store.restore(0, restored) is True
+    assert restored.state == b.state and restored.whoami == b.whoami
+
+    paths = store.dump(os.path.join(tmp_path, "ckpts"))
+    assert [os.path.basename(p) for p in paths] == ["replica_0.ckpt"]
+    from_file = Process(whoami=b"\x00" * 32, f=0)
+    restore_process(from_file, paths[0])
+    assert from_file.state == b.state
+
+
+def test_restore_mid_round_locked_value_no_equivocation():
+    """Crash-restore a Process that LOCKED a value mid-round (ISSUE 5
+    satellite): the restored replica re-arms its precommit timeout
+    without re-broadcasting anything, and in the next round its
+    restored lock steers it to prevote NIL against a different
+    proposal (paper L28/L22 locking rules) — equivocation-free."""
+    from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+    from hyperdrive_tpu.scheduler import RoundRobin
+    from hyperdrive_tpu.types import NIL_VALUE, Step
+
+    sigs = [bytes([i + 1]) * 32 for i in range(4)]
+    me = sigs[0]
+    v_locked = b"\xaa" * 32
+
+    class CaptureTimer:
+        def __init__(self):
+            self.armed = []
+
+        def timeout_propose(self, h, r):
+            self.armed.append(("propose", h, r))
+
+        def timeout_prevote(self, h, r):
+            self.armed.append(("prevote", h, r))
+
+        def timeout_precommit(self, h, r):
+            self.armed.append(("precommit", h, r))
+
+    def build():
+        sent = []
+        timer = CaptureTimer()
+        proc = Process(
+            whoami=me,
+            f=1,
+            timer=timer,
+            scheduler=RoundRobin(sigs),
+            proposer=MockProposer(fn=lambda h, r: b"\xee" * 32),
+            validator=MockValidator(ok=True),
+            broadcaster=BroadcasterCallbacks(
+                on_propose=sent.append,
+                on_prevote=sent.append,
+                on_precommit=sent.append,
+            ),
+            committer=CommitterCallback(on_commit=lambda h, v: (0, None)),
+        )
+        return proc, sent, timer
+
+    proc, sent, _ = build()
+    proc.start()  # proposer of (1, 0) is sigs[1]; we arm timeout_propose
+    proc.propose(
+        Propose(
+            height=1,
+            round=0,
+            valid_round=-1,
+            value=v_locked,
+            sender=sigs[1],
+        )
+    )
+    for s in sigs[1:]:  # 2f+1 prevotes -> L36: lock v at round 0
+        proc.prevote(Prevote(height=1, round=0, value=v_locked, sender=s))
+    assert proc.state.locked_value == v_locked
+    assert proc.state.locked_round == 0
+    assert proc.state.current_step == Step.PRECOMMITTING
+    blob = checkpoint_bytes(proc)
+
+    # Crash: fresh wiring, restore, resume. No broadcast may happen —
+    # a re-sent round-0 vote is exactly the double-send the catcher
+    # would flag as equivocation.
+    proc2, sent2, timer2 = build()
+    restore_bytes(proc2, blob)
+    assert proc2.state.locked_value == v_locked
+    assert proc2.state.current_step == Step.PRECOMMITTING
+    proc2.resume()
+    assert sent2 == []
+    assert timer2.armed == [("precommit", 1, 0)]
+
+    # The quorum moved on: precommit timeout fires, round 1 starts
+    # (proposer sigs[2]), and a DIFFERENT value is proposed. The
+    # restored lock must answer with a NIL prevote (L22).
+    proc2.on_timeout_precommit(1, 0)
+    assert proc2.state.current_round == 1
+    proc2.propose(
+        Propose(
+            height=1,
+            round=1,
+            valid_round=-1,
+            value=b"\xcc" * 32,
+            sender=sigs[2],
+        )
+    )
+    nil_prevotes = [
+        m
+        for m in sent2
+        if isinstance(m, Prevote) and m.round == 1
+    ]
+    assert [m.value for m in nil_prevotes] == [NIL_VALUE]
+    # And nothing from round 0 was ever re-broadcast after restore.
+    assert not any(
+        isinstance(m, (Prevote, Precommit)) and m.round == 0 for m in sent2
+    )
+    assert not any(isinstance(m, Propose) for m in sent2)
