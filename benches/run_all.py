@@ -247,7 +247,7 @@ def _run_signed_burst_paired(ver, heights: int, seed: int, block: int = 20,
         m: {"wall": 0.0, "steps": 0, "verified": 0, "heights": 0,
             "completed": True, "tracer": _wall_tracer(),
             "sync_count": 0, "sync_p50s": [], "cascade_p50s": [],
-            "routed_count": 0}
+            "routed_count": 0, "block_walls": []}
         for m in modes
     }
     names = list(modes)
@@ -268,7 +268,9 @@ def _run_signed_burst_paired(ver, heights: int, seed: int, block: int = 20,
                 r.tracer = a["tracer"]
             t0 = time.perf_counter()
             res = sim.run(max_steps=max_steps)
-            a["wall"] += time.perf_counter() - t0
+            block_wall = time.perf_counter() - t0
+            a["wall"] += block_wall
+            a["block_walls"].append(block_wall)
             res.assert_safety()
             a["completed"] = a["completed"] and res.completed
             assert res.completed, f"mode {mode} stalled at {res.heights}"
@@ -302,6 +304,11 @@ def _run_signed_burst_paired(ver, heights: int, seed: int, block: int = 20,
             "steps": a["steps"],
             "wall_s": round(a["wall"], 2),
             "heights_per_s": round(a["heights"] / a["wall"], 3),
+            # Per-block rate median: the drift-robust figure the paired
+            # gates compare (one outlier block cannot move it).
+            "block_heights_per_s_p50": round(
+                block / float(np.median(a["block_walls"])), 3
+            ),
             "msgs_per_s": round(a["steps"] / a["wall"], 1),
             "signatures_verified": a["verified"],
             "votes_verified_per_s": round(a["verified"] / a["wall"], 1),
@@ -414,6 +421,42 @@ def config_4() -> dict:
     dedup, grid_run, routed_run, host_paired = (
         paired["dedup"], paired["tally"], paired["routed"], paired["host"]
     )
+
+    # Height pipelining (ROADMAP item 5): the SAME device-tally path,
+    # sequential vs pipelined through the async devsched queue —
+    # speculative settle, commits gated on the coalesced launch's
+    # future. Settle windows fill ~25% of a verify bucket, and a padded
+    # launch costs by BUCKET, not fill, so coalescing the settles of
+    # several heights into one launch pays the sync floor once per
+    # pipeline slot instead of once per settle. Paired 10-height blocks
+    # like the mode comparison above; the gate reads the per-block
+    # MEDIANS so one drifty block cannot manufacture (or mask) the 2x.
+    pipe_paired = _run_signed_burst_paired(
+        ver, heights=120, seed=1004, block=10,
+        modes={
+            "tally_seq": {"device_tally": True},
+            "tally_pipelined": {
+                "device_tally": True, "pipeline_heights": True,
+            },
+        },
+    )
+    pipe_seq, pipe_run = (
+        pipe_paired["tally_seq"], pipe_paired["tally_pipelined"]
+    )
+    pipe_speedup = round(
+        pipe_run["block_heights_per_s_p50"]
+        / pipe_seq["block_heights_per_s_p50"], 2
+    )
+    height_pipelining = {
+        "sequential": pipe_seq,
+        "pipelined": pipe_run,
+        "speedup_block_p50": pipe_speedup,
+        "speedup_aggregate": round(
+            pipe_run["heights_per_s"] / pipe_seq["heights_per_s"], 2
+        ),
+        "pipelined_2x_sequential": bool(pipe_speedup >= 2.0),
+    }
+
     redundant = _run_signed_burst(ver, heights=20, dedup=False, seed=1044)
 
     # (a'') the host-engine ceiling: the same signed 256-replica network
@@ -538,6 +581,7 @@ def config_4() -> dict:
         "device_tally_routed_run": routed_run,
         "host_paired_run": host_paired,
         "host_engine_run": host_engine,
+        "height_pipelining": height_pipelining,
         # The settle-pipeline verdict (VERDICT r3 #2): every fused settle
         # pays exactly ONE blocking device sync (mask + counts in one
         # transfer, fused_sync_p50_ms ~= device_sync_floor_ms), and the
@@ -1290,6 +1334,16 @@ def main():
                 r.setdefault(k, v)
         with open(path, "w") as fh:
             json.dump(r, fh, indent=1)
+        if i == 4 and "height_pipelining" in r:
+            # The pipelining comparison doubles as a standalone artifact
+            # (BENCH_r05.json's sibling): the paired sequential/pipelined
+            # medians plus provenance, committed at the repo root.
+            r06 = dict(r["height_pipelining"])
+            r06["device"] = r.get("device")
+            r06["rlc"] = r.get("rlc")
+            r06["measured_at"] = r["measured_at"]
+            with open(os.path.join(REPO, "BENCH_r06.json"), "w") as fh:
+                json.dump(r06, fh, indent=1)
         print(json.dumps(r))
     results = []
     for i in sorted(CONFIGS):
@@ -1308,6 +1362,28 @@ def write_bench_md(results):
         "host = single-core container, device = jax.devices()[0]. Each "
         "section records its own measured_at (sections persist in "
         "benches/results/ and merge across partial re-runs).",
+        "",
+        "All numbers are measured with `HD_SANITIZE` unset/`0`: the "
+        "consensus",
+        "sanitizer (ANALYSIS.md) recounts quorums from host logs on "
+        "every commit",
+        "and cross-checks device tallies, which is exactly the host "
+        "work the hot",
+        "path exists to avoid. The test suite turns it on; benchmarks "
+        "must not.",
+        "",
+        "Artifacts are metrics-carrying (OBSERVABILITY.md): each "
+        "sim-config row",
+        "in `benches/results/config_*.json` embeds the run's full",
+        "`tracer_snapshot` (counter/histogram registry) and, for "
+        "observed sims,",
+        "`commit_anatomy` — the per-phase commit-latency breakdown "
+        "from the",
+        "flight recorder — and `bench.py`'s single JSON line carries "
+        "the same",
+        "pair from a fixed-seed 4-replica host sim. Diff the "
+        "artifact, not a",
+        "re-run.",
         "",
     ]
     # Headline = MEDIAN of the checked-in artifact's trials, computed
@@ -1329,6 +1405,18 @@ def write_bench_md(results):
             if trials else
             f"256 validators: {r05['value'] / 1e3:.1f}k votes/s "
             "sustained (BENCH_r05.json)"
+        )
+    r06_path = os.path.join(REPO, "BENCH_r06.json")
+    if os.path.exists(r06_path):
+        with open(r06_path) as fh:
+            r06 = json.load(fh)
+        head.append(
+            "height pipelining: "
+            f"{r06['speedup_block_p50']}x device-tally heights/s "
+            f"({r06['sequential']['block_heights_per_s_p50']} -> "
+            f"{r06['pipelined']['block_heights_per_s_p50']} per-block "
+            f"p50, paired blocks on {r06.get('device', '?')}, "
+            "BENCH_r06.json)"
         )
     by_num = {}
     for r in results:

@@ -16,7 +16,13 @@ budget.
 
 from __future__ import annotations
 
-__all__ = ["hot_path", "device_fetch", "set_fetch_observer"]
+__all__ = [
+    "hot_path",
+    "async_scope",
+    "drain_point",
+    "device_fetch",
+    "set_fetch_observer",
+]
 
 #: Optional callback invoked with the ``why`` string on every
 #: device_fetch — the flight recorder's tap (obs/recorder.py). Module
@@ -44,6 +50,41 @@ def hot_path(fn=None):
         return hot_path
     try:
         fn.__hd_hot_path__ = True
+    except (AttributeError, TypeError):  # builtins / slotted callables
+        pass
+    return fn
+
+
+def async_scope(fn=None):
+    """Mark ``fn`` as devsched-managed async code for HD006.
+
+    Inside an async scope (this marker, or the path-scoped
+    ``devsched/`` package, or a ``# hdlint: scope=async`` pragma),
+    futures are the only allowed device-access idiom: a raw blocking
+    :func:`device_fetch` would re-serialize the pipeline the scope
+    exists to overlap, so HD006 flags it unless the enclosing function
+    is a declared :func:`drain_point`. Pure marker like
+    :func:`hot_path`: usable bare or called, zero call-time cost.
+    """
+    if fn is None:
+        return async_scope
+    try:
+        fn.__hd_async_scope__ = True
+    except (AttributeError, TypeError):  # builtins / slotted callables
+        pass
+    return fn
+
+
+def drain_point(fn=None):
+    """Mark ``fn`` as a devsched drain point: the ONE place an async
+    scope is allowed to block (resolve futures, materialize masks).
+    HD006 exempts the marked function's body — blocking is the point
+    of a drain, exactly as ``device_fetch`` is the point of a sync.
+    """
+    if fn is None:
+        return drain_point
+    try:
+        fn.__hd_drain_point__ = True
     except (AttributeError, TypeError):  # builtins / slotted callables
         pass
     return fn

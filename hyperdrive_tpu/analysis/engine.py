@@ -8,6 +8,8 @@ A *scope* names a slice of the repo a rule cares about:
 * ``digest`` — determinism feeding commit digests / wire bytes (HD003):
   ``codec.py``, ``process.py``, ``harness/sim.py``.
 * ``ops``    — device kernel dtype discipline (HD004): ``ops/``.
+* ``async``  — devsched future discipline (HD006): ``devsched/``;
+  elsewhere only functions marked ``@async_scope``.
 
 Scopes resolve from the file path; a file outside the path set can opt
 in with a pragma comment (used by the fixture corpus)::
@@ -46,7 +48,7 @@ SUPPRESS_RE = re.compile(
 )
 SCOPE_RE = re.compile(r"#\s*hdlint:\s*scope=(?P<scopes>[a-z]+(?:\s*,\s*[a-z]+)*)")
 
-VALID_SCOPES = frozenset({"hot", "digest", "ops"})
+VALID_SCOPES = frozenset({"hot", "digest", "ops", "async"})
 
 _HOT_SUFFIXES = ("/tallyflush.py", "/batch.py", "/harness/sim.py")
 _DIGEST_SUFFIXES = ("/codec.py", "/process.py", "/harness/sim.py")
@@ -129,6 +131,8 @@ class FileContext:
             scopes.add("digest")
         if in_ops:
             scopes.add("ops")
+        if "/devsched/" in p or p.startswith("devsched/"):
+            scopes.add("async")
         return scopes
 
     # --------------------------------------------------------- suppressions

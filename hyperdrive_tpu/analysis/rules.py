@@ -1,4 +1,4 @@
-"""hdlint rules HD001–HD005.
+"""hdlint rules HD001–HD006.
 
 Every rule is a heuristic tuned against THIS repo's idioms (see
 ANALYSIS.md for the catalog with examples). False positives are waived
@@ -14,7 +14,8 @@ import re
 from hyperdrive_tpu.analysis.engine import Finding
 
 __all__ = ["ALL_RULES", "default_rules", "HostSyncRule", "RetraceRule",
-           "NondetIterRule", "DtypeWidthRule", "MetricNameRule"]
+           "NondetIterRule", "DtypeWidthRule", "MetricNameRule",
+           "AsyncFetchRule"]
 
 _CASTS = frozenset({"int", "float", "bool"})
 _NP_CONVERTERS = frozenset(
@@ -721,10 +722,63 @@ class MetricNameRule:
         return "is not a static name"
 
 
+# ------------------------------------------------------------------- HD006
+
+class AsyncFetchRule:
+    """HD006: blocking device fetch inside a devsched async scope.
+
+    In async scope (``devsched/``, any ``@async_scope`` function, or a
+    ``# hdlint: scope=async`` pragma) the device is reached through
+    :class:`~hyperdrive_tpu.devsched.DeviceWorkQueue` futures — that is
+    the scope's whole contract. A raw ``device_fetch(...)`` there
+    re-serializes the pipeline the scope exists to overlap: it blocks
+    THIS submitter on a sync the queue would have amortized across
+    every pending command at the next drain. Flagged unless the
+    enclosing function is a declared ``@drain_point`` — blocking is the
+    point of a drain, exactly as ``device_fetch`` is the point of a
+    sync under HD001 (the two rules compose: HD001 funnels hot-path
+    syncs into ``device_fetch``; HD006 funnels async-scope fetches into
+    drain points).
+    """
+
+    code = "HD006"
+    name = "blocking-fetch-in-async-scope"
+    summary = "raw device_fetch inside a devsched async scope"
+
+    def check(self, ctx):
+        findings: list = []
+        parents = _parent_map(ctx.tree)
+        if "async" in ctx.scopes:
+            roots = [ctx.tree]
+        else:
+            roots = [
+                n for n in ast.walk(ctx.tree)
+                if isinstance(n, _FUNC_NODES)
+                and _has_decorator(n, {"async_scope"})
+            ]
+        seen: set = set()
+        for root in roots:
+            for n in ast.walk(root):
+                if not _is_device_fetch(n) or id(n) in seen:
+                    continue
+                seen.add(id(n))
+                fn = _enclosing_function(n, parents)
+                if fn is not None and _has_decorator(fn, {"drain_point"}):
+                    continue
+                findings.append(Finding(
+                    self.code, ctx.path, n.lineno,
+                    "blocking device_fetch inside a devsched async scope "
+                    "re-serializes the pipeline; submit to the work queue "
+                    "and read the mask in the future's callback, or mark "
+                    "the enclosing function @drain_point",
+                ))
+        return findings
+
+
 ALL_RULES = {
     r.code: r
     for r in (HostSyncRule, RetraceRule, NondetIterRule, DtypeWidthRule,
-              MetricNameRule)
+              MetricNameRule, AsyncFetchRule)
 }
 
 

@@ -4,6 +4,7 @@ Usage::
 
     python -m hyperdrive_tpu.chaos soak [--scenarios N] [--seed S]
         [--n N_REPLICAS] [--target H] [--out DIR] [--replay-every K]
+        [--pipelined-every K]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -37,8 +38,24 @@ from hyperdrive_tpu.harness.sim import ScenarioRecord, Simulation
 _SEED_STRIDE = 9973
 
 
-def _build(scen_seed: int, n: int, target: int):
+def _build(scen_seed: int, n: int, target: int, pipelined: bool = False):
     plan = FaultPlan.seeded(scen_seed, n)
+    extra = {}
+    if pipelined:
+        # Queue-backed settle path: every replica flushes through one
+        # shared async device-work queue (jax-free QueueFlusher), so
+        # faults land with coalesced settles in flight. Still unsigned
+        # and accelerator-free — only the schedule moves.
+        from hyperdrive_tpu.devsched import DeviceWorkQueue, QueueFlusher
+        from hyperdrive_tpu.verifier import NullVerifier
+
+        queue = DeviceWorkQueue(max_depth=8)
+        extra = dict(
+            devsched=queue,
+            flusher_for=lambda i, validators: QueueFlusher(
+                NullVerifier(), queue
+            ),
+        )
     sim = Simulation(
         n=n,
         target_height=target,
@@ -49,6 +66,7 @@ def _build(scen_seed: int, n: int, target: int):
         delivery_cost=1e-3,
         chaos=plan,
         observe=True,
+        **extra,
     )
     return plan, sim
 
@@ -80,6 +98,19 @@ def soak(args) -> int:
                 if replayed.commits != result.commits:
                     raise InvariantViolation(
                         "replay", "replayed commits diverge from live run"
+                    )
+            if args.pipelined_every and k % args.pipelined_every == 0:
+                # Re-run the same plan with settles pipelined through
+                # the shared device-work queue: the monitor must stay
+                # clean and the agreed chain byte-identical.
+                _, psim = _build(scen_seed, n, args.target, pipelined=True)
+                pmon = InvariantMonitor(psim)
+                presult = psim.run(max_steps=args.max_steps)
+                pmon.check_final(presult)
+                if presult.commit_digest() != result.commit_digest():
+                    raise InvariantViolation(
+                        "pipelined",
+                        "pipelined chain diverges from sequential",
                     )
         except (InvariantViolation, AssertionError) as err:
             failures += 1
@@ -137,6 +168,13 @@ def main(argv=None) -> int:
         type=int,
         default=5,
         help="determinism self-check cadence (0 = off)",
+    )
+    p.add_argument(
+        "--pipelined-every",
+        type=int,
+        default=4,
+        help="re-run every Kth plan with devsched-pipelined settles and "
+        "cross-check the commit digest (0 = off)",
     )
     p.add_argument("--keep-going", action="store_true")
     p.set_defaults(fn=soak)

@@ -1,0 +1,43 @@
+# hdlint: scope=async
+"""Async device-work scheduling: one queue, futures, coalesced launches.
+
+The engine's dominant cost is no longer crypto — it is the serial
+device round trip. BENCH config 4 measures a ~107 ms minimal
+launch+fetch floor on a tunnel-attached chip against only ~27-36 ms of
+dependent host work (``sub_crossover_note``), and before this package
+every settle paid that floor blocking, once per height.
+
+:class:`DeviceWorkQueue` replaces per-call blocking device access with
+submitted commands returning :class:`DeviceFuture` handles. Pending
+commands against the same launcher coalesce into ONE device launch at
+the next drain — across replicas, heights, and (multi-tenant seam,
+``parallel/multihost.py``) consensus instances — so the sync floor is
+paid once per pipeline slot instead of once per call. On top of it the
+sim harness pipelines consensus chained-HotStuff-style
+(``Simulation(pipeline_heights=True)``): a replica enters height h+1's
+propose/prevote while height h's verification is still in flight, with
+commit finalization gated on the future's resolution.
+
+Scope discipline (ANALYSIS.md HD006): inside devsched-managed async
+scopes, futures are the ONLY device-access idiom — a raw blocking
+``device_fetch`` would silently re-serialize the pipeline. Drains
+(the one place blocking is the point) are marked ``@drain_point``.
+"""
+
+from hyperdrive_tpu.devsched.flusher import QueueFlusher
+from hyperdrive_tpu.devsched.queue import (
+    DeviceFuture,
+    DeviceWorkQueue,
+    NullVerifyLauncher,
+    SpeculationMismatch,
+    VerifyLauncher,
+)
+
+__all__ = [
+    "DeviceFuture",
+    "DeviceWorkQueue",
+    "NullVerifyLauncher",
+    "QueueFlusher",
+    "SpeculationMismatch",
+    "VerifyLauncher",
+]

@@ -1,0 +1,133 @@
+"""Devsched parity smoke: pipelined runs must commit the sequential chain.
+
+Usage::
+
+    python -m hyperdrive_tpu.devsched parity [--n N] [--heights H]
+        [--seed S] [--device] [--buckets 64,256]
+
+Runs the same scenario sequentially and pipelined and compares
+:meth:`~hyperdrive_tpu.harness.sim.SimulationResult.commit_digest` —
+byte-identical chains or exit 1. Two legs by default, both cheap enough
+for a CI dryrun (no ladder compile):
+
+- ``burst``: signed supersteps through the HostVerifier, sequential vs
+  ``pipeline_heights=True`` (speculative settle, gated commits);
+- ``lockstep``: unsigned delivery, blocking flush vs queue-backed
+  :class:`~hyperdrive_tpu.devsched.QueueFlusher` replicas sharing one
+  :class:`~hyperdrive_tpu.devsched.DeviceWorkQueue`.
+
+``--device`` adds the compiled leg — TpuBatchVerifier + device tally
+with a small bucket ladder — which is minutes of XLA compile on a cold
+cache; CI keeps it out of the dryrun and the bench covers it instead.
+HD_SANITIZE=1 in the environment arms the runtime consensus sanitizer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from hyperdrive_tpu.devsched import DeviceWorkQueue, QueueFlusher
+from hyperdrive_tpu.harness.sim import Simulation
+from hyperdrive_tpu.verifier import NullVerifier
+
+
+def _leg_burst(args):
+    kw = dict(
+        n=args.n, target_height=args.heights, seed=args.seed,
+        sign=True, burst=True, observe=True,
+    )
+    seq = Simulation(**kw).run()
+    sim = Simulation(pipeline_heights=True, **kw)
+    pipe = sim.run()
+    q = sim._sched
+    return seq, pipe, q
+
+
+def _leg_lockstep(args):
+    kw = dict(
+        n=args.n, target_height=args.heights, seed=args.seed,
+        timeout=1.0, delivery_cost=1e-3, observe=True,
+    )
+    seq = Simulation(**kw).run()
+    q = DeviceWorkQueue(max_depth=8)
+    pipe = Simulation(
+        devsched=q,
+        flusher_for=lambda i, validators: QueueFlusher(NullVerifier(), q),
+        **kw,
+    ).run()
+    return seq, pipe, q
+
+
+def _leg_device(args):
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+    buckets = tuple(int(b) for b in args.buckets.split(","))
+    kw = dict(
+        n=args.n, target_height=args.heights, seed=args.seed,
+        sign=True, burst=True, observe=True,
+        dedup_verify=True, device_tally=True,
+    )
+    seq = Simulation(
+        batch_verifier=TpuBatchVerifier(buckets=buckets), **kw
+    ).run()
+    sim = Simulation(
+        batch_verifier=TpuBatchVerifier(buckets=buckets),
+        pipeline_heights=True,
+        **kw,
+    )
+    pipe = sim.run()
+    return seq, pipe, sim._sched
+
+
+def parity(args) -> int:
+    legs = {"burst": _leg_burst, "lockstep": _leg_lockstep}
+    if args.device:
+        legs["device"] = _leg_device
+    failed = 0
+    for name, leg in legs.items():
+        seq, pipe, q = leg(args)
+        d_seq, d_pipe = seq.commit_digest(), pipe.commit_digest()
+        ok = seq.completed and pipe.completed and d_seq == d_pipe
+        print(
+            f"{'ok' if ok else 'FAIL'} {name}: digest {d_seq[:16]} "
+            f"{'==' if d_seq == d_pipe else '!='} {d_pipe[:16]} "
+            f"sched={q.submitted} submitted / {q.launches} launches "
+            f"({q.coalesced} coalesced)"
+        )
+        if not ok:
+            failed += 1
+        if q.coalesced == 0:
+            print(f"FAIL {name}: queue never coalesced", file=sys.stderr)
+            failed += 1
+    if failed:
+        print(f"parity FAILED: {failed} checks", file=sys.stderr)
+        return 1
+    print(f"parity ok: {len(legs)} legs, pipelined == sequential")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m hyperdrive_tpu.devsched")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser(
+        "parity", help="pipelined-vs-sequential commit-digest smoke"
+    )
+    p.add_argument("--n", type=int, default=4)
+    p.add_argument("--heights", type=int, default=6)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument(
+        "--device", action="store_true",
+        help="also run the compiled device-tally leg (slow: XLA compile)",
+    )
+    p.add_argument(
+        "--buckets", default="64,256",
+        help="device-leg verify bucket ladder (comma-separated)",
+    )
+    p.set_defaults(fn=parity)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+# hdlint: scope=async
+"""Queue-backed flushing for the host-automaton path.
+
+:class:`QueueFlusher` is the minimal devsched client: it plugs into the
+:class:`~hyperdrive_tpu.replica.Replica` ``flusher`` seam, drains the
+replica's eligible window, submits its verification to the shared
+:class:`~hyperdrive_tpu.devsched.DeviceWorkQueue`, and dispatches the
+window into the automaton when the future resolves — by which point the
+queue has coalesced every co-submitted window (other replicas, later
+heights) into one launch. It is the no-grid sibling of
+:class:`~hyperdrive_tpu.tallyflush.DeviceTallyFlusher`'s queue mode:
+same schedule, no device tally — which keeps it free of any jax import,
+so the chaos soak can run pipelined scenarios on the pure-host engine.
+"""
+
+from __future__ import annotations
+
+from hyperdrive_tpu.analysis.annotations import async_scope
+from hyperdrive_tpu.obs.recorder import NULL_BOUND
+
+__all__ = ["QueueFlusher"]
+
+
+class QueueFlusher:
+    """Host-automaton flush through the async device-work queue.
+
+    ``verifier``: anything with ``verify_signatures`` (coalesced into
+    one call per drain) or nothing but transport trust (NullVerifier —
+    the queue substitutes the accept-all launcher). Verdict semantics
+    are identical to the blocking flush leg; only the schedule moves.
+    """
+
+    def __init__(self, verifier, queue, obs=None):
+        self.verifier = verifier
+        self.queue = queue
+        self.obs = obs if obs is not None else NULL_BOUND
+        self._inflight: list = []
+        #: Windows submitted / dispatched (observability, tests).
+        self.submitted = 0
+        self.dispatched = 0
+
+    @async_scope
+    def flush(self, replica) -> None:
+        """Drain the replica's queue to quiescence, one submitted window
+        per pass; dispatch happens at the queue's next drain."""
+        queue = self.queue
+        launcher = queue.verify_launcher(self.verifier)
+        while True:
+            window = replica.mq.drain_window(
+                replica.proc.current_height, replica.opts.verify_window
+            )
+            if not window:
+                return
+            if self.obs is not NULL_BOUND:
+                self.obs.emit(
+                    "flush.launch",
+                    replica.proc.current_height,
+                    replica.proc.current_round,
+                    len(window),
+                )
+            fut = queue.submit(
+                launcher,
+                [(m.sender, m.digest(), m.signature) for m in window],
+            )
+            self._inflight.append(fut)
+            self.submitted += 1
+
+            def dispatch(f, window=window, replica=replica):
+                try:
+                    self._inflight.remove(f)
+                except ValueError:
+                    pass
+                # hdlint: disable=HD001 resolved futures hold a host list; the one device fetch happened inside the coalesced launch
+                replica.dispatch_window(
+                    window, [bool(ok) for ok in f.result()]
+                )
+                self.dispatched += 1
+                # Dispatching may advance the height and make buffered
+                # messages eligible; re-flush so those join the drain's
+                # next cycle (the blocking leg loops to quiescence too).
+                self.flush(replica)
+
+            fut.add_done_callback(dispatch)
+
+    def reset(self, replica=None) -> None:
+        """Crash-restart recovery hook (``Replica.restore``): cancel the
+        dead incarnation's in-flight windows — the revived replica must
+        not have them dispatched on top of its checkpoint."""
+        for fut in self._inflight:
+            fut.cancel()
+        self._inflight.clear()

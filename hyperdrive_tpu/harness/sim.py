@@ -406,6 +406,10 @@ class Simulation:
         columnar_ingest: Optional[bool] = None,
         pipeline_verify: Optional[bool] = None,
         route_hysteresis: int = 32,
+        pipeline_heights: Optional[bool] = None,
+        pipeline_depth: int = 6,
+        devsched=None,
+        flusher_for: Optional[Callable[[int, list], object]] = None,
         observe: bool = False,
         obs_capacity: int = 65536,
         chaos=None,
@@ -625,6 +629,98 @@ class Simulation:
         #: grid rebuilds — claimed at the current height, fully dirty —
         #: when a fused-sized settle re-engages it.
         self._route_hyst_n = int(route_hysteresis)
+        #: Chained height pipelining (ROADMAP item 5, chained-HotStuff
+        #: shape): settles dispatch IMMEDIATELY on a speculative verdict
+        #: (parseable-and-signed — identical to the device's verdict for
+        #: every honest signature) while the actual verification rides
+        #: the async device-work queue (hyperdrive_tpu/devsched); a
+        #: replica enters height h+1's propose/prevote while height h's
+        #: verify launch is still in flight. Commit finalization is
+        #: GATED on the future's resolution: _on_commit buffers until
+        #: the covering drain confirms the speculation, so no commit is
+        #: externally visible on an unverified window — and a
+        #: divergence (a forged-but-well-formed signature) raises
+        #: SpeculationMismatch instead of rolling back. The device sync
+        #: floor (~107 ms on a tunnel-attached chip, BENCH config 4) is
+        #: then paid once per pipeline slot (``pipeline_depth`` settles
+        #: coalesced into one launch) instead of once per height.
+        #: None = off (the sequential trajectory stays the default and
+        #: the differential baseline).
+        self._pipeline_heights = bool(pipeline_heights or False)
+        self._pipeline_depth = int(pipeline_depth)
+        if self._pipeline_heights:
+            if not burst:
+                raise ValueError(
+                    "pipeline_heights requires burst mode (settles are "
+                    "the unit of pipelining; lock-step replicas "
+                    "pipeline through a queue-backed flusher instead)"
+                )
+            if batch_verifier is None and not sign:
+                raise ValueError(
+                    "pipeline_heights pipelines the batch_verifier's "
+                    "launches; pass one (or sign=True, which installs "
+                    "a HostVerifier default)"
+                )
+            if payload_bytes:
+                raise ValueError(
+                    "pipeline_heights defers commit finalization past "
+                    "the height, but payload reconstruction reads the "
+                    "committed height's propose logs at commit time — "
+                    "run the payload path sequentially"
+                )
+        #: The async device-work queue. Externally injectable
+        #: (``devsched=``): lock-step chaos runs hand the sim the queue
+        #: their replicas' flushers submit through, so the delivery
+        #: loop drains it before firing timeouts — virtual time never
+        #: jumps over in-flight device work. Pipelined burst runs that
+        #: don't pass one get their own.
+        self._sched = devsched
+        if self._sched is None and self._pipeline_heights:
+            from hyperdrive_tpu.devsched import DeviceWorkQueue
+
+            self._sched = DeviceWorkQueue(
+                max_depth=self._pipeline_depth,
+                obs=self.obs.scoped(-2),
+                tracer=self.tracer,
+            )
+        if self._sched is not None:
+            self._sched.on_drain = self._on_sched_drain
+            # An externally-built queue adopts this run's observability
+            # seams unless its builder already bound some: sched.* events
+            # land on the devsched track (-2) and sim.sched.* metrics on
+            # the run's tracer, same as a sim-built queue.
+            from hyperdrive_tpu.obs.recorder import NULL_BOUND
+
+            if self._sched.obs is NULL_BOUND:
+                self._sched.obs = self.obs.scoped(-2)
+            if self._sched.tracer is None:
+                self._sched.tracer = self.tracer
+        #: Per-replica flusher factory ``(i, signatories) -> flusher``
+        #: for LOCK-STEP pipelining: queue-backed flushers (devsched
+        #: QueueFlusher / DeviceTallyFlusher with ``queue=``) submit
+        #: through the injected ``devsched`` queue and the delivery loop
+        #: drains it whenever the network quiesces — so every replica's
+        #: windows coalesce into one launch per drain. Chaos scenarios
+        #: use this seam to keep settles in flight across partitions
+        #: and crash-restarts.
+        self._flusher_for = flusher_for
+        if flusher_for is not None and burst:
+            raise ValueError(
+                "flusher_for wires per-replica flushers for lock-step "
+                "delivery; burst mode settles through the aggregated "
+                "harness path (use pipeline_heights there)"
+            )
+        #: Commit finalizations gated on in-flight speculation:
+        #: (replica, height, value) in commit order, flushed by
+        #: _on_sched_drain once the covering futures resolve.
+        self._gated_commits: list = []
+        self._spec_inflight = 0
+        #: Rows accumulated in the open pipeline slot — the row-aware
+        #: drain trigger (_settle_speculative) closes the slot just
+        #: before a submission would spill into a larger verify bucket,
+        #: because a spilled launch costs the BIGGER bucket's full lane
+        #: count (4096 lanes ≈ 4× the 1024 launch) for the same work.
+        self._spec_rows = 0
         if device_tally and not (burst and self.batch_ingest):
             raise ValueError(
                 "device_tally requires burst=True with batched ingestion"
@@ -975,17 +1071,50 @@ class Simulation:
                 on_propose=bcast, on_prevote=bcast, on_precommit=bcast
             ),
             verifier=verifier,
+            flusher=(
+                self._flusher_for(i, list(self.signatories))
+                if self._flusher_for is not None
+                else None
+            ),
         )
 
     # -------------------------------------------------------------- running
 
     def _on_commit(self, i: int, height: Height, value: Value):
+        if self._spec_inflight:
+            # Pipelined finalize ordering: the commit rests on windows
+            # whose verification is still in flight — buffer it (in
+            # commit order) until the covering drain confirms the
+            # speculation. The replica itself proceeds into the next
+            # height (that is the pipeline); only the EXTERNAL commit
+            # effects — the recorded commit, completion accounting —
+            # wait. Rollback-free: a speculation mismatch raises out of
+            # the drain before any gated commit is finalized.
+            self._gated_commits.append((i, height, value))
+            if self._obs_sim is not _OBS_NULL:
+                self._obs_sim.emit("sched.gated", height, -1, i)
+            return (0, None)
         self.commits[i][height] = value
         if self.payload_bytes:
             self._reconstruct_commit(i, height, value)
         if height >= self.target_height:
             self._pending_replicas.discard(i)
         return (0, None)
+
+    def _on_sched_drain(self, resolved: int) -> None:
+        """Queue drain hook: every in-flight speculative settle just
+        resolved (mismatches raise out of the drain itself), so gated
+        commits are confirmed — finalize them in commit order."""
+        self._spec_inflight = 0
+        self._spec_rows = 0
+        if not self._gated_commits:
+            return
+        gated = self._gated_commits
+        self._gated_commits = []
+        for i, height, value in gated:
+            self.commits[i][height] = value
+            if height >= self.target_height:
+                self._pending_replicas.discard(i)
 
     def _completed(self) -> bool:
         return not self._pending_replicas
@@ -1023,8 +1152,15 @@ class Simulation:
 
         steps = 0
         record_messages = self.record.messages if self._record_on else _DISCARD
+        sched = self._sched
         while steps < max_steps and not self._completed():
             if self._qhead >= len(self.queue):
+                # Resolve in-flight device work (queue-backed flushers)
+                # before advancing virtual time: a timeout must not
+                # fire over a settle that is still in flight — the
+                # drain's cascade may broadcast, refilling the queue.
+                if sched is not None and sched.depth and sched.drain():
+                    continue
                 # Network drained: advance virtual time to the next timeout.
                 if self.clock.pending() == 0:
                     if self._chaos_rescue(steps):
@@ -1087,6 +1223,8 @@ class Simulation:
                 # the last message the process survived.
                 self._ckpt_store.save(to, self.replicas[to].proc)
 
+        if sched is not None:
+            sched.drain()
         return SimulationResult(
             completed=self._completed(),
             steps=steps,
@@ -1106,10 +1244,18 @@ class Simulation:
         replay is exact; faults/drops/reorder apply per message exactly as
         in lock-step mode."""
         steps = 0
+        sched = self._sched
         while steps < max_steps and not self._completed():
             if self.clock.pending() > 65536:
                 self._prune_clock()
             if self._qhead >= len(self.queue):
+                # Nothing left to deliver: resolve in-flight device
+                # work FIRST — a drain can finalize gated commits (and
+                # so complete the run) without burning a timeout, and
+                # virtual time must never jump over a pipeline slot
+                # that still owes its verdict.
+                if sched is not None and sched.depth and sched.drain():
+                    continue
                 if self.clock.pending() == 0:
                     break  # genuine stall
                 event, owner = self.clock.fire_next()
@@ -1236,6 +1382,12 @@ class Simulation:
                 self.record.bursts.append(delivered)
             self._settle()
 
+        if sched is not None:
+            # Shutdown contract: no command may be dropped — the final
+            # drain resolves every outstanding speculation (raising on
+            # mismatch) and finalizes the gated commits the result
+            # below reports.
+            sched.drain()
         return SimulationResult(
             completed=self._completed(),
             steps=steps,
@@ -1570,6 +1722,9 @@ class Simulation:
             obs = self._obs_sim
             if obs is not _OBS_NULL:
                 obs.emit("settle.pass", -1, -1, len(windows))
+            if self._pipeline_heights:
+                self._settle_speculative(windows, shared_window)
+                continue
             if (
                 shared_window is not None
                 and self.device_tally
@@ -1934,6 +2089,152 @@ class Simulation:
         self.tracer.observe("sim.verify.launch", total_items)
         if self._obs_sim is not _OBS_NULL:
             self._obs_sim.emit("verify.launch", -1, -1, total_items)
+
+    def _settle_speculative(self, windows, shared_window) -> None:
+        """Chained height pipelining (ROADMAP item 5): dispatch this
+        settle pass NOW on a speculative verdict and push the actual
+        verification onto the async device-work queue
+        (:mod:`hyperdrive_tpu.devsched`) — replicas enter the next
+        height's propose/prevote while this height's launch is still in
+        flight, and the queue coalesces up to ``pipeline_depth``
+        settles into ONE launch, so the device sync floor is paid once
+        per pipeline slot instead of once per settle.
+
+        The speculation rule accepts exactly the parseable-and-signed
+        rows (32-byte sender, 64-byte signature) — for every honest
+        signature the device's verdict is identical, so honest
+        trajectories are superstep-identical to the sequential run:
+        commit-digest parity holds by construction (asserted by
+        tests/test_devsched.py and the CI parity smoke). A forged-but-
+        well-formed row that speculation admitted raises
+        :class:`SpeculationMismatch` at drain, BEFORE any commit gated
+        on it finalizes (_on_commit buffers while futures are in
+        flight) — loud failure, no rollback machinery.
+
+        Dispatch runs on the host counters (the crossover router's
+        sub-floor path), so under ``device_tally`` the grid gets the
+        same poison upkeep as a host-routed settle.
+        """
+        from hyperdrive_tpu.devsched import SpeculationMismatch
+
+        if self.device_tally:
+            if self._grid_engaged:
+                shared_touched = None
+                for i, w in windows:
+                    if w is shared_window:
+                        if shared_touched is None:
+                            shared_touched = self._touched_slots(w)
+                        touched = shared_touched
+                    else:
+                        touched = self._touched_slots(w)
+                    if touched:
+                        self._poison_grid(i, touched)
+            else:
+                self.tracer.count("sim.settle.grid_upkeep_skipped")
+            self._note_route(True)
+
+        # Speculative verdicts for the unique-broadcast batch (identity
+        # dedup — the same keying as _verify_windows' dedup path).
+        index: dict[int, int] = {}
+        items: list = []
+        expect: list = []
+
+        def spec(m) -> bool:
+            sig = m.signature
+            return (
+                sig is not None and len(sig) == 64 and len(m.sender) == 32
+            )
+
+        keeps: list = []
+        shared_keep = None
+        if shared_window is not None:
+            for m in shared_window:
+                index[id(m)] = len(items)
+                items.append((m.sender, m.digest(), m.signature))
+                expect.append(spec(m))
+            shared_keep = list(expect)
+        for _, w in windows:
+            if w is shared_window:
+                keeps.append(shared_keep)
+                continue
+            row = []
+            for m in w:
+                j = index.get(id(m))
+                if j is None:
+                    j = index[id(m)] = len(items)
+                    items.append((m.sender, m.digest(), m.signature))
+                    expect.append(spec(m))
+                row.append(expect[j])
+            keeps.append(row)
+
+        if items:
+            if self._obs_sim is not _OBS_NULL:
+                self._obs_sim.emit(
+                    "settle.speculative", -1, -1, len(items)
+                )
+            self.tracer.observe("sim.verify.speculated", len(items))
+            sched = self._sched
+            # Row-aware slot close: if adding this settle would push the
+            # coalesced batch into a LARGER verify bucket, drain first —
+            # padded launches cost by bucket, not by fill, so crossing
+            # the boundary quadruples the launch for the same rows.
+            # Verifiers without a bucket ladder (HostVerifier) fall back
+            # to the queue's command-count depth bound.
+            buckets = getattr(
+                getattr(self.batch_verifier, "host", None), "buckets", None
+            )
+            if buckets and self._spec_rows:
+                from hyperdrive_tpu.ops.bucketing import bucket_for
+
+                if bucket_for(
+                    self._spec_rows + len(items), buckets
+                ) > bucket_for(self._spec_rows, buckets):
+                    sched.drain()
+            # Account BEFORE submit: submit may auto-drain at max_depth
+            # (resolving this very command and zeroing the counters via
+            # _on_sched_drain) — incrementing afterwards would record a
+            # phantom in-flight settle that gates commits forever.
+            self._spec_rows += len(items)
+            self._spec_inflight += 1
+            fut = sched.submit(
+                sched.verify_launcher(self.batch_verifier), items
+            )
+            expected = expect
+
+            def confirm(f, expected=expected, items=items):
+                # hdlint: disable=HD001 resolved futures hold a host list; the one device fetch happened inside the coalesced launch
+                actual = [bool(b) for b in f.result()]
+                if actual != expected:
+                    bad = next(
+                        j
+                        for j in range(len(actual))
+                        if actual[j] != expected[j]
+                    )
+                    raise SpeculationMismatch(
+                        "pipelined settle diverged from the device "
+                        f"verdict at lane {bad}/{len(actual)} "
+                        f"(sender {items[bad][0].hex()[:16]}…, "
+                        f"speculated {expected[bad]}, actual "
+                        f"{actual[bad]}): a forged-but-well-formed "
+                        "signature was speculatively dispatched; rerun "
+                        "with pipeline_heights=False"
+                    )
+
+            fut.add_done_callback(confirm)
+
+        # Dispatch immediately — THIS is the pipeline: the network
+        # progresses on the speculative verdicts while the launch is in
+        # flight. Commits raised by the cascade gate in _on_commit.
+        self._dispatch_windows(windows, keeps, shared_window)
+
+        # A gated commit that would complete the run must not wait for
+        # the depth trigger — drain now so run() terminates promptly
+        # instead of speculating extra heights past the target.
+        if self._gated_commits and any(
+            h >= self.target_height and i in self._pending_replicas
+            for i, h, _ in self._gated_commits
+        ):
+            self._sched.drain()
 
     def _touched_slots(self, msgs) -> set:
         """The (plane, round) grid slots a window's votes would fill —

@@ -28,6 +28,11 @@ _INSTANTS = {
     "wire.frame.malformed": "frame malformed",
     "wire.frame.oversize": "frame oversize",
     "wire.frame.shed": "frame shed",
+    "settle.speculative": "speculative settle",
+    "verify.rlc.fallbacks": "rlc fallback",
+    "sched.coalesce": "coalesce",
+    "sched.drain": "drain",
+    "sched.gated": "commit gated",
 }
 
 _PHASE_OPENERS = {
@@ -75,12 +80,29 @@ def to_trace_events(events):
         if open_round.pop(tid, None) is not None:
             end(tid, ts)
 
+    # Running queue depth for the devsched track's counter series —
+    # reconstructed from the journal (submits raise it, a drain zeroes
+    # it), so the counter is as deterministic as the journal itself.
+    sched_depth = 0
+
     for ev in events:
         ts, replica, height, round_, kind, detail = (
             ev[0], ev[1], ev[2], ev[3], ev[4], ev[5],
         )
         tid = replica
         tids.add(tid)
+        if kind == "sched.submit" or kind == "sched.drain":
+            sched_depth = sched_depth + 1 if kind == "sched.submit" else 0
+            out.append(
+                {
+                    "ph": "C",
+                    "ts": _us(ts),
+                    "pid": PID,
+                    "tid": tid,
+                    "name": "sched.depth",
+                    "args": {"depth": sched_depth},
+                }
+            )
         if kind == "round.start":
             close_round(tid, ts)
             begin(
@@ -129,7 +151,14 @@ def to_trace_events(events):
     # Track naming metadata first, so the UI labels tids as replicas.
     meta = []
     for tid in sorted(tids):
-        name = "sim" if tid < 0 else f"replica {tid}"
+        # tid -2 is the devsched work-queue track (sim.py scopes the
+        # queue's recorder handle there); -1 is the sim's own track.
+        if tid == -2:
+            name = "devsched"
+        elif tid < 0:
+            name = "sim"
+        else:
+            name = f"replica {tid}"
         meta.append(
             {
                 "ph": "M",

@@ -59,8 +59,15 @@ EVENT_KINDS = frozenset(
         "ingest.window",
         "mq.drop",
         "settle.pass",
+        "settle.speculative",
         "verify.launch",
+        "verify.rlc.verdict",
+        "verify.rlc.fallbacks",
         "tally.launch",
+        "sched.submit",
+        "sched.coalesce",
+        "sched.drain",
+        "sched.gated",
         "flush.launch",
         "flush.settle",
         "fetch.sync",

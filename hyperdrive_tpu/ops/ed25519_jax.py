@@ -37,6 +37,7 @@ from jax import lax
 
 from hyperdrive_tpu.analysis.annotations import device_fetch
 from hyperdrive_tpu.crypto import ed25519 as host_ed
+from hyperdrive_tpu.obs.recorder import NULL_BOUND as _OBS_NULL_BOUND
 from hyperdrive_tpu.ops import bucketing
 from hyperdrive_tpu.ops import fe25519 as fe
 
@@ -744,13 +745,21 @@ class TpuBatchVerifier:
     """
 
     def __init__(self, buckets=(64, 256, 1024, 4096), rlc: bool = False,
-                 backend: str = "auto"):
+                 backend: str = "auto", obs=None):
         self.host = Ed25519BatchHost(buckets=buckets)
         self._fn = make_verify_fn(jit=True)
         self.rlc = rlc
         self._rlc_fn = make_rlc_fn(jit=True) if rlc else None
         #: How many windows fell back to the per-signature kernel.
         self.rlc_fallbacks = 0
+        #: Flight-recorder handle (obs/recorder.py; NULL_BOUND = off).
+        #: The documented-slower ``rlc=True`` path reports per-chunk
+        #: verdicts and the running fallback count through this seam
+        #: instead of a silent counter — an observed run shows WHERE the
+        #: second launches went, not just that some happened. The sim
+        #: binds it when ``observe=True``; deployments pass a scoped
+        #: handle.
+        self.obs = obs if obs is not None else _OBS_NULL_BOUND
         # Kernel backend: the Pallas ladder (7.5x the XLA kernel on v5e
         # — 535.1k vs 70.9k sigs/s in bench.py) on real TPU backends, the
         # XLA kernel elsewhere (the Mosaic interpreter is far too slow
@@ -887,11 +896,22 @@ class TpuBatchVerifier:
             if dev is None:
                 out.append(prevalid[:n].copy())  # all lanes malformed
             elif self._rlc_fn is not None:
+                obs_on = self.obs is not _OBS_NULL_BOUND
                 if bool(device_fetch(dev, why="RLC verdict gates the "
                                               "fallback launch")):
+                    if obs_on:
+                        self.obs.emit("verify.rlc.verdict", -1, -1, "ok")
                     out.append(prevalid[:n].copy())
                 else:
                     self.rlc_fallbacks += 1
+                    if obs_on:
+                        self.obs.emit(
+                            "verify.rlc.verdict", -1, -1, "fallback"
+                        )
+                        self.obs.emit(
+                            "verify.rlc.fallbacks", -1, -1,
+                            self.rlc_fallbacks,
+                        )
                     mask = device_fetch(self._device_verify(arrays),
                                         why="per-signature fallback mask")
                     out.append((mask & prevalid)[:n])

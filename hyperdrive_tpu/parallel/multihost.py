@@ -39,7 +39,68 @@ __all__ = [
     "make_hybrid_mesh",
     "global_window_from_local",
     "replicate_to_all_hosts",
+    "ShardVerifyService",
 ]
+
+
+class ShardVerifyService:
+    """One verifier + one async device-work queue, shared by every
+    replica a host runs: the multi-tenant batching seam.
+
+    A host that runs many replicas (one per shard/tenant it serves) must
+    NOT let each of them launch its own verify — per-launch sync cost
+    multiplied by tenant count is exactly the bill devsched exists to
+    split. Every tenant submits into the same
+    :class:`~hyperdrive_tpu.devsched.DeviceWorkQueue`, so windows from
+    all of them coalesce into ONE launch per drain: the sync floor is
+    paid once per pipeline slot per HOST, not per replica.
+
+    The service is deliberately mesh-agnostic — it batches the *launch
+    schedule*, while :func:`make_hybrid_mesh` shapes the *launch
+    itself*; a pod host composes both (sharded verify kernels fed by a
+    coalesced queue).
+    """
+
+    def __init__(self, verifier, queue=None, max_depth: int = 8,
+                 obs=None, tracer=None):
+        from hyperdrive_tpu.devsched import DeviceWorkQueue
+
+        self.verifier = verifier
+        self.queue = (
+            queue
+            if queue is not None
+            else DeviceWorkQueue(max_depth=max_depth, obs=obs,
+                                 tracer=tracer)
+        )
+        self._launcher = self.queue.verify_launcher(verifier)
+        #: Commands submitted per tenant key (observability).
+        self.tenants: dict = {}
+
+    def submit(self, tenant, items):
+        """Enqueue one tenant's verify batch; returns its
+        :class:`~hyperdrive_tpu.devsched.DeviceFuture`. ``tenant`` is an
+        opaque accounting key (replica id, shard id)."""
+        self.tenants[tenant] = self.tenants.get(tenant, 0) + 1
+        return self.queue.submit(self._launcher, items)
+
+    def flusher(self, validators, **kwargs):
+        """A queue-backed :class:`~hyperdrive_tpu.tallyflush.
+        DeviceTallyFlusher` for one tenant replica. Every flusher built
+        here shares this service's queue (and verifier), which is the
+        whole point: co-located replicas' flush windows coalesce."""
+        from hyperdrive_tpu.tallyflush import DeviceTallyFlusher
+
+        return DeviceTallyFlusher(
+            self.verifier, validators, queue=self.queue, **kwargs
+        )
+
+    def drain(self) -> int:
+        """Resolve every tenant's pending commands (one coalesced
+        launch); the host event loop's idle hook."""
+        return self.queue.drain()
+
+    def close(self) -> int:
+        return self.queue.close()
 
 
 def init_distributed(

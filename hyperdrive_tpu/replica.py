@@ -359,6 +359,11 @@ class Replica:
         self._lane_counts.clear()
         self._pending.clear()
         self._last_commit_time = None
+        if self.flusher is not None and hasattr(self.flusher, "reset"):
+            # Queue-backed flushers hold in-flight settle futures; the
+            # revived replica must not apply its dead predecessor's
+            # windows on top of the checkpoint (devsched cancel path).
+            self.flusher.reset(self)
         self.logger.info(
             "restored %s",
             _kv(

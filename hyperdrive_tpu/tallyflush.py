@@ -33,7 +33,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from hyperdrive_tpu.analysis.annotations import device_fetch
+from hyperdrive_tpu.analysis.annotations import async_scope, device_fetch
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
 __all__ = ["DeviceTallyFlusher"]
@@ -56,7 +56,7 @@ class DeviceTallyFlusher:
 
     def __init__(self, verifier, validators, r_slots: int = 8,
                  buckets: tuple = (256, 1024, 4096), tally_check=None,
-                 pipeline_split: int = 512, obs=None):
+                 pipeline_split: int = 512, obs=None, queue=None):
         from hyperdrive_tpu.ops.votegrid import VoteGrid
 
         self.verifier = verifier
@@ -88,6 +88,18 @@ class DeviceTallyFlusher:
         self.fastpath_rows = 0
         #: Flight-recorder handle (obs/recorder.py; NULL_BOUND = off).
         self.obs = obs if obs is not None else NULL_BOUND
+        #: Async device-work queue (:class:`hyperdrive_tpu.devsched.
+        #: DeviceWorkQueue`). When set, :meth:`flush` stops blocking per
+        #: window: each drained window becomes one submitted verify
+        #: command and its settle (insert + tally + cascade) runs at the
+        #: queue's next drain — where windows from EVERY flusher sharing
+        #: the queue coalesce into one launch, so co-located replicas
+        #: (and multihost tenants) split one sync floor instead of
+        #: paying one each. None keeps the synchronous schedule.
+        self.queue = queue
+        #: Futures for submitted-but-unsettled windows, in submission
+        #: order (crash-restart reset cancels them).
+        self._inflight: list = []
 
     def warmup(self) -> None:
         """Compile the grid kernel (one empty scatter) before the replica
@@ -108,6 +120,65 @@ class DeviceTallyFlusher:
         if hasattr(self.verifier, "warmup"):
             self.verifier.warmup()
 
+    def reset(self, replica=None) -> None:
+        """Crash-restart recovery hook (:meth:`hyperdrive_tpu.replica.
+        Replica.restore` calls this): cancel every in-flight settle — a
+        revived replica restores from its checkpoint and must NOT apply
+        its dead predecessor's submitted-but-unsettled windows on top —
+        and drop the height claim so the next settle resets the grid
+        plane instead of trusting pre-crash scatters."""
+        for fut in self._inflight:
+            fut.cancel()
+        self._inflight.clear()
+        self._height = None
+        self._dirty = set()
+
+    @async_scope
+    def _flush_async(self, replica) -> None:
+        """The devsched flush schedule: drain windows NOW, settle at the
+        queue's next drain. Each window's verify command goes onto the
+        shared queue and its settle (mask filter + insert + tally +
+        cascade) runs in the future's done-callback — by then the
+        coalesced launch has verified every co-submitted window, so N
+        flushing replicas paid ONE device sync. The settle reads the
+        replica's state at drain time, which is the pipelining: the
+        replica keeps stepping (next height's propose/prevote) while its
+        windows are in flight. No ``device_fetch`` here — the mask
+        arrives resolved (HD006 enforces this discipline)."""
+        queue = self.queue
+        launcher = queue.verify_launcher(self.verifier)
+        while True:
+            window = replica.mq.drain_window(
+                replica.proc.current_height, replica.opts.verify_window
+            )
+            if not window:
+                return
+            if self.obs is not NULL_BOUND:
+                self.obs.emit(
+                    "flush.launch",
+                    replica.proc.current_height,
+                    replica.proc.current_round,
+                    len(window),
+                )
+            fut = queue.submit(
+                launcher,
+                [(m.sender, m.digest(), m.signature) for m in window],
+            )
+            self._inflight.append(fut)
+
+            def settle(f, window=window, replica=replica):
+                try:
+                    self._inflight.remove(f)
+                except ValueError:
+                    pass
+                # The launcher already applied the verifier's unsigned
+                # filter; its verdicts ARE verify_batch's.
+                # hdlint: disable=HD001 resolved futures hold a host list; the one device fetch happened inside the coalesced launch
+                keep = [bool(ok) for ok in f.result()]
+                self._settle(replica, [(window, None, lambda k=keep: k)])
+
+            fut.add_done_callback(settle)
+
     def flush(self, replica) -> None:
         """Drain the replica's queue to quiescence (the reference flush
         contract, replica/replica.go:251-264), one verified + tallied
@@ -123,6 +194,9 @@ class DeviceTallyFlusher:
         byte-identical to the single-launch schedule (the automaton sees
         the same rows in the same order).
         """
+        if self.queue is not None:
+            self._flush_async(replica)
+            return
         begin = getattr(self.verifier, "verify_signatures_begin", None)
         while True:
             window = replica.mq.drain_window(

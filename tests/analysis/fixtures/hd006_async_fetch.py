@@ -1,0 +1,38 @@
+# hdlint: scope=async
+"""HD006 fixture: blocking fetches inside a devsched async scope."""
+
+from hyperdrive_tpu.analysis.annotations import (
+    async_scope,
+    device_fetch,
+    drain_point,
+)
+
+
+class AsyncFlusher:
+    def __init__(self, queue, launcher):
+        self.queue = queue
+        self.launcher = launcher
+
+    def submit_then_block(self, items):
+        fut = self.queue.submit(self.launcher, items)
+        return device_fetch(fut)  # BAD: blocks mid-pipeline
+
+    def eager_mask(self, pending):
+        return [bool(b) for b in device_fetch(pending.mask())]  # BAD
+
+    def submit_with_callback(self, items, settle):
+        # GOOD: the async idiom — the mask arrives resolved at drain
+        fut = self.queue.submit(self.launcher, items)
+        fut.add_done_callback(settle)
+        return fut
+
+    @drain_point
+    def drain_and_read(self, pending):
+        # GOOD: a declared drain point is where blocking belongs
+        return device_fetch(pending.mask())
+
+
+@async_scope
+def marker_scoped_block(queue, launcher, items):
+    fut = queue.submit(launcher, items)
+    return device_fetch(fut)  # BAD: marker scope, same discipline

@@ -87,6 +87,43 @@ def test_hd005_fixture_flags_dynamic_names_not_table_lookups():
     assert "not lowercase dotted" in msgs
 
 
+def test_hd006_fixture_flags_blocking_fetches_not_drain_points():
+    path = os.path.join(FIXTURES, "hd006_async_fetch.py")
+    findings = run_on(path)
+    assert {f.rule for f in findings} == {"HD006"}
+    # submit-then-block, eager mask fetch, marker-scoped block — and
+    # neither the callback idiom nor the @drain_point body.
+    assert len(findings) == 3
+    src = open(path).read()
+    bad_lines = {
+        i + 1 for i, text in enumerate(src.splitlines()) if "# BAD" in text
+    }
+    assert set(lines_of(findings, "HD006")) == bad_lines
+    assert all("drain_point" in f.message for f in findings)
+
+
+def test_async_scope_marker_extends_hd006_beyond_devsched(tmp_path):
+    src = textwrap.dedent(
+        """
+        from hyperdrive_tpu.analysis.annotations import (
+            async_scope, device_fetch,
+        )
+
+        @async_scope
+        def pipelined(pending):
+            return device_fetch(pending.mask())
+
+        def sequential(pending):
+            return device_fetch(pending.mask())
+        """
+    )
+    p = tmp_path / "elsewhere.py"
+    p.write_text(src)
+    findings = run_on(str(p))
+    assert len(findings) == 1  # only the @async_scope body is audited
+    assert findings[0].rule == "HD006"
+
+
 def test_suppressed_fixture_is_clean_even_in_strict():
     path = os.path.join(FIXTURES, "suppressed_clean.py")
     assert run_on(path) == []
@@ -189,7 +226,9 @@ def test_suppression_on_preceding_line_covers_next_line():
 
 
 def test_rule_catalog_is_complete():
-    assert set(ALL_RULES) == {"HD001", "HD002", "HD003", "HD004", "HD005"}
+    assert set(ALL_RULES) == {
+        "HD001", "HD002", "HD003", "HD004", "HD005", "HD006",
+    }
     for cls in ALL_RULES.values():
         assert cls.summary and cls.name
 

@@ -1,0 +1,324 @@
+"""Async device-work queue: futures, coalescing, height pipelining.
+
+Deterministic property tests for hyperdrive_tpu/devsched under the
+sim's virtual clock — per-submitter FIFO, coalescing determinism at a
+fixed seed, future fan-out, drain-on-shutdown — plus the headline
+guarantee: a pipelined run commits exactly the chain the sequential
+run does, and a forged-but-well-formed signature fails LOUDLY
+(SpeculationMismatch) before any gated commit finalizes.
+
+Everything here is jax-free: queue mechanics use counting launchers,
+and the sim runs use the HostVerifier leg (``sign=True``).
+"""
+
+import pytest
+
+from hyperdrive_tpu.devsched import (
+    DeviceFuture,
+    DeviceWorkQueue,
+    NullVerifyLauncher,
+    QueueFlusher,
+    SpeculationMismatch,
+    VerifyLauncher,
+)
+from hyperdrive_tpu.harness.sim import Simulation
+from hyperdrive_tpu.verifier import HostVerifier, NullVerifier
+
+# ------------------------------------------------------- queue mechanics
+
+
+class CountingLauncher:
+    """Echo launcher: each payload's result is the payload itself;
+    records every launch's shape for coalescing assertions."""
+
+    kind = "echo"
+
+    def __init__(self):
+        self.launches = []
+
+    def launch(self, payloads):
+        self.launches.append([len(p) for p in payloads])
+        return [list(p) for p in payloads]
+
+
+def test_submit_returns_pending_future_and_drain_resolves():
+    q = DeviceWorkQueue()
+    launcher = CountingLauncher()
+    fut = q.submit(launcher, [1, 2, 3])
+    assert not fut.done() and q.depth == 1
+    assert q.drain() == 1
+    assert fut.done() and fut.result() == [1, 2, 3]
+    assert launcher.launches == [[3]]
+
+
+def test_per_submitter_fifo_resolution_order():
+    # Futures resolve in global submission order — per-submitter FIFO
+    # is a corollary. Interleave three "replicas"; the callback log
+    # must replay the exact submission sequence.
+    q = DeviceWorkQueue()
+    launcher = CountingLauncher()
+    order = []
+    expect = []
+    for step in range(9):
+        replica = step % 3
+        tag = (replica, step)
+        expect.append(tag)
+        fut = q.submit(launcher, [tag])
+        fut.add_done_callback(lambda f, t=tag: order.append(t))
+    q.drain()
+    assert order == expect
+    # ...and they all rode ONE launch.
+    assert launcher.launches == [[1] * 9]
+
+
+def test_coalescing_groups_by_launcher_in_first_submission_order():
+    q = DeviceWorkQueue()
+    a, b = CountingLauncher(), CountingLauncher()
+    q.submit(a, [1])
+    q.submit(b, [2, 2])
+    q.submit(a, [3])
+    assert q.drain() == 3
+    assert a.launches == [[1, 1]]  # two commands, one launch
+    assert b.launches == [[2]]
+    assert q.launches == 2 and q.coalesced == 1
+
+
+def test_future_fanout_multiple_callbacks_in_order():
+    q = DeviceWorkQueue()
+    fut = q.submit(CountingLauncher(), [7])
+    seen = []
+    fut.add_done_callback(lambda f: seen.append(("first", f.result())))
+    fut.add_done_callback(lambda f: seen.append(("second", f.result())))
+    q.drain()
+    assert seen == [("first", [7]), ("second", [7])]
+    # Late registration fires immediately on a resolved future.
+    fut.add_done_callback(lambda f: seen.append(("late", f.result())))
+    assert seen[-1] == ("late", [7])
+
+
+def test_callbacks_may_submit_more_work_into_same_drain():
+    q = DeviceWorkQueue()
+    launcher = CountingLauncher()
+    results = []
+
+    def chain(f):
+        results.append(f.result())
+        if len(results) < 3:
+            q.submit(launcher, [len(results)]).add_done_callback(chain)
+
+    q.submit(launcher, [0]).add_done_callback(chain)
+    resolved = q.drain()
+    assert resolved == 3
+    assert results == [[0], [1], [2]]
+    assert q.depth == 0
+
+
+def test_max_depth_auto_drains_on_submit():
+    q = DeviceWorkQueue(max_depth=2)
+    launcher = CountingLauncher()
+    f1 = q.submit(launcher, [1])
+    assert not f1.done()
+    f2 = q.submit(launcher, [2])  # hits the bound -> drains both
+    assert f1.done() and f2.done()
+    assert launcher.launches == [[1, 1]]
+
+
+def test_cancel_skips_resolution_and_launch():
+    q = DeviceWorkQueue()
+    launcher = CountingLauncher()
+    fut = q.submit(launcher, [1])
+    live = q.submit(launcher, [2])
+    assert fut.cancel()
+    q.drain()
+    assert fut.cancelled() and live.result() == [2]
+    # The cancelled payload never reached the device.
+    assert launcher.launches == [[1]]
+    with pytest.raises(RuntimeError, match="cancelled"):
+        fut.result()
+    assert not live.cancel()  # already resolved
+
+
+def test_result_forces_drain():
+    q = DeviceWorkQueue()
+    fut = q.submit(CountingLauncher(), [5])
+    assert fut.result() == [5]  # blocking escape hatch drains the queue
+    assert q.depth == 0
+
+
+def test_close_drains_then_rejects_submits():
+    # Drain-on-shutdown: nothing pending may be silently dropped.
+    q = DeviceWorkQueue()
+    launcher = CountingLauncher()
+    futs = [q.submit(launcher, [i]) for i in range(4)]
+    assert q.close() == 4
+    assert all(f.done() for f in futs)
+    with pytest.raises(RuntimeError, match="closed"):
+        q.submit(launcher, [9])
+
+
+def test_on_drain_fires_with_resolved_count():
+    q = DeviceWorkQueue()
+    counts = []
+    q.on_drain = counts.append
+    q.submit(CountingLauncher(), [1])
+    q.submit(CountingLauncher(), [2])
+    q.drain()
+    q.drain()  # empty drain must NOT fire the hook
+    assert counts == [2]
+
+
+def test_verify_launcher_memoized_per_verifier():
+    q = DeviceWorkQueue()
+    host, null = HostVerifier(), NullVerifier()
+    assert q.verify_launcher(host) is q.verify_launcher(host)
+    assert isinstance(q.verify_launcher(host), VerifyLauncher)
+    # NullVerifier has no verify_signatures -> transport-trusting leg.
+    assert isinstance(q.verify_launcher(null), NullVerifyLauncher)
+
+
+def test_null_launcher_matches_null_verifier_verdicts():
+    # Swapping NullVerifier flushing from blocking to queued must not
+    # change verdicts: unsigned rows stay accepted.
+    payload = [(b"\x00" * 32, b"\x01" * 32, None)] * 3
+    assert NullVerifyLauncher().launch([payload]) == [[True, True, True]]
+
+
+# ------------------------------------------------ sim integration (burst)
+
+_SIGNED = dict(
+    n=4, target_height=6, seed=7, sign=True, burst=True, observe=True
+)
+
+
+def test_pipelined_digest_parity_with_sequential():
+    seq = Simulation(**_SIGNED)
+    res_seq = seq.run()
+    pipe = Simulation(pipeline_heights=True, **_SIGNED)
+    res_pipe = pipe.run()
+    assert res_seq.completed and res_pipe.completed
+    assert res_seq.commit_digest() == res_pipe.commit_digest()
+    # Pipelining actually engaged: settles coalesced across heights.
+    assert pipe._sched.coalesced > 0
+    assert pipe._sched.launches < pipe._sched.submitted
+    assert pipe._sched.depth == 0  # drained before the result returned
+
+
+def test_pipelined_run_is_deterministic_at_fixed_seed():
+    # Same seed, same config -> identical coalescing decisions,
+    # identical obs journal, identical chain.
+    a = Simulation(pipeline_heights=True, **_SIGNED)
+    res_a = a.run()
+    b = Simulation(pipeline_heights=True, **_SIGNED)
+    res_b = b.run()
+    assert res_a.commit_digest() == res_b.commit_digest()
+    assert a.obs.digest() == b.obs.digest()
+    assert (a._sched.submitted, a._sched.launches, a._sched.coalesced) == (
+        b._sched.submitted, b._sched.launches, b._sched.coalesced
+    )
+
+
+def test_forged_signature_raises_speculation_mismatch():
+    # Speculation accepts parseable-and-signed rows; a verifier that
+    # rejects them all at drain time means forged-but-well-formed
+    # signatures — the pipeline must fail loudly, not diverge.
+    sim = Simulation(pipeline_heights=True, **_SIGNED)
+    launcher = sim._sched.verify_launcher(sim.batch_verifier)
+    launcher.verifier = type(
+        "Forged", (), {
+            "verify_signatures": staticmethod(
+                lambda items: [False] * len(items)
+            )
+        }
+    )()
+    with pytest.raises(SpeculationMismatch):
+        sim.run()
+
+
+def test_pipeline_heights_requires_burst():
+    with pytest.raises(ValueError, match="burst"):
+        Simulation(n=4, target_height=3, sign=True, pipeline_heights=True)
+
+
+def test_pipeline_heights_requires_a_verifier():
+    with pytest.raises(ValueError, match="batch_verifier"):
+        Simulation(
+            n=4, target_height=3, burst=True, pipeline_heights=True
+        )
+
+
+def test_flusher_for_rejects_burst_mode():
+    q = DeviceWorkQueue()
+    with pytest.raises(ValueError, match="lock-step"):
+        Simulation(
+            n=4, target_height=3, burst=True, sign=True,
+            devsched=q,
+            flusher_for=lambda i, v: QueueFlusher(NullVerifier(), q),
+        )
+
+
+# -------------------------------------------- lock-step flusher pipeline
+
+
+def test_lockstep_queue_flusher_digest_parity():
+    # The chaos-soak leg: unsigned lock-step replicas flushing through
+    # one shared queue commit the same chain as plain sequential
+    # delivery, with real cross-replica coalescing.
+    kw = dict(
+        n=4, target_height=8, seed=31, timeout=1.0,
+        delivery_cost=1e-3, observe=True,
+    )
+    seq = Simulation(**kw)
+    res_seq = seq.run()
+    queue = DeviceWorkQueue(max_depth=8)
+    pipe = Simulation(
+        devsched=queue,
+        flusher_for=lambda i, validators: QueueFlusher(
+            NullVerifier(), queue
+        ),
+        **kw,
+    )
+    res_pipe = pipe.run()
+    assert res_seq.commit_digest() == res_pipe.commit_digest()
+    assert queue.coalesced > 0 and queue.depth == 0
+    flushers = [r.flusher for r in pipe.replicas]
+    assert sum(f.dispatched for f in flushers) == sum(
+        f.submitted for f in flushers
+    )
+
+
+def test_queue_flusher_reset_cancels_inflight():
+    queue = DeviceWorkQueue()
+    flusher = QueueFlusher(NullVerifier(), queue)
+    fut = queue.submit(queue.verify_launcher(flusher.verifier), [])
+    flusher._inflight.append(fut)
+    flusher.reset()
+    assert fut.cancelled() and not flusher._inflight
+    queue.drain()  # cancelled command must not resolve or launch
+    assert not fut.done() or fut.cancelled()
+
+
+# ------------------------------------------------- multi-tenant service
+
+
+def test_shard_verify_service_coalesces_tenants():
+    from hyperdrive_tpu.parallel.multihost import ShardVerifyService
+
+    class CountingVerifier:
+        def __init__(self):
+            self.calls = []
+
+        def verify_signatures(self, items):
+            self.calls.append(len(items))
+            return [True] * len(items)
+
+    ver = CountingVerifier()
+    svc = ShardVerifyService(ver, max_depth=0)
+    rows = [(b"\x00" * 32, b"\x01" * 32, b"\x02" * 64)]
+    futs = [svc.submit(f"shard-{t}", rows * (t + 1)) for t in range(3)]
+    assert svc.queue.depth == 3
+    svc.drain()
+    # Three tenants, ONE device call covering all six rows.
+    assert ver.calls == [6]
+    assert [len(f.result()) for f in futs] == [1, 2, 3]
+    assert svc.tenants == {"shard-0": 1, "shard-1": 1, "shard-2": 1}
+    svc.close()

@@ -338,3 +338,63 @@ def test_settle_block_sync_verifier_fallback():
         window = [m for m in _signed_script(3) if m.height == h]
         fl.settle_block(rep, MessageBlock.from_messages(window))
     assert commits == c_mono and set(commits) == {1, 2, 3}
+
+
+def test_queue_mode_commits_match_blocking_flush():
+    """The devsched seam: a flusher given ``queue=`` submits its windows
+    instead of verifying inline, and dispatch happens at the queue's
+    drain — by which point co-submitted windows coalesced into one
+    launch. The committed chain must equal the blocking flush's."""
+    from hyperdrive_tpu.devsched import DeviceWorkQueue
+
+    queue = DeviceWorkQueue()
+    commits_q: dict = {}
+    fq = DeviceTallyFlusher(NullVerifier(), SIGS, queue=queue)
+    rep_q = _build(flusher=fq, commits=commits_q)
+    commits_host: dict = {}
+    rep_host = _build(commits=commits_host)
+
+    rep_q.start()
+    rep_host.start()
+    for m in _script(3):
+        rep_q.handle(m)
+        rep_host.handle(m)
+        queue.drain()  # the deployment event loop's idle hook
+    assert commits_q == commits_host
+    assert len(commits_q) >= 3
+    assert queue.submitted > 0 and queue.depth == 0
+
+
+def test_queue_mode_reset_cancels_inflight_windows():
+    """Crash-restart recovery: Replica.restore() must not let the dead
+    incarnation's in-flight windows dispatch on top of the checkpoint —
+    reset() cancels them at the queue."""
+    from hyperdrive_tpu.devsched import DeviceWorkQueue
+    from hyperdrive_tpu.utils.checkpoint import checkpoint_bytes
+
+    queue = DeviceWorkQueue()
+    commits: dict = {}
+    fl = DeviceTallyFlusher(NullVerifier(), SIGS, queue=queue)
+    rep = _build(flusher=fl, commits=commits)
+    rep.start()
+    ckpt = checkpoint_bytes(rep.proc)
+    for m in _script(2):
+        rep.handle(m)  # no drain: windows pile up in flight
+    inflight = list(fl._inflight)
+    assert inflight, "expected undrained windows in flight"
+    rep.restore(ckpt)
+    assert not fl._inflight
+    assert all(f.cancelled() for f in inflight)
+    # The cancelled windows never dispatch; the revived replica rebuilds
+    # from live traffic and commits the same chain.
+    queue.drain()
+    commits.clear()
+    for m in _script(2):
+        rep.handle(m)
+        queue.drain()
+    commits_host: dict = {}
+    rep_host = _build(commits=commits_host)
+    rep_host.start()
+    for m in _script(2):
+        rep_host.handle(m)
+    assert commits == commits_host
