@@ -1,0 +1,215 @@
+"""Round-7 bench: RLC-MSM batch verification vs the per-signature
+ladder, plus the O(1) quorum-certificate size sweep.
+
+Usage:
+    python benches/msm_bench.py [--lanes 16384 65536] [--trials 5]
+        [-o BENCH_r07.json]
+
+The kernel comparison is PAIRED the way every r05/r06 artifact is:
+each trial times BOTH legs back to back — the 64-window per-signature
+ladder (``verify_kernel``) and the RLC batch equation whose two
+Pippenger MSMs reduce the whole batch in one combined check
+(``rlc_kernel`` → ``ops/msm.py``) — with the leg order alternating per
+trial so drift cannot rank them by when they ran. The headline is the
+per-trial ladder/msm wall ratio's median at each lane count.
+
+The certificate sweep measures marshalled ``QuorumCertificate`` bytes
+at 256/512/1024 validators (constant but for the n/8 signer bitmap)
+against the 64(2f+1)-byte signature set a commit proof would otherwise
+re-gossip, and re-verifies a freshly minted certificate to time the
+O(1) check. A culprit-isolation leg plants one forged lane in an
+otherwise honest batch and asserts the RLC path's fallback mask equals
+the ladder's exactly — the artifact's ``culprit_parity`` flag.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2.0")
+
+
+def _signed_items(n: int, distinct_keys: int = 32):
+    from hyperdrive_tpu.crypto.keys import KeyPair
+
+    kps = [
+        KeyPair.deterministic(i.to_bytes(4, "little"))
+        for i in range(distinct_keys)
+    ]
+    items = []
+    for i in range(n):
+        kp = kps[i % distinct_keys]
+        d = hashlib.sha256(b"r07-%d" % i).digest()
+        items.append((kp.public, d, kp.sign_digest(d)))
+    return items
+
+
+def kernel_comparison(lanes: list, trials: int) -> dict:
+    """Paired ladder vs RLC-MSM wall times at each lane count."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from hyperdrive_tpu.ops.ed25519_jax import (
+        Ed25519BatchHost,
+        make_rlc_fn,
+        make_verify_fn,
+        rlc_scalars,
+    )
+    from hyperdrive_tpu.ops.msm import msm_plan
+
+    base = 256
+    host = Ed25519BatchHost(buckets=(base,))
+    arrays, prevalid, _ = host.pack(_signed_items(base))
+    vfn, rfn = make_verify_fn(), make_rlc_fn()
+
+    out = {}
+    for n in lanes:
+        reps = n // base
+        arrs = tuple(np.tile(a, (reps, 1)) for a in arrays)
+        pv = np.tile(prevalid, reps)
+        m_nib, z_nib, c_nib = rlc_scalars(arrs[5], arrs[6], pv, b"r07")
+        dev = [jnp.asarray(a) for a in arrs]
+        dm, dz, dc = (jnp.asarray(x) for x in (m_nib, z_nib, c_nib))
+
+        t0 = time.time()
+        np.asarray(vfn(*dev))
+        warm_ladder = time.time() - t0
+        t0 = time.time()
+        assert bool(rfn(*dev[:5], dm, dz, dc))
+        warm_msm = time.time() - t0
+
+        rows = []
+        for t in range(trials):
+            legs = {}
+            for leg in ("ladder", "msm") if t % 2 == 0 else ("msm", "ladder"):
+                t0 = time.time()
+                if leg == "ladder":
+                    np.asarray(vfn(*dev))
+                else:
+                    bool(rfn(*dev[:5], dm, dz, dc))
+                legs[leg] = time.time() - t0
+            rows.append(legs)
+            print(f"  lanes={n} trial={t} {legs}", file=sys.stderr)
+        ratios = sorted(r["ladder"] / r["msm"] for r in rows)
+        out[str(n)] = {
+            "trials": rows,
+            "p50_ladder_over_msm": ratios[len(ratios) // 2],
+            "warmup_s": {"ladder": warm_ladder, "msm": warm_msm},
+            "msm_plan_64w": msm_plan(n, 64),
+        }
+    return out
+
+
+def culprit_parity(n: int = 64) -> dict:
+    """One forged lane: the RLC reject must isolate the exact culprit
+    the ladder isolates (fallback re-verify), masks bit-identical."""
+    import numpy as np
+
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+    items = _signed_items(n)
+    # Forge with a WELL-FORMED signature (same key, wrong digest): it
+    # survives host prevalidation, so the reject must come from the RLC
+    # combined equation and the per-signature fallback must isolate it.
+    from hyperdrive_tpu.crypto.keys import KeyPair
+
+    kp = KeyPair.deterministic((n - 1).to_bytes(4, "little"))
+    wrong = kp.sign_digest(hashlib.sha256(b"r07-forged").digest())
+    items[-1] = (items[-1][0], items[-1][1], wrong)
+
+    ladder = TpuBatchVerifier(buckets=(n,), rlc=False)
+    rlc = TpuBatchVerifier(buckets=(n,), rlc=True)
+    m_l = np.asarray(ladder.verify_signatures(items))
+    m_r = np.asarray(rlc.verify_signatures(items))
+    return {
+        "masks_equal": bool((m_l == m_r).all()),
+        "culprit_isolated": bool(m_l[:-1].all() and not m_l[-1]),
+        "rlc_fallbacks": rlc.rlc_fallbacks,
+        "transcript_bytes": len(rlc.last_transcript),
+    }
+
+
+def certificate_sweep() -> dict:
+    """Marshalled certificate bytes vs validator count, one O(1)
+    re-verify timed per size."""
+    from hyperdrive_tpu.certificates import (
+        Certifier,
+        certificate_size,
+        marshal_certificate,
+    )
+    from hyperdrive_tpu.codec import Writer
+
+    rows = {}
+    for n in (256, 512, 1024):
+        f = (n - 1) // 3
+        validators = [
+            hashlib.sha256(b"v%d" % i).digest() for i in range(n)
+        ]
+        c = Certifier(validators, f, transcript_source=lambda: b"\x07" * 32)
+        cert = c.observe_commit(1, 0, b"r07-value", validators[: 2 * f + 1])
+        w = Writer()
+        marshal_certificate(cert, w)
+        t0 = time.time()
+        ok = c.verify(cert)
+        verify_s = time.time() - t0
+        assert ok and len(w.data()) == certificate_size(n)
+        rows[str(n)] = {
+            "certificate_bytes": len(w.data()),
+            "sigset_bytes": 64 * (2 * f + 1),
+            "ratio": 64 * (2 * f + 1) / len(w.data()),
+            "o1_verify_s": verify_s,
+        }
+    return rows
+
+
+def pipelined_cert_digest_check() -> dict:
+    """Pipelined and sequential schedules must mint identical commit
+    AND certificate chains (the r06 guarantee extended to certs)."""
+    from hyperdrive_tpu.harness.sim import Simulation
+
+    kw = dict(
+        n=4, target_height=6, seed=7, sign=True, burst=True,
+        certificates=True,
+    )
+    seq = Simulation(**kw).run()
+    pipe = Simulation(pipeline_heights=True, **kw).run()
+    return {
+        "commit_digests_equal": seq.commit_digest() == pipe.commit_digest(),
+        "cert_digests_equal": seq.cert_digests == pipe.cert_digests,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--lanes", type=int, nargs="+", default=[16384, 65536])
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--skip-kernels", action="store_true",
+                    help="certificate + parity legs only (no big compiles)")
+    ap.add_argument("-o", "--out", default=os.path.join(REPO, "BENCH_r07.json"))
+    args = ap.parse_args(argv)
+
+    result = {
+        "measured_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        "certificates": certificate_sweep(),
+        "pipelined": pipelined_cert_digest_check(),
+        "culprit": culprit_parity(),
+    }
+    if not args.skip_kernels:
+        result["kernels"] = kernel_comparison(args.lanes, args.trials)
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

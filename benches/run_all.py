@@ -51,8 +51,9 @@ sys.path.insert(0, REPO)
 
 #: Whether config 4's verifier uses the RLC fast path (set from the
 #: measured kernel comparison; the per-signature kernel remains the
-#: fallback and the correctness anchor either way).
-RLC_DEFAULT = False
+#: fallback and the correctness anchor either way). BENCH_r07.json's
+#: paired medians — 2.56x at 16384 lanes, 3.57x at 65536 — flip this on.
+RLC_DEFAULT = True
 
 
 def _sim_metrics(sim, res, wall: float) -> dict:
@@ -1441,6 +1442,30 @@ def write_bench_md(results):
             + (f"; spread {min(t1k) / 1e3:.1f}-{max(t1k) / 1e3:.1f}k"
                if t1k else "") + ", config 7 probe)"
         )
+    r07_path = os.path.join(REPO, "BENCH_r07.json")
+    if os.path.exists(r07_path):
+        with open(r07_path) as fh:
+            r07 = json.load(fh)
+        kern = r07.get("kernels", {})
+        ratios = ", ".join(
+            f"{k} lanes {v['p50_ladder_over_msm']:.2f}x"
+            for k, v in sorted(kern.items(), key=lambda kv: int(kv[0]))
+        )
+        if ratios:
+            head.append(
+                f"RLC-MSM batch verify: {ratios} over the per-signature "
+                "ladder (paired per-trial medians, BENCH_r07.json; "
+                "benches/msm_bench.py)"
+            )
+        certs = r07.get("certificates", {}).get("1024")
+        if certs:
+            head.append(
+                "quorum certificates: "
+                f"{certs['certificate_bytes']} B commit proof at 1024 "
+                f"validators vs {certs['sigset_bytes'] / 1e3:.1f} KB of "
+                f"re-gossiped signatures ({certs['ratio']:.0f}x, O(1) "
+                "re-verify; BENCH_r07.json)"
+            )
     if head:
         lines += [
             "Headline sustained-verification rates (medians of the "

@@ -4,7 +4,7 @@ Usage::
 
     python -m hyperdrive_tpu.chaos soak [--scenarios N] [--seed S]
         [--n N_REPLICAS] [--target H] [--out DIR] [--replay-every K]
-        [--pipelined-every K]
+        [--pipelined-every K] [--certs-every K]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -26,6 +26,7 @@ on every replica — CI runs the soak that way.
 from __future__ import annotations
 
 import argparse
+import hashlib
 import os
 import random
 import sys
@@ -38,9 +39,12 @@ from hyperdrive_tpu.harness.sim import ScenarioRecord, Simulation
 _SEED_STRIDE = 9973
 
 
-def _build(scen_seed: int, n: int, target: int, pipelined: bool = False):
+def _build(scen_seed: int, n: int, target: int, pipelined: bool = False,
+           certificates: bool = False):
     plan = FaultPlan.seeded(scen_seed, n)
     extra = {}
+    if certificates:
+        extra["certificates"] = True
     if pipelined:
         # Queue-backed settle path: every replica flushes through one
         # shared async device-work queue (jax-free QueueFlusher), so
@@ -99,6 +103,43 @@ def soak(args) -> int:
                     raise InvariantViolation(
                         "replay", "replayed commits diverge from live run"
                     )
+            if args.certs_every and k % args.certs_every == 0:
+                # Re-run the same plan with quorum certificates minted
+                # at every commit: partitions, crashes, and heals must
+                # not bend the chain (digest-identical to the baseline
+                # run), every surviving certificate must match the
+                # committed value it proves, and each must still pass
+                # its O(1) re-verification.
+                _, csim = _build(
+                    scen_seed, n, args.target, certificates=True
+                )
+                cmon = InvariantMonitor(csim)
+                cresult = csim.run(max_steps=args.max_steps)
+                cmon.check_final(cresult)
+                if cresult.commit_digest() != result.commit_digest():
+                    raise InvariantViolation(
+                        "certificates",
+                        "certificate-carrying chain diverges from baseline",
+                    )
+                for i, certifier in enumerate(csim.certifiers):
+                    for ch, cert in certifier.certs.items():
+                        v = cresult.commits[i].get(ch)
+                        if (
+                            v is not None
+                            and cert.value_digest
+                            != hashlib.sha256(v).digest()
+                        ):
+                            raise InvariantViolation(
+                                "certificates",
+                                f"certificate digest mismatch at "
+                                f"height {ch}",
+                            )
+                        if not certifier.verify(cert):
+                            raise InvariantViolation(
+                                "certificates",
+                                f"certificate failed O(1) re-verify at "
+                                f"height {ch}",
+                            )
             if args.pipelined_every and k % args.pipelined_every == 0:
                 # Re-run the same plan with settles pipelined through
                 # the shared device-work queue: the monitor must stay
@@ -175,6 +216,13 @@ def main(argv=None) -> int:
         default=4,
         help="re-run every Kth plan with devsched-pipelined settles and "
         "cross-check the commit digest (0 = off)",
+    )
+    p.add_argument(
+        "--certs-every",
+        type=int,
+        default=4,
+        help="re-run every Kth plan with quorum certificates enabled and "
+        "cross-check chain digests + certificate integrity (0 = off)",
     )
     p.add_argument("--keep-going", action="store_true")
     p.set_defaults(fn=soak)

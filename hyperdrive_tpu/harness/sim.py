@@ -331,6 +331,11 @@ class SimulationResult:
     commits: list[dict[Height, Value]]
     record: "ScenarioRecord | None"  # None when the run had record=False
     alive: list[bool]
+    #: Per-replica certificate chain digests (certificates=True runs
+    #: only): ``Certifier.chain_digest()`` in replica order — the O(1)
+    #: commit-proof sibling of :meth:`commit_digest` for pipelined ==
+    #: sequential and cross-replica equality checks.
+    cert_digests: "list[str] | None" = None
 
     def assert_safety(self) -> None:
         """All replicas — including ones that later died — must agree
@@ -413,6 +418,7 @@ class Simulation:
         observe: bool = False,
         obs_capacity: int = 65536,
         chaos=None,
+        certificates: bool = False,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -518,6 +524,13 @@ class Simulation:
 
         self.burst = burst
         self.batch_verifier = batch_verifier
+        #: certificates=True: every replica's Process carries a
+        #: certificates.Certifier minting a constant-size
+        #: QuorumCertificate at each commit (transcript-bound to the
+        #: settle layer's batch verifier when one is installed); chain
+        #: digests land in SimulationResult.cert_digests.
+        self.certificates_on = bool(certificates)
+        self.certifiers: list = []
         self.dedup_verify = dedup_verify
         #: Small-window host routing for device-backed verifiers: a
         #: propose settle is 1-2 signatures, and on a tunnel-attached
@@ -1047,6 +1060,23 @@ class Simulation:
             if not byz_validator:
                 validator = _PayloadValidator(self)
 
+        certifier = None
+        if self.certificates_on:
+            from hyperdrive_tpu.certificates import Certifier
+
+            certifier = Certifier(
+                list(self.signatories),
+                self.f,
+                # Bind the settle layer's batch verifier lazily: its
+                # last_transcript is the launch that verified this
+                # commit's quorum (b"" on unsigned/ladder paths).
+                transcript_source=lambda: getattr(
+                    self.batch_verifier, "last_transcript", b""
+                ),
+                obs=self.obs.scoped(i),
+            )
+            self.certifiers.append(certifier)
+
         return Replica(
             ReplicaOptions(
                 max_capacity=capacity,
@@ -1076,6 +1106,7 @@ class Simulation:
                 if self._flusher_for is not None
                 else None
             ),
+            certifier=certifier,
         )
 
     # -------------------------------------------------------------- running
@@ -1131,7 +1162,7 @@ class Simulation:
                     r.start()
         obs = self._obs_sim
         if obs is _OBS_NULL:
-            return self._run_delivery(max_steps)
+            return self._finish(self._run_delivery(max_steps))
         # Observed run: tap every device_fetch for the journal. The
         # observer is a module global (annotations.py), so install/remove
         # brackets the run — nested observed sims are not a thing.
@@ -1141,9 +1172,18 @@ class Simulation:
             lambda why: obs.emit("fetch.sync", -1, -1, why or None)
         )
         try:
-            return self._run_delivery(max_steps)
+            return self._finish(self._run_delivery(max_steps))
         finally:
             set_fetch_observer(None)
+
+    def _finish(self, result: SimulationResult) -> SimulationResult:
+        """Post-run stamping: certificate chain digests (certificates=
+        True runs) ride the result for equality checks."""
+        if self.certifiers:
+            result.cert_digests = [
+                c.chain_digest() for c in self.certifiers
+            ]
+        return result
 
     def _run_delivery(self, max_steps: int) -> SimulationResult:
         """The delivery loop behind :meth:`run` (burst or lock-step)."""
@@ -2019,13 +2059,15 @@ class Simulation:
         """
         begin = getattr(self.batch_verifier, "verify_signatures_begin",
                         None)
+        from hyperdrive_tpu.ops.bucketing import launch_target
+
         buckets = getattr(
             getattr(self.batch_verifier, "host", None), "buckets", None
         )
         # Group so one launch carries about one verify bucket of lanes:
         # finer groups pay launch overhead, coarser ones leave nothing
         # in flight to hide behind the cascade.
-        target = buckets[-1] if buckets else 4096
+        target = launch_target(buckets)
         per_win = max(len(w) for _, w in windows)
         gsize = max(1, target // max(per_win, 1))
         groups = [
@@ -2183,13 +2225,10 @@ class Simulation:
             buckets = getattr(
                 getattr(self.batch_verifier, "host", None), "buckets", None
             )
-            if buckets and self._spec_rows:
-                from hyperdrive_tpu.ops.bucketing import bucket_for
+            from hyperdrive_tpu.ops.bucketing import would_spill
 
-                if bucket_for(
-                    self._spec_rows + len(items), buckets
-                ) > bucket_for(self._spec_rows, buckets):
-                    sched.drain()
+            if would_spill(self._spec_rows, len(items), buckets):
+                sched.drain()
             # Account BEFORE submit: submit may auto-drain at max_depth
             # (resolving this very command and zeroing the counters via
             # _on_sched_drain) — incrementing afterwards would record a

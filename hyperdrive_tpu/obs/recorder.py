@@ -63,6 +63,11 @@ EVENT_KINDS = frozenset(
         "verify.launch",
         "verify.rlc.verdict",
         "verify.rlc.fallbacks",
+        "verify.msm.windows",
+        "verify.msm.occupancy",
+        "verify.msm.depth",
+        "cert.emit",
+        "cert.verify",
         "tally.launch",
         "sched.submit",
         "sched.coalesce",

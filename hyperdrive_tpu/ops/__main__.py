@@ -1,0 +1,183 @@
+"""Ops kernel smokes: differential parity for the device crypto path.
+
+Usage::
+
+    python -m hyperdrive_tpu.ops msm-parity [--n N] [--windows W]
+        [--seed S] [--rlc]
+
+``msm-parity`` drives :func:`hyperdrive_tpu.ops.msm.msm_kernel` against
+the host curve reference (``crypto/ed25519.py`` scalar_mult/point_add)
+on random points and scalars — the Pippenger bucketing, group combine,
+and window Horner must land on the exact affine point the serial
+reference computes, or exit 1. ``--rlc`` adds the end-to-end leg: real
+signatures through ``TpuBatchVerifier(rlc=True)`` (whose rlc_kernel
+drives two MSMs) versus the per-signature ladder, including a forged
+lane to prove the culprit-isolation fallback masks identically.
+
+Shapes stay tiny (the fori-loop kernels compile once regardless of
+window count, so the compile bill is flat and the .jax_cache-warmed CI
+run is seconds); HD_SANITIZE=1 in the environment arms the runtime
+sanitizer exactly as the devsched parity smoke does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+# Standalone-CLI compile cache: tests get this from conftest.py; the CI
+# smoke reuses the same .jax_cache checkout path so warmed runs skip the
+# XLA compile entirely.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", ".jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2.0")
+
+
+def _host_affine(p):
+    """Extended homogeneous (X, Y, Z, T) -> affine (x, y). The host
+    curve ops keep Z != 1, so anything packed for the kernel (which
+    assumes z = 1) or compared against it must normalize first."""
+    from hyperdrive_tpu.crypto import ed25519 as hed
+
+    x, y, z, _ = p
+    zinv = pow(z, hed.P - 2, hed.P)
+    return (x * zinv) % hed.P, (y * zinv) % hed.P
+
+
+def _host_msm(points, scalars):
+    """Serial reference: sum [s_i]P_i (affine inputs) via the host curve
+    arithmetic; returns the affine sum."""
+    from hyperdrive_tpu.crypto import ed25519 as hed
+
+    acc = hed.IDENTITY
+    for (x, y), s in zip(points, scalars):
+        ext = (x, y, 1, x * y % hed.P)
+        acc = hed.point_add(acc, hed.scalar_mult(s, ext))
+    return _host_affine(acc)
+
+
+def msm_parity(args) -> int:
+    import numpy as np
+
+    from hyperdrive_tpu.crypto import ed25519 as hed
+    from hyperdrive_tpu.ops import fe25519 as fe
+    from hyperdrive_tpu.ops.ed25519_jax import _recode_signed
+    from hyperdrive_tpu.ops.msm import msm_kernel, msm_plan
+
+    rng = random.Random(args.seed)
+    n, windows = args.n, args.windows
+    bits = 4 * windows
+
+    points, scalars = [], []
+    for _ in range(n):
+        k = rng.randrange(1, hed.L)
+        points.append(_host_affine(hed.scalar_mult(k, hed.BASE)))
+        scalars.append(rng.randrange(0, min(1 << bits, 2**252)))
+
+    px = np.stack([fe.to_limbs(p[0]) for p in points])
+    py = np.stack([fe.to_limbs(p[1]) for p in points])
+    pt = np.stack([fe.to_limbs(p[0] * p[1] % hed.P) for p in points])
+    # One extra zero nibble absorbs the signed-recode carry out of the
+    # top window (same reason rlc_kernel runs 33 windows for 128-bit z).
+    nibs = np.array(
+        [
+            [(s >> (4 * w)) & 0xF for w in range(windows + 1)]
+            for s in scalars
+        ],
+        dtype=np.int32,
+    )
+    digits = np.asarray(_recode_signed(nibs))
+
+    sx, sy, sz, _ = msm_kernel(px, py, pt, digits)
+    zi = pow(int(fe.from_limbs(np.asarray(sz))[0]), hed.P - 2, hed.P)
+    got = (
+        int(fe.from_limbs(np.asarray(sx))[0]) * zi % hed.P,
+        int(fe.from_limbs(np.asarray(sy))[0]) * zi % hed.P,
+    )
+    want = _host_msm(points, scalars)
+    plan = msm_plan(n, windows)
+    ok = got == want
+    print(
+        f"{'ok' if ok else 'FAIL'} msm-kernel: n={n} windows={windows} "
+        f"groups={plan['groups']}x{plan['group_size']} "
+        f"depth={plan['reduction_depth']} "
+        f"{'matches host reference' if ok else f'{got} != {want}'}"
+    )
+    return 0 if ok else 1
+
+
+def rlc_parity(args) -> int:
+    import hashlib
+
+    import numpy as np
+
+    from hyperdrive_tpu.crypto.keys import KeyPair
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+    items = []
+    for i in range(args.n):
+        kp = KeyPair.deterministic(b"msm-parity-%d" % i)
+        digest = hashlib.sha256(f"msg-{i}".encode()).digest()
+        items.append((kp.public, digest, kp.sign_digest(digest)))
+    # One forged lane — a WELL-FORMED signature over the wrong digest
+    # (a mangled encoding would be caught by host prevalidation and
+    # never reach the batch equation): the RLC combined check must fail
+    # the chunk and the per-signature fallback must isolate exactly
+    # this culprit.
+    kp = KeyPair.deterministic(b"msm-parity-%d" % (args.n - 1))
+    wrong = hashlib.sha256(b"msm-parity-forged").digest()
+    items[-1] = (items[-1][0], items[-1][1], kp.sign_digest(wrong))
+
+    buckets = (64,)
+    ladder = TpuBatchVerifier(buckets=buckets, rlc=False)
+    rlc = TpuBatchVerifier(buckets=buckets, rlc=True)
+    m_ladder = np.asarray(ladder.verify_signatures(items))
+    m_rlc = np.asarray(rlc.verify_signatures(items))
+    ok = bool(
+        (m_ladder == m_rlc).all()
+        and m_ladder[:-1].all()
+        and not m_ladder[-1]
+        and rlc.rlc_fallbacks >= 1
+        and len(rlc.last_transcript) == 32
+    )
+    print(
+        f"{'ok' if ok else 'FAIL'} rlc-msm: n={len(items)} "
+        f"masks {'==' if (m_ladder == m_rlc).all() else '!='} "
+        f"fallbacks={rlc.rlc_fallbacks} "
+        f"transcript={rlc.last_transcript.hex()[:16]}"
+    )
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m hyperdrive_tpu.ops")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser(
+        "msm-parity",
+        help="Pippenger MSM vs host curve reference differential smoke",
+    )
+    p.add_argument("--n", type=int, default=37)
+    p.add_argument(
+        "--windows", type=int, default=16,
+        help="4-bit scalar windows (scalar width = 4*windows bits)",
+    )
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument(
+        "--rlc", action="store_true",
+        help="also run real signatures through the RLC-MSM verifier vs "
+        "the per-signature ladder (adds the verify-kernel compile)",
+    )
+    args = ap.parse_args(argv)
+    rc = msm_parity(args)
+    if args.rlc:
+        rc = rlc_parity(args) or rc
+    if rc == 0:
+        print("msm parity ok")
+    else:
+        print("msm parity FAILED", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -303,24 +303,24 @@ def make_verify_fn(jit: bool = True):
 #
 #     [c]B == Σ_i ( [z_i]R_i + [m_i]A_i )         (w.h.p. over z)
 #
-# The intended win is structural: the per-signature Horner loops of
+# The win is structural: the per-signature Horner loops of
 # `verify_kernel` each carry their own accumulator (64 windows × 4
-# doublings per signature), while the batch sum above runs ONE Straus
-# ladder — per window, select each signature's table entry, tree-sum them
-# across the whole batch, and fold into a single shared accumulator. The
-# doubling work collapses from per-signature to per-window, cutting field
-# multiplications per signature ~1.75x (~2.9k → ~1.6k mul-equivalents).
+# doublings per signature), while the batch sum above reduces the whole
+# batch through ONE Pippenger multi-scalar multiplication
+# (:mod:`hyperdrive_tpu.ops.msm`): windowed signed-digit decomposition,
+# bucket accumulation as fixed-shape batched niels additions, bucket
+# suffix-sums, and a single shared window-Horner accumulator. Per lane
+# per window that is ~7 field muls against the ladder's ~22, and the
+# doubling work collapses from per-signature to per-window.
 #
-# MEASURED OUTCOME (v5e, B=16384): ~40k votes/s vs ~59k for the
-# per-signature kernel — the op-count win does NOT materialize on TPU.
-# The per-signature kernel is embarrassingly parallel with zero
-# cross-lane data movement, while the Straus tree's per-window
-# concatenate + halving reductions break XLA fusion and add layout
-# traffic that costs more than the saved doublings. The kernel is kept
-# correct, differentially tested, and off by default (TpuBatchVerifier
-# rlc=False) as the honest record of the experiment; on hardware where
-# cross-lane reduction is cheaper relative to ALU (or with a fused Pallas
-# reduction) the balance may flip.
+# HISTORY: the first cut of this kernel was a shared Straus walk whose
+# per-window tree-sum concatenates broke XLA fusion — measured ~40k
+# votes/s vs ~59k for the per-signature kernel on v5e at B=16384, and it
+# shipped off by default as the honest record of that experiment. The
+# Pippenger rewrite removes every concatenate from the hot loop (one-hot
+# bucket blends over a static [G, 9] layout instead); BENCH_r07.json
+# carries the paired ladder-vs-MSM medians that flipped the default (see
+# TpuBatchVerifier: rlc="auto" resolves per backend + bucket ladder).
 #
 # A batch mismatch falls back to `verify_kernel` to identify culprits.
 # Acceptance semantics: the kernel cofactor-clears the combined sum with
@@ -371,45 +371,6 @@ def _identity_rows(m):
     return (zero, one, one, zero)
 
 
-def _tree_sum(pts, width: int):
-    """Reduce a batch of extended points [M, 20] to [width, 20] by halving
-    additions; M is padded to a power of two with identity rows first, so
-    every level is one full-width vectorized add."""
-    x, y, z, t = pts
-    m = x.shape[0]
-    target = 1 << (m - 1).bit_length()
-    if target != m:
-        ix, iy, iz, it = _identity_rows(target - m)
-        x = jnp.concatenate([x, ix])
-        y = jnp.concatenate([y, iy])
-        z = jnp.concatenate([z, iz])
-        t = jnp.concatenate([t, it])
-        m = target
-    while m > width:
-        h = m // 2
-        x, y, z, t = _add_ext(
-            (x[:h], y[:h], z[:h], t[:h]),
-            (x[h:], y[h:], z[h:], t[h:]),
-            need_t=True,
-        )
-        m = h
-    return x, y, z, t
-
-
-def _scan_table(ax, ay, at):
-    """The 16 multiples [0..15]P of affine points (z=1) as stacked
-    projective extended components [B, 16, 20] each."""
-    bsz = ax.shape[0]
-    k2d = jnp.asarray(_K2D_LIMBS, dtype=jnp.int32)
-    niels = (fe.add(ay, ax), fe.sub(ay, ax), fe.mul(at, k2d))
-
-    def step(pt, _):
-        return _madd(pt, niels, need_t=True), pt
-
-    _, stacked = lax.scan(step, _identity_like((bsz,)), None, length=16)
-    return tuple(jnp.moveaxis(c, 0, 1) for c in stacked)  # [B, 16, 20] x4
-
-
 def rlc_kernel(ax, ay, at, rx, ry, m_nib, z_nib, c_nib):
     """Batched RLC check: does [c]B + Σ([z_i](-R_i) + [m_i](-A_i)) vanish?
 
@@ -421,55 +382,27 @@ def rlc_kernel(ax, ay, at, rx, ry, m_nib, z_nib, c_nib):
       z_nib:      [B, 64] nibbles of z_i (only the low 32 are nonzero)
       c_nib:      [1, 64] nibbles of c = sum z_i*s_i mod L
     Returns: bool [] — True iff the whole batch verifies.
+
+    The batch sum is TWO Pippenger MSMs sharing one engine
+    (:func:`hyperdrive_tpu.ops.msm.msm_kernel`): Σ[m_i](-A_i) over 64
+    signed windows and Σ[z_i](-R_i) over 33 (z is 128-bit; one extra
+    window absorbs the recode carry), instead of the per-lane table
+    walk + tree-sum of the original Straus formulation.
     """
-    bsz = ax.shape[0]
-    # Accumulator width trades per-window work against vector occupancy;
-    # measured on v5e at B=16k, 256 and 2048 perform within noise of each
-    # other (the kernel is not occupancy-bound at either setting).
-    width = min(2048, bsz)
+    from hyperdrive_tpu.ops.msm import msm_kernel
+
     lanes = jnp.arange(16, dtype=jnp.int32)
 
-    ta = _scan_table(ax, ay, at)
+    # Signed-window decomposition. Both scalars satisfy the < 2^253
+    # recode precondition: m and c are reduced mod L, z is 128-bit.
+    m_digits = _recode_signed(m_nib)  # [64, B]
+    z_digits = _recode_signed(z_nib)[:33]  # [33, B]
+
+    t_a = msm_kernel(ax, ay, at, m_digits)
     # -R: negate x and t of the affine point.
     nrx = fe.neg(rx)
-    tr = _scan_table(nrx, ry, fe.mul(nrx, ry))
-
-    acc = _identity_rows(width)
-
-    def high_body(i, acc):
-        w = 63 - i
-        acc = _add_ext(
-            _dbl4_ext(acc),
-            _tree_sum(
-                _point_select(
-                    lanes[None, :]
-                    == lax.dynamic_slice_in_dim(m_nib, w, 1, axis=1),
-                    ta,
-                ),
-                width,
-            ),
-            need_t=True,
-        )
-        return acc
-
-    def low_body(i, acc):
-        w = 31 - i
-        sel_a = _point_select(
-            lanes[None, :] == lax.dynamic_slice_in_dim(m_nib, w, 1, axis=1),
-            ta,
-        )
-        sel_r = _point_select(
-            lanes[None, :] == lax.dynamic_slice_in_dim(z_nib, w, 1, axis=1),
-            tr,
-        )
-        both = tuple(
-            jnp.concatenate([a, r]) for a, r in zip(sel_a, sel_r)
-        )
-        return _add_ext(_dbl4_ext(acc), _tree_sum(both, width), need_t=True)
-
-    acc = lax.fori_loop(0, 32, high_body, acc)
-    acc = lax.fori_loop(0, 32, low_body, acc)
-    t_point = _tree_sum(acc, 1)  # [1, 20] x4
+    t_r = msm_kernel(nrx, ry, fe.mul(nrx, ry), z_digits)
+    t_point = _add_ext(t_a, t_r, need_t=True)  # [1, 20] x4
 
     # [c]B on the shared fixed-base niels table.
     tb = tuple(jnp.asarray(comp, dtype=jnp.int32) for comp in _b_niels_np())
@@ -737,19 +670,43 @@ class TpuBatchVerifier:
     whole mq drain window into one device launch.
 
     ``rlc=True`` verifies each window through the random-linear-combination
-    kernel first, falling back to the per-signature kernel when the
-    combined check fails to identify the culprit lanes. Off by default:
-    measured on v5e the RLC kernel is ~1.5x SLOWER than the per-signature
-    kernel (see the module comment above rlc_kernel), so it exists as a
-    correct, tested alternative rather than the production path.
+    kernel first — ONE Pippenger MSM over the whole chunk
+    (:mod:`hyperdrive_tpu.ops.msm`) — falling back to the per-signature
+    kernel when the combined check fails, to identify the culprit lanes
+    (and for strict cofactorless semantics; see PARITY.md). The default
+    ``rlc="auto"`` flips the fast path on exactly where the paired
+    medians justify it (BENCH_r07.json): the XLA backend with a
+    production-size bucket ladder (top bucket >= 4096 lanes, where the
+    MSM's per-lane op-count collapse dominates its fixed reduction
+    cost). The Pallas ladder backend keeps rlc off — its per-signature
+    kernel is already past 500k sigs/s on v5e and the MSM is not ported
+    to Mosaic. ``HD_RLC=0``/``HD_RLC=1`` force-overrides the resolution
+    either way.
     """
 
-    def __init__(self, buckets=(64, 256, 1024, 4096), rlc: bool = False,
+    def __init__(self, buckets=(64, 256, 1024, 4096), rlc="auto",
                  backend: str = "auto", obs=None):
+        from hyperdrive_tpu.ops.ed25519_pallas import resolve_backend
+
         self.host = Ed25519BatchHost(buckets=buckets)
         self._fn = make_verify_fn(jit=True)
-        self.rlc = rlc
-        self._rlc_fn = make_rlc_fn(jit=True) if rlc else None
+        self.backend = resolve_backend(backend)
+        if rlc == "auto":
+            env = os.environ.get("HD_RLC")
+            if env is not None:
+                rlc = env not in ("0", "")
+            else:
+                rlc = (
+                    self.backend != "pallas"
+                    and bucketing.launch_target(self.host.buckets) >= 4096
+                )
+        self.rlc = bool(rlc)
+        self._rlc_fn = make_rlc_fn(jit=True) if self.rlc else None
+        #: Digest of the last verified chunk's length-framed transcript
+        #: (the RLC binder) — the batch-verify binding that
+        #: :mod:`hyperdrive_tpu.certificates` folds into emitted quorum
+        #: certificates. b"" until the first RLC chunk verifies.
+        self.last_transcript = b""
         #: How many windows fell back to the per-signature kernel.
         self.rlc_fallbacks = 0
         #: Flight-recorder handle (obs/recorder.py; NULL_BOUND = off).
@@ -760,13 +717,11 @@ class TpuBatchVerifier:
         #: binds it when ``observe=True``; deployments pass a scoped
         #: handle.
         self.obs = obs if obs is not None else _OBS_NULL_BOUND
-        # Kernel backend: the Pallas ladder (7.5x the XLA kernel on v5e
-        # — 535.1k vs 70.9k sigs/s in bench.py) on real TPU backends, the
-        # XLA kernel elsewhere (the Mosaic interpreter is far too slow
-        # for production windows; CPU tests run the XLA kernel).
-        from hyperdrive_tpu.ops.ed25519_pallas import resolve_backend
-
-        self.backend = resolve_backend(backend)
+        # Kernel backend (resolved above, before the rlc="auto" decision
+        # that depends on it): the Pallas ladder (7.5x the XLA kernel on
+        # v5e — 535.1k vs 70.9k sigs/s in bench.py) on real TPU backends,
+        # the XLA kernel elsewhere (the Mosaic interpreter is far too
+        # slow for production windows; CPU tests run the XLA kernel).
 
     def _device_verify(self, arrays):
         dev_in = [jnp.asarray(a) for a in arrays]
@@ -827,7 +782,7 @@ class TpuBatchVerifier:
         items = list(items)
         if not items:
             return np.zeros(0, dtype=bool)
-        cap = self.host.buckets[-1]
+        cap = bucketing.launch_target(self.host.buckets)
         pending = []
         for lo in range(0, len(items), cap):
             chunk = items[lo : lo + cap]
@@ -858,6 +813,26 @@ class TpuBatchVerifier:
                 m_nib, z_nib, c_nib = rlc_scalars(
                     arrays[5], arrays[6], prevalid, binder
                 )
+                import hashlib as _hl
+
+                self.last_transcript = _hl.sha256(binder).digest()
+                if self.obs is not _OBS_NULL_BOUND:
+                    from hyperdrive_tpu.ops.msm import msm_plan
+
+                    plan = msm_plan(arrays[0].shape[0], 64 + 33)
+                    occ = (
+                        np.count_nonzero(m_nib) + np.count_nonzero(z_nib)
+                    ) / max(m_nib.size + z_nib.size, 1)
+                    self.obs.emit(
+                        "verify.msm.windows", -1, -1, plan["windows"]
+                    )
+                    self.obs.emit(
+                        "verify.msm.occupancy", -1, -1, round(occ, 4)
+                    )
+                    self.obs.emit(
+                        "verify.msm.depth", -1, -1,
+                        plan["reduction_depth"],
+                    )
                 dev = self._rlc_fn(
                     *(jnp.asarray(a) for a in arrays[:5]),
                     jnp.asarray(m_nib),

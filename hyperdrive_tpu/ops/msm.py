@@ -1,0 +1,220 @@
+"""Pippenger multi-scalar multiplication on TPU.
+
+Computes Q = sum_i [s_i]P_i for a whole batch of points in ONE kernel —
+the reduction engine behind the RLC batch-verify fast path
+(:func:`hyperdrive_tpu.ops.ed25519_jax.rlc_kernel`): instead of walking a
+shared Straus ladder whose per-window tree-sum concatenates break XLA
+fusion, the batch is bucketed the classic Pippenger way and every stage
+is a fixed-shape batched point operation.
+
+Shape of the algorithm (c = 4-bit signed windows, digits in [-8, 8]):
+
+1. **Windowed decomposition** (host or caller): each scalar becomes one
+   signed digit per window (:func:`~hyperdrive_tpu.ops.ed25519_jax.
+   _recode_signed`); the kernel takes the [W, N] digit tensor.
+2. **Bucket accumulation**: lanes are folded into G independent groups
+   of g lanes; each group owns 8 buckets (|digit| = 1..8, digit 0 and
+   padding fall into a write-only trash slot) and serially folds its g
+   lanes in — every fold is one [G]-wide niels addition plus a one-hot
+   select/blend, so all groups advance in lock step on the vector units
+   and no gather/scatter ever materializes (gathers scatter badly on
+   TPU; a [G, 9] one-hot contraction rides the MXU/VPU like the
+   verify kernel's table selects).
+3. **Group combine**: the G per-group bucket arrays reduce to one by a
+   halving tree of [G/2, 8]-wide additions — log2(G) full-width levels,
+   no concatenates (identity padding happens once, at layout time).
+4. **Bucket-sum + window Horner**: the 8 buckets collapse with the
+   suffix-sum identity sum_v v*S_v = sum_v (S_8 + ... + S_v), then the
+   per-window sums fold high-to-low through the standard 4-doublings
+   Horner accumulator.
+
+Cost per lane per window is ~7 field muls (one niels add) plus the
+amortized group combine (72/g muls), against the per-signature ladder's
+4 doublings + 2 table adds — the op-count collapse the EdDSA batch-
+verification literature banks on (PAPERS.md: "Performance of EdDSA and
+BLS Signatures in Committee-Based Consensus").
+
+Points are affine extended (z = 1, t = x*y) int32 limb tensors from the
+:mod:`~hyperdrive_tpu.ops.fe25519` layout; the kernel is backend-neutral
+XLA (same dialect as verify_kernel) and is exercised on CPU and TPU
+alike. See /opt guides' Pallas notes for why the inner loop avoids
+data-dependent addressing entirely.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from hyperdrive_tpu.ops import fe25519 as fe
+from hyperdrive_tpu.ops.ed25519_jax import (
+    _add_ext,
+    _dbl,
+    _identity_rows,
+    _madd,
+)
+
+__all__ = ["msm_kernel", "plan_groups", "msm_plan"]
+
+#: Signed 4-bit windows: |digit| <= 8, bucket values 1..8 plus the
+#: write-only trash slot at index 0 (digit 0 / padding lanes land there).
+N_BUCKETS = 8
+
+
+def plan_groups(n: int) -> tuple[int, int]:
+    """(G, g): group count and per-group serial depth for an n-lane MSM.
+
+    G is a power of two so the combine tree halves cleanly; g ~ 64 keeps
+    the per-window combine overhead (~72/g muls per lane) near 1 mul
+    while G stays wide enough to fill the vector units. Small batches
+    floor at G = 8 — narrower groups would serialize the whole kernel.
+    """
+    g_target = max(1, n // 64)
+    G = 8
+    while G * 2 <= min(1024, g_target):
+        G *= 2
+    if n < 8:
+        G = 1
+    g = -(-n // G)  # ceil
+    return G, g
+
+
+def msm_plan(n: int, windows: int) -> dict:
+    """Static launch geometry for observability (`verify.msm.*` events)
+    and benchmarks: window count, bucket occupancy denominator, and the
+    reduction depth (combine-tree levels + bucket suffix chain)."""
+    G, g = plan_groups(n)
+    depth = (G - 1).bit_length() + (N_BUCKETS - 1)
+    return {
+        "windows": windows,
+        "groups": G,
+        "group_size": g,
+        "buckets": N_BUCKETS,
+        "reduction_depth": depth,
+    }
+
+
+def _niels_affine(px, py, pt):
+    """Affine point batch -> niels components (y+x, y-x, 2d*t)."""
+    from hyperdrive_tpu.ops.ed25519_jax import _K2D_LIMBS
+
+    k2d = jnp.asarray(_K2D_LIMBS, dtype=jnp.int32)
+    return (fe.add(py, px), fe.sub(py, px), fe.mul(pt, k2d))
+
+
+def _accumulate_window(digits_w, niels_r, G: int, g: int):
+    """One window's bucket accumulation: fold g lanes into each of G
+    groups' 9-slot bucket arrays (slot 0 = trash). ``digits_w``: [G, g]
+    signed; ``niels_r``: niels components reshaped [G, g, 20]. Returns
+    extended bucket components, each [G, 9, 20]."""
+    yp_r, ym_r, t2_r = niels_r
+    lanes9 = jnp.arange(N_BUCKETS + 1, dtype=jnp.int32)
+
+    zero = jnp.zeros((G, N_BUCKETS + 1, fe.N_LIMBS), dtype=jnp.int32)
+    one = jnp.broadcast_to(
+        jnp.asarray(fe.ONE, dtype=jnp.int32),
+        (G, N_BUCKETS + 1, fe.N_LIMBS),
+    )
+    buckets = (zero, one, one, zero)
+
+    def lane_step(j, buckets):
+        d = lax.dynamic_slice_in_dim(digits_w, j, 1, axis=1)[:, 0]  # [G]
+        sign = d < 0
+        oh = (lanes9[None, :] == jnp.abs(d)[:, None]).astype(jnp.int32)
+        # Read: one-hot contraction picks each group's target bucket.
+        cur = tuple(
+            jnp.einsum("gv,gvl->gl", oh, comp) for comp in buckets
+        )
+        # This lane's niels entry, negated when the digit is (swap the
+        # y+-x pair, negate the 2d*t component — as _select_signed).
+        yp = lax.dynamic_slice_in_dim(yp_r, j, 1, axis=1)[:, 0]
+        ym = lax.dynamic_slice_in_dim(ym_r, j, 1, axis=1)[:, 0]
+        t2 = lax.dynamic_slice_in_dim(t2_r, j, 1, axis=1)[:, 0]
+        entry = (
+            fe.select(sign, ym, yp),
+            fe.select(sign, yp, ym),
+            fe.select(sign, fe.neg(t2), t2),
+        )
+        new = _madd(cur, entry, need_t=True)  # [G, 20] x4
+        # Write back: blend the updated bucket into its slot only.
+        mask = oh[:, :, None] == 1
+        return tuple(
+            jnp.where(mask, comp_new[:, None, :], comp)
+            for comp, comp_new in zip(buckets, new)
+        )
+
+    return lax.fori_loop(0, g, lane_step, buckets)
+
+
+def _combine_groups(buckets, G: int):
+    """Halving tree over the group axis: [G, 9, 20] components -> [8, 20]
+    (the trash slot is dropped before the first level)."""
+    comps = tuple(comp[:, 1:] for comp in buckets)  # [G, 8, 20]
+    m = G
+    while m > 1:
+        h = m // 2
+        comps = _add_ext(
+            tuple(c[:h] for c in comps),
+            tuple(c[h:m] for c in comps),
+            need_t=True,
+        )
+        m = h
+    return tuple(c[0] for c in comps)  # [8, 20] x4
+
+
+def _bucket_reduce(buckets8):
+    """sum_v v*S_v via suffix sums: runtot = S_8 + ... + S_v accumulates
+    into the window sum with 2*(buckets-1) width-1 additions."""
+    def slot(v):
+        return tuple(c[v - 1 : v] for c in buckets8)  # [1, 20] x4
+
+    runtot = slot(N_BUCKETS)
+    wsum = runtot
+    for v in range(N_BUCKETS - 1, 0, -1):
+        runtot = _add_ext(runtot, slot(v), need_t=True)
+        wsum = _add_ext(wsum, runtot, need_t=True)
+    return wsum
+
+
+def msm_kernel(px, py, pt, digits):
+    """sum_i [s_i]P_i over affine extended points, scalars pre-decomposed
+    to signed 4-bit windows.
+
+    Args (all int32):
+      px, py, pt: [N, 20] affine extended coords (z = 1, t = x*y mod p)
+      digits:     [W, N] signed window digits in [-8, 8], window 0 least
+                  significant (the caller recodes nibbles; see
+                  ``_recode_signed``)
+    Returns: the sum as an extended projective point, [1, 20] x4.
+
+    Padding lanes are free: a zero digit routes its (arbitrary) point to
+    the trash bucket, so callers pad with anything shape-compatible.
+    """
+    n = px.shape[0]
+    windows = digits.shape[0]
+    G, g = plan_groups(n)
+    pad = G * g - n
+
+    niels = _niels_affine(px, py, pt)
+    if pad:
+        zrow = jnp.zeros((pad, fe.N_LIMBS), dtype=jnp.int32)
+        niels = tuple(jnp.concatenate([c, zrow]) for c in niels)
+        digits = jnp.concatenate(
+            [digits, jnp.zeros((windows, pad), dtype=digits.dtype)], axis=1
+        )
+    niels_r = tuple(c.reshape(G, g, fe.N_LIMBS) for c in niels)
+    digits_r = digits.reshape(windows, G, g)
+
+    def window_body(i, acc):
+        w = windows - 1 - i
+        # Horner shift: one 4-bit window = four doublings (T on the last).
+        acc3 = acc[:3]
+        for _ in range(3):
+            acc3 = _dbl(acc3, need_t=False)
+        acc = _dbl(acc3, need_t=True)
+        dw = lax.dynamic_slice_in_dim(digits_r, w, 1, axis=0)[0]  # [G, g]
+        buckets = _accumulate_window(dw, niels_r, G, g)
+        wsum = _bucket_reduce(_combine_groups(buckets, G))
+        return _add_ext(acc, wsum, need_t=True)
+
+    return lax.fori_loop(0, windows, window_body, _identity_rows(1))

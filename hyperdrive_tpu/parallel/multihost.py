@@ -75,6 +75,35 @@ class ShardVerifyService:
         self._launcher = self.queue.verify_launcher(verifier)
         #: Commands submitted per tenant key (observability).
         self.tenants: dict = {}
+        #: tenant -> {height -> QuorumCertificate}: O(1) commit proofs
+        #: accepted through :meth:`accept_certificate`. A proof that
+        #: fails the certifier's check never lands here.
+        self.certificates: dict = {}
+
+    def certifier(self, signatories, f, obs=None):
+        """A :class:`~hyperdrive_tpu.certificates.Certifier` for one
+        tenant, transcript-bound to this service's shared launcher — its
+        certificates commit to the coalesced launch that verified the
+        quorum, whichever tenants co-submitted into it."""
+        from hyperdrive_tpu.certificates import Certifier
+
+        return Certifier(
+            signatories, f,
+            transcript_source=lambda: self._launcher.last_transcript,
+            obs=obs,
+        )
+
+    def accept_certificate(self, tenant, certifier, cert) -> bool:
+        """Cross-tenant commit-proof exchange: re-verify ``cert`` in
+        O(1) against ``certifier`` (quorum weight + binding; no
+        signatures re-checked, no vote set re-gossiped) and register it
+        under ``tenant`` on success. This replaces shipping the 2f+1
+        precommits a remote shard would otherwise need to trust the
+        commit."""
+        if not certifier.verify(cert):
+            return False
+        self.certificates.setdefault(tenant, {})[cert.height] = cert
+        return True
 
     def submit(self, tenant, items):
         """Enqueue one tenant's verify batch; returns its

@@ -153,6 +153,7 @@ class Process:
         "validator",
         "broadcaster",
         "committer",
+        "certifier",
         "catcher",
         "state",
         "_tally_source",
@@ -170,6 +171,7 @@ class Process:
         validator: Optional[Validator] = None,
         broadcaster: Optional[Broadcaster] = None,
         committer: Optional[Committer] = None,
+        certifier=None,
         catcher: Optional[Catcher] = None,
         height: Height | None = None,
         state: State | None = None,
@@ -183,6 +185,11 @@ class Process:
         self.validator = validator
         self.broadcaster = broadcaster
         self.committer = committer
+        #: Optional certificates.Certifier: when set, every L49 commit
+        #: also mints a constant-size QuorumCertificate from the 2f+1
+        #: precommit signers (the O(1) commit proof downstream consumers
+        #: carry instead of the vote set).
+        self.certifier = certifier
         self.catcher = catcher
         if state is not None:
             self.state = state
@@ -971,6 +978,20 @@ class Process:
                 self.state.current_height,
                 round,
                 propose.value.hex()[:16],
+            )
+        if self.certifier is not None:
+            # Mint the O(1) commit proof from the quorum that just fired.
+            # The log scan is once-per-commit (cold path); the hot tally
+            # checks above never touch it.
+            signers = [
+                sender
+                for sender, pc in self.state.precommit_logs.get(
+                    round, {}
+                ).items()
+                if pc.value == propose.value
+            ]
+            self.certifier.observe_commit(
+                self.state.current_height, round, propose.value, signers
             )
         new_f, new_scheduler = self.committer.commit(
             self.state.current_height, propose.value

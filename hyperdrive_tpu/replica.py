@@ -190,6 +190,7 @@ class Replica:
         verifier=None,
         flusher=None,
         recorder=None,
+        certifier=None,
     ):
         f = len(signatories) // 3
         self.opts = opts
@@ -205,6 +206,7 @@ class Replica:
             validator=validator,
             broadcaster=broadcaster,
             committer=self._instrument_committer(committer),
+            certifier=certifier,
             catcher=self._instrument_catcher(catcher),
             height=opts.starting_height,
             obs=self.obs,

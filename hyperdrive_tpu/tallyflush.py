@@ -56,10 +56,23 @@ class DeviceTallyFlusher:
 
     def __init__(self, verifier, validators, r_slots: int = 8,
                  buckets: tuple = (256, 1024, 4096), tally_check=None,
-                 pipeline_split: int = 512, obs=None, queue=None):
+                 pipeline_split: int = 512, obs=None, queue=None,
+                 certifier=None):
         from hyperdrive_tpu.ops.votegrid import VoteGrid
 
         self.verifier = verifier
+        #: Optional certificates.Certifier shared with the replica's
+        #: Process: the settle path re-verifies each newly minted
+        #: QuorumCertificate in O(1) (binding + quorum weight) instead of
+        #: carrying the 2f+1-signature vote set forward. When the
+        #: certifier has no transcript source yet, bind it to this
+        #: flusher's verifier so certificates commit to the batch launch
+        #: that established their quorum.
+        self.certifier = certifier
+        if certifier is not None and certifier.transcript_source is None:
+            certifier.transcript_source = lambda: getattr(
+                self.verifier, "last_transcript", b""
+            )
         self.grid = VoteGrid(
             1, len(validators), r_slots=r_slots, buckets=buckets
         )
@@ -132,6 +145,8 @@ class DeviceTallyFlusher:
         self._inflight.clear()
         self._height = None
         self._dirty = set()
+        if self.certifier is not None:
+            self.certifier.reset()
 
     @async_scope
     def _flush_async(self, replica) -> None:
@@ -386,7 +401,17 @@ class DeviceTallyFlusher:
         )
         if self.tally_check is not None:
             view = self.tally_check(view, proc)
+        h_before = proc.current_height
         replica.ingest_cascade_window(plan, view)
+        if self.certifier is not None:
+            # Any height the cascade just committed minted a certificate
+            # (Process L49); re-check each one here in O(1) so a broken
+            # emission seam fails the settle that produced it, not a
+            # remote consumer rounds later.
+            for ch in range(h_before, proc.current_height):
+                cert = self.certifier.certificate_for(ch)
+                if cert is not None:
+                    self.certifier.verify(cert)
         if self.obs is not NULL_BOUND:
             self.obs.emit(
                 "flush.settle", proc.current_height, proc.current_round

@@ -50,6 +50,15 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
 
 def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'` under a hard wall-clock cap (ROADMAP):
+    # tests carrying this marker are the launch/compile-heavy ones whose
+    # differential coverage is duplicated by a fresh-process CI smoke
+    # (`python -m hyperdrive_tpu.ops msm-parity`, devsched parity) and
+    # which the in-suite 8-virtual-device/1-core environment slows 5-10x
+    # over their standalone cost. They still run in an unfiltered pass.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run"
+    )
     # Stdlib line-coverage measurement (no pytest-cov in the build
     # image) — see tests/_linecov.py. Opt-in: HD_LINECOV=1.
     if os.environ.get("HD_LINECOV"):

@@ -1,0 +1,266 @@
+"""Quorum certificates: codec roundtrips, forgery rejection, O(1) size,
+and the consensus seams that mint and re-verify them.
+
+The certificate replaces re-gossiping 2f+1 signatures with a constant-
+size record; everything here checks the two properties that make that
+sound: the binding commits to every field (any tamper rejects), and the
+emission seams (Process L49, the settle path, the sim) agree on the
+chain they minted.
+"""
+
+import hashlib
+
+import pytest
+
+from hyperdrive_tpu.certificates import (
+    Certifier,
+    QuorumCertificate,
+    certificate_size,
+    marshal_certificate,
+    unmarshal_certificate,
+)
+from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.harness.sim import Simulation
+
+
+def _mk_certifier(n=7, f=2, transcript=b"\x5a" * 32):
+    return Certifier(
+        [bytes([i]) * 32 for i in range(n)],
+        f,
+        transcript_source=(lambda: transcript) if transcript else None,
+    )
+
+
+# ------------------------------------------------------------------ codec
+
+
+def test_roundtrip_property(rng):
+    for _ in range(64):
+        n = rng.randint(1, 1024)
+        cert = QuorumCertificate(
+            height=rng.randint(0, 2**63 - 1),
+            round=rng.randint(0, 2**31 - 1),
+            value_digest=rng.randbytes(32),
+            signers=rng.randbytes(-(-n // 8)),
+            transcript=rng.randbytes(32),
+            binding=rng.randbytes(32),
+        )
+        w = Writer()
+        marshal_certificate(cert, w)
+        r = Reader(w.data())
+        assert unmarshal_certificate(r) == cert
+        assert r.done()
+
+
+def test_truncated_and_oversize_blobs_reject(rng):
+    cert = _mk_certifier().observe_commit(3, 1, b"value", [])
+    w = Writer()
+    marshal_certificate(cert, w)
+    blob = w.data()
+    for cut in (0, 1, len(blob) // 2, len(blob) - 1):
+        with pytest.raises(SerdeError):
+            unmarshal_certificate(Reader(blob[:cut]))
+    # A bitmap length claiming more than any validator set we size for.
+    w2 = Writer()
+    w2.u64(1)
+    w2.u32(0)
+    w2.bytes32(bytes(32))
+    w2.raw(bytes(8192))
+    w2.bytes32(bytes(32))
+    w2.bytes32(bytes(32))
+    with pytest.raises(SerdeError):
+        unmarshal_certificate(Reader(w2.data()))
+
+
+def test_size_is_constant_in_validator_count():
+    # The acceptance criterion: bytes at n=256/512/1024 move only by the
+    # bitmap (n/8), i.e. 1/512th the slope of the 64n-byte signature set
+    # the certificate replaces.
+    s256, s512, s1024 = (certificate_size(n) for n in (256, 512, 1024))
+    assert s512 - s256 == 256 // 8
+    assert s1024 - s512 == 512 // 8
+    assert s1024 < 256  # vs ~44 KB of 2f+1 signatures at n=1024
+
+
+# -------------------------------------------------------------- emit/verify
+
+
+def test_emit_then_verify_accepts():
+    c = _mk_certifier()
+    sigs = c.signatories
+    cert = c.observe_commit(9, 2, b"block-nine", sigs[:5])
+    assert cert.signer_count() == 5
+    assert cert.value_digest == hashlib.sha256(b"block-nine").digest()
+    assert cert.transcript == b"\x5a" * 32
+    assert c.verify(cert)
+    assert c.certificate_for(9) is cert
+    assert c.verified == 1 and c.rejected == 0
+
+
+def test_unknown_signers_do_not_count():
+    c = _mk_certifier()
+    cert = c.observe_commit(
+        1, 0, b"v", [b"\xee" * 32, c.signatories[0]]
+    )
+    assert cert.signer_count() == 1
+
+
+def test_forged_certificates_reject():
+    c = _mk_certifier()
+    sigs = c.signatories
+    cert = c.observe_commit(4, 0, b"honest", sigs[:5])
+
+    def forged(**kw):
+        fields = dict(
+            height=cert.height,
+            round=cert.round,
+            value_digest=cert.value_digest,
+            signers=cert.signers,
+            transcript=cert.transcript,
+            binding=cert.binding,
+        )
+        fields.update(kw)
+        return QuorumCertificate(**fields)
+
+    assert c.verify(cert)
+    # Tampering with ANY bound field breaks the binding.
+    assert not c.verify(forged(height=cert.height + 1))
+    assert not c.verify(forged(round=cert.round + 1))
+    assert not c.verify(forged(value_digest=b"\x01" * 32))
+    assert not c.verify(forged(transcript=b"\x02" * 32))
+    assert not c.verify(forged(signers=bytes([0xFF])))
+    # A re-bound forgery with too few signers fails the quorum check.
+    thin = c.observe_commit(5, 0, b"thin", sigs[:4])
+    assert not c.verify(thin)
+    # Wrong bitmap width (different validator set size) rejects.
+    other = Certifier([bytes([i]) * 32 for i in range(20)], 2)
+    wide = other.observe_commit(4, 0, b"honest", other.signatories[:7])
+    assert not c.verify(wide)
+
+
+def test_sub_32_byte_transcript_is_hashed_to_width():
+    c = _mk_certifier(transcript=None)
+    c.transcript_source = lambda: b"short"
+    cert = c.observe_commit(1, 0, b"v", c.signatories[:5])
+    assert cert.transcript == hashlib.sha256(b"short").digest()
+    c.transcript_source = lambda: b""
+    cert2 = c.observe_commit(2, 0, b"v", c.signatories[:5])
+    assert cert2.transcript == bytes(32)
+
+
+def test_chain_digest_orders_by_height_and_resets():
+    a = _mk_certifier()
+    b = _mk_certifier()
+    sigs = a.signatories
+    a.observe_commit(1, 0, b"one", sigs[:5])
+    a.observe_commit(2, 0, b"two", sigs[:5])
+    b.observe_commit(2, 0, b"two", sigs[:5])
+    b.observe_commit(1, 0, b"one", sigs[:5])
+    assert a.chain_digest() == b.chain_digest()
+    b.observe_commit(3, 0, b"three", sigs[:5])
+    assert a.chain_digest() != b.chain_digest()
+    b.reset()
+    assert not b.certs
+
+
+# ----------------------------------------------------------- consensus seams
+
+
+def test_sim_certificates_match_commits_across_replicas():
+    sim = Simulation(n=4, target_height=6, certificates=True)
+    result = sim.run()
+    assert result.completed
+    # Every replica minted the same certificate chain.
+    assert result.cert_digests is not None
+    assert len(set(result.cert_digests)) == 1
+    # Each certificate's value digest is the committed value's digest,
+    # its quorum weight clears 2f+1, and it re-verifies in O(1).
+    for i, certifier in enumerate(sim.certifiers):
+        for h, cert in certifier.certs.items():
+            want = hashlib.sha256(result.commits[i][h]).digest()
+            assert cert.value_digest == want
+            assert cert.signer_count() >= 2 * sim.f + 1
+            assert certifier.verify(cert)
+
+
+def test_sim_certificate_chain_is_deterministic():
+    kw = dict(n=4, target_height=5, seed=11, certificates=True)
+    assert (
+        Simulation(**kw).run().cert_digests
+        == Simulation(**kw).run().cert_digests
+    )
+
+
+def test_pipelined_certificates_equal_sequential():
+    # The devsched acceptance cross-check: gated/speculative commits must
+    # mint the same certificate chain the blocking schedule mints.
+    kw = dict(
+        n=4, target_height=6, seed=7, sign=True, burst=True,
+        certificates=True,
+    )
+    seq = Simulation(**kw).run()
+    pipe = Simulation(pipeline_heights=True, **kw).run()
+    assert seq.completed and pipe.completed
+    assert seq.commit_digest() == pipe.commit_digest()
+    assert seq.cert_digests == pipe.cert_digests
+
+
+def test_tallyflush_binds_verifier_transcript_and_reverifies():
+    from hyperdrive_tpu.tallyflush import DeviceTallyFlusher
+    from hyperdrive_tpu.verifier import NullVerifier
+
+    validators = [bytes([i]) * 32 for i in range(4)]
+    certifier = Certifier(validators, f=1)
+    flusher = DeviceTallyFlusher(
+        NullVerifier(), validators, certifier=certifier
+    )
+    # The flusher bound its verifier as the transcript source.
+    assert certifier.transcript_source is not None
+    assert certifier.transcript_source() == b""
+    # And reset() clears the chain with the other volatile state.
+    certifier.observe_commit(1, 0, b"v", validators[:3])
+    flusher.reset()
+    assert not certifier.certs
+
+
+def test_multihost_accept_certificate_registry():
+    from hyperdrive_tpu.parallel.multihost import ShardVerifyService
+    from hyperdrive_tpu.verifier import NullVerifier
+
+    svc = ShardVerifyService(NullVerifier())
+    validators = [bytes([i]) * 32 for i in range(7)]
+    certifier = svc.certifier(validators, f=2)
+    cert = certifier.observe_commit(3, 0, b"shard-val", validators[:5])
+    assert svc.accept_certificate("tenant-a", certifier, cert)
+    assert svc.certificates["tenant-a"][3] is cert
+    bad = QuorumCertificate(
+        cert.height, cert.round, b"\x09" * 32, cert.signers,
+        cert.transcript, cert.binding,
+    )
+    assert not svc.accept_certificate("tenant-a", certifier, bad)
+    assert 3 in svc.certificates["tenant-a"]
+
+
+def test_cert_obs_events_emitted():
+    from hyperdrive_tpu.obs.recorder import EVENT_KINDS, Recorder
+
+    rec = Recorder(capacity=256)
+    c = Certifier(
+        [bytes([i]) * 32 for i in range(4)], 1, obs=rec.scoped(0)
+    )
+    cert = c.observe_commit(2, 1, b"v", c.signatories[:3])
+    c.verify(cert)
+    c.verify(
+        QuorumCertificate(
+            cert.height, cert.round, cert.value_digest, cert.signers,
+            b"\x01" * 32, cert.binding,
+        )
+    )
+    kinds = [e.kind for e in rec.snapshot()]
+    assert kinds.count("cert.emit") == 1
+    assert kinds.count("cert.verify") == 2
+    assert {"cert.emit", "cert.verify"} <= EVENT_KINDS
+    outcomes = [
+        e.detail for e in rec.snapshot() if e.kind == "cert.verify"
+    ]
+    assert outcomes == ["ok", "reject"]

@@ -165,6 +165,8 @@ def test_rlc_malformed_lanes_skip_fallback(rlc_verifier, ring):
     assert rlc_verifier.rlc_fallbacks == before
 
 
+@pytest.mark.slow  # same differential at fixed vectors: the rlc tests
+# above + the wire/chal suites; randomized sweep stays in the full pass
 def test_rlc_differential_random(rlc_verifier, ring, rng):
     items = []
     for _i in range(24):

@@ -1,0 +1,188 @@
+"""Pippenger MSM kernel: differential parity against the host curve
+reference, plus the cofactored-vs-strict adversarial boundary.
+
+The MSM is the reduction engine behind the RLC batch-verify fast path
+(rlc_kernel drives two of them); these tests pin it to the serial host
+arithmetic on random inputs and document the ONE divergence class the
+batch equation is allowed to have: crafted small-order/torsion
+signatures, where batch-accept means cofactored-valid (PARITY.md).
+"""
+
+import numpy as np
+import pytest
+
+from hyperdrive_tpu.crypto import ed25519 as hed
+from hyperdrive_tpu.ops import fe25519 as fe
+from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier, _recode_signed
+from hyperdrive_tpu.ops.msm import msm_kernel, msm_plan, plan_groups
+
+
+def _host_affine(p):
+    # Host curve ops are extended homogeneous (X, Y, Z, T) with Z != 1;
+    # the kernel takes z = 1 affine limbs, so normalize first.
+    x, y, z, _ = p
+    zinv = pow(z, hed.P - 2, hed.P)
+    return (x * zinv) % hed.P, (y * zinv) % hed.P
+
+
+def _ext(p):
+    x, y = p
+    return (x, y, 1, x * y % hed.P)
+
+
+def _host_msm(points, scalars):
+    acc = hed.IDENTITY
+    for p, s in zip(points, scalars):
+        acc = hed.point_add(acc, hed.scalar_mult(s, _ext(p)))
+    return _host_affine(acc)
+
+
+def _pack_points(points):
+    px = np.stack([fe.to_limbs(p[0]) for p in points])
+    py = np.stack([fe.to_limbs(p[1]) for p in points])
+    pt = np.stack([fe.to_limbs(p[0] * p[1] % hed.P) for p in points])
+    return px, py, pt
+
+
+def _digits(scalars, windows):
+    # One extra zero nibble absorbs the signed-recode carry out of the
+    # top window (rlc_kernel runs 33 windows for 128-bit z the same way).
+    nibs = np.array(
+        [
+            [(s >> (4 * w)) & 0xF for w in range(windows + 1)]
+            for s in scalars
+        ],
+        dtype=np.int32,
+    )
+    return np.asarray(_recode_signed(nibs))
+
+
+def _affine(ext):
+    sx, sy, sz, _ = ext
+    zi = pow(int(fe.from_limbs(np.asarray(sz))[0]), hed.P - 2, hed.P)
+    return (
+        int(fe.from_limbs(np.asarray(sx))[0]) * zi % hed.P,
+        int(fe.from_limbs(np.asarray(sy))[0]) * zi % hed.P,
+    )
+
+
+def test_plan_groups_geometry():
+    # Power-of-two group counts, ceil-division serial depth, all lanes
+    # covered, and the small-batch floor.
+    for n in (1, 7, 8, 64, 256, 1024, 16384, 65536):
+        G, g = plan_groups(n)
+        assert G * g >= n
+        assert G == 1 or (G & (G - 1)) == 0
+        assert msm_plan(n, 64)["reduction_depth"] >= 7
+    assert plan_groups(65536) == (1024, 64)
+    assert plan_groups(7) == (1, 7)
+
+
+@pytest.mark.slow  # the CI msm-parity smoke runs this exact differential
+def test_msm_matches_host_reference(rng):
+    # Same shape as the CI smoke (python -m hyperdrive_tpu.ops
+    # msm-parity) so the persistent compile cache is shared: one XLA
+    # compile covers both.
+    n, windows = 37, 16
+    points, scalars = [], []
+    for _ in range(n):
+        points.append(
+            _host_affine(hed.scalar_mult(rng.randrange(1, hed.L), hed.BASE))
+        )
+        scalars.append(rng.randrange(0, 1 << (4 * windows)))
+    # Exercise the trash slot: zero scalars and duplicate points.
+    scalars[3] = 0
+    points[11] = points[4]
+
+    px, py, pt = _pack_points(points)
+    got = _affine(msm_kernel(px, py, pt, _digits(scalars, windows)))
+    assert got == _host_msm(points, scalars)
+
+
+# ------------------------------------------------- cofactored semantics
+
+
+def _order8_point():
+    """An order-8 torsion point (the canonical small-order vector of the
+    "Taming the many EdDSAs" test suite)."""
+    for seed in range(2, 50):
+        p = hed.point_decompress(bytes([seed]) + bytes(31))
+        if p is None:
+            continue
+        q = hed.scalar_mult(hed.L, p)
+        o, acc = 1, q
+        while not hed.point_equal(acc, hed.IDENTITY) and o <= 8:
+            acc = hed.point_add(acc, q)
+            o += 1
+        if o == 8:
+            return q
+    raise AssertionError("no order-8 point found")
+
+
+def small_order_item():
+    """(pub, msg, sig) that is cofactored-valid but strict-invalid:
+    A = R = an 8-torsion point, s = 0. Then [8]([s]B - R - [k]A) is the
+    identity (the cofactor kills the torsion), while [s]B == R + [k]A
+    itself fails for a suitably chosen message."""
+    t8 = _order8_point()
+    enc = hed.point_compress(t8)
+    sig = enc + bytes(32)
+    for i in range(64):
+        msg = b"small-order-%d" % i
+        k = hed.challenge_scalar(enc, enc, msg)
+        rka = hed.point_add(t8, hed.scalar_mult(k, t8))
+        if not hed.point_equal(hed.IDENTITY, rka):
+            return enc, msg, sig
+    raise AssertionError("no diverging message found")
+
+
+def test_small_order_vector_documents_cofactored_divergence(ring):
+    # The PARITY.md divergence class, pinned: the RLC batch equation is
+    # cofactored (3 final doublings), the per-signature ladder and the
+    # host reference are strict — a crafted torsion signature is the
+    # only input family where they may disagree, and callers needing
+    # strict semantics keep rlc=False for exactly this reason.
+    pub, msg, sig = small_order_item()
+    assert not hed.verify(pub, msg, sig)  # strict host: reject
+
+    item = (pub, msg, sig)
+    good = []
+    for i in range(3):
+        kp = ring[i]
+        m = bytes([i]) * 24
+        good.append((kp.public, m, hed.sign(kp.seed, m)))
+
+    ladder = TpuBatchVerifier(buckets=(16,), rlc=False)
+    strict = ladder.verify_signatures(good + [item]).tolist()
+    assert strict == [True, True, True, False]
+
+    rlc = TpuBatchVerifier(buckets=(16,), rlc=True)
+    batched = rlc.verify_signatures(good + [item]).tolist()
+    # The combined cofactored equation absorbs the torsion: the batch
+    # accepts all four lanes in ONE launch, no fallback fired.
+    assert batched == [True, True, True, True]
+    assert rlc.rlc_fallbacks == 0
+
+
+@pytest.mark.slow
+def test_msm_torsion_points_in_batch_match_reference(rng):
+    # Mixed-cofactor MSM input: torsion points alongside prime-order
+    # ones must still reduce to the host reference sum exactly — the
+    # kernel is plain group arithmetic; cofactor semantics only enter at
+    # the rlc_kernel's final check.
+    t8 = _host_affine(_order8_point())
+    n, windows = 37, 16
+    points = [t8 if i % 5 == 0
+              else _host_affine(hed.scalar_mult(i + 1, hed.BASE))
+              for i in range(n)]
+    scalars = [rng.randrange(0, 1 << (4 * windows)) for _ in range(n)]
+    px, py, pt = _pack_points(points)
+    got = _affine(msm_kernel(px, py, pt, _digits(scalars, windows)))
+    assert got == _host_msm(points, scalars)
+
+
+@pytest.fixture(scope="module")
+def ring():
+    from hyperdrive_tpu.crypto.keys import KeyRing
+
+    return KeyRing.deterministic(4, namespace=b"msmtest")

@@ -12,6 +12,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 from hyperdrive_tpu.codec import Reader, Writer
 from hyperdrive_tpu.crypto.keys import KeyRing
 from hyperdrive_tpu.messages import Prevote, marshal_message
@@ -179,6 +181,9 @@ def test_two_process_tcp_consensus():
     )
 
 
+@pytest.mark.slow  # subprocess workers recompile the wire kernels
+# fresh each run; the three_of_four test keeps fast-suite transport
+# coverage and the chaos soak exercises the full mesh
 def test_two_process_tpu_verified_device_tally_consensus():
     # The deployment capstone: every layer of the framework in ONE
     # multi-process run. Two OS processes x two replicas, loopback-TCP
