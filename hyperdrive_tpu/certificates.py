@@ -231,6 +231,17 @@ class Certifier:
             )
         return ok
 
+    # ------------------------------------------------------------- rotation
+
+    def rotate(self, signatories, f: int) -> None:
+        """Epoch hot-swap (epochs.py): install the next committee's
+        whitelist order and quorum threshold. Emitted certificates are
+        kept — the chain stays continuous across the transition; only
+        bitmap indexing for NEW emissions follows the new order."""
+        self.signatories = list(signatories)
+        self._pos = {s: i for i, s in enumerate(self.signatories)}
+        self.f = int(f)
+
     # ------------------------------------------------------------- chaining
 
     def certificate_for(self, height):

@@ -4,7 +4,8 @@ Usage::
 
     python -m hyperdrive_tpu.chaos soak [--scenarios N] [--seed S]
         [--n N_REPLICAS] [--target H] [--out DIR] [--replay-every K]
-        [--pipelined-every K] [--certs-every K]
+        [--pipelined-every K] [--certs-every K] [--churn-every K]
+        [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -75,6 +76,37 @@ def _build(scen_seed: int, n: int, target: int, pipelined: bool = False,
     return plan, sim
 
 
+def _build_churn(scen_seed: int, n: int, target: int):
+    """An epoch-churn scenario: short epochs with a ~25% membership
+    swap + one key rotation per boundary, under a churn-shaped fault
+    plan (partition spanning a boundary, crash-restore inside it,
+    laggards rejoining under rotated keys). Certificates are on so the
+    epoch-proof chain is minted and the monitor can verify it
+    end-to-end; the target guarantees >= 3 boundary crossings."""
+    from hyperdrive_tpu.epochs import EpochConfig
+
+    plan = FaultPlan.churn(scen_seed, n)
+    epoch_length = 2
+    committee = max(3, (3 * n) // 4)
+    target = max(target, 3 * epoch_length + 1)
+    sim = Simulation(
+        n=n,
+        target_height=target,
+        seed=scen_seed,
+        timeout=1.0,
+        delivery_cost=1e-3,
+        chaos=plan,
+        observe=True,
+        certificates=True,
+        epochs=EpochConfig(
+            epoch_length=epoch_length,
+            committee_size=committee,
+            rekey_per_epoch=1,
+        ),
+    )
+    return plan, sim
+
+
 def _dump_failure(out: str, scen_seed: int, sim, err) -> str:
     os.makedirs(out, exist_ok=True)
     base = os.path.join(out, f"chaos_seed_{scen_seed}")
@@ -89,6 +121,7 @@ def _dump_failure(out: str, scen_seed: int, sim, err) -> str:
 def soak(args) -> int:
     rng = random.Random(args.seed)
     failures = 0
+    churn_dumped = False
     for k in range(args.scenarios):
         scen_seed = args.seed + k * _SEED_STRIDE
         n = args.n if args.n else rng.choice([4, 7])
@@ -171,6 +204,56 @@ def soak(args) -> int:
             f"steps={result.steps} crashes={len(monitor.crashes)} "
             f"heals={len(monitor.heals)}"
         )
+        if args.churn_every and k % args.churn_every == 0:
+            # Every Kth scenario additionally runs the epoch-churn
+            # family: dynamic validator sets under the same seed's
+            # hostility, with the monitor's epoch invariants armed
+            # (no fork across switches, retired keys out of every
+            # whitelist, union proof chain verifying end-to-end) and a
+            # record-replay determinism self-check.
+            cn = args.n if args.n else 8
+            zplan, zsim = _build_churn(scen_seed, cn, args.target)
+            zmon = InvariantMonitor(zsim)
+            try:
+                zresult = zsim.run(max_steps=args.max_steps)
+                zmon.check_final(zresult)
+                if not zmon.epoch_switches:
+                    raise InvariantViolation(
+                        "epoch-liveness",
+                        "churn run never crossed an epoch boundary",
+                    )
+                zreplayed = Simulation.replay(zsim.record)
+                if zreplayed.commits != zresult.commits:
+                    raise InvariantViolation(
+                        "replay",
+                        "churn replay diverges from live run",
+                    )
+            except (InvariantViolation, AssertionError) as err:
+                failures += 1
+                base = _dump_failure(args.out, scen_seed, zsim, err)
+                print(
+                    f"FAIL churn seed={scen_seed} n={cn} {err}\n"
+                    f"  dumped {base}.bin (+ journal, checkpoints)\n"
+                    f"  reproduce: python -m hyperdrive_tpu.chaos "
+                    f"replay {base}.bin",
+                    file=sys.stderr,
+                )
+                if not args.keep_going:
+                    return 1
+                continue
+            print(
+                f"ok churn seed={scen_seed} n={cn} "
+                f"epoch={zsim.epoch} switches={len(zmon.epoch_switches)} "
+                f"stale_votes={sum(r.stale_votes for r in zsim.replicas)}"
+            )
+            if args.dump_ok and not churn_dumped:
+                os.makedirs(args.dump_ok, exist_ok=True)
+                okbase = os.path.join(
+                    args.dump_ok, f"churn_seed_{scen_seed}.bin"
+                )
+                zsim.record.dump(okbase)
+                churn_dumped = True
+                print(f"  dumped passing churn record: {okbase}")
     if failures:
         print(f"soak FAILED: {failures}/{args.scenarios}", file=sys.stderr)
         return 1
@@ -180,7 +263,13 @@ def soak(args) -> int:
 
 def replay(args) -> int:
     record = ScenarioRecord.load(args.dump)
-    result = Simulation.replay(record)
+    extra = {}
+    if record.epochs is not None:
+        # Epoch records replay with certificates on so the transition
+        # proofs are re-minted from the recorded deliveries and the
+        # light-client chain walk can run from the dump alone.
+        extra["certificates"] = True
+    result = Simulation.replay(record, **extra)
     result.assert_safety()
     print(
         f"replayed seed={record.seed} n={record.n} "
@@ -188,6 +277,24 @@ def replay(args) -> int:
         f"steps={result.steps} lifecycle_ops={len(record.lifecycle)} "
         f"digest={result.commit_digest()[:16]}"
     )
+    if record.epochs is not None:
+        from hyperdrive_tpu.epochs import verify_epoch_chain
+
+        sim = result.sim
+        covered: dict = {}
+        for c in sim.certifiers:
+            for e, pr in getattr(c, "proofs", {}).items():
+                covered.setdefault(e, pr)
+        missing = sorted(set(range(1, sim.epoch + 1)) - set(covered))
+        if missing:
+            print(f"epoch chain BROKEN: no proof for epochs {missing}",
+                  file=sys.stderr)
+            return 1
+        proofs = [covered[e] for e in sorted(covered)]
+        hops = verify_epoch_chain(
+            sim.epoch_schedule.signatories(0), proofs
+        )
+        print(f"epoch chain ok: {hops} transitions verified from genesis")
     return 0
 
 
@@ -223,6 +330,19 @@ def main(argv=None) -> int:
         default=4,
         help="re-run every Kth plan with quorum certificates enabled and "
         "cross-check chain digests + certificate integrity (0 = off)",
+    )
+    p.add_argument(
+        "--churn-every",
+        type=int,
+        default=0,
+        help="additionally run every Kth seed as an epoch-churn scenario "
+        "(dynamic validator set + key rotation under chaos; 0 = off)",
+    )
+    p.add_argument(
+        "--dump-ok",
+        default="",
+        help="dump the first PASSING churn scenario's record here (the "
+        "CI epoch-proof-chain replay smoke consumes it)",
     )
     p.add_argument("--keep-going", action="store_true")
     p.set_defaults(fn=soak)

@@ -70,6 +70,12 @@ class InvariantMonitor:
         self.restores: list[tuple[int, int]] = []
         self.commit_rounds_after_heal: list[int] = []
         self._await_heal_commit: "set[int] | None" = None
+        #: (epoch, boundary height) per observed epoch switch.
+        self.epoch_switches: list[tuple[int, int]] = []
+        self.commit_rounds_after_epoch: list[int] = []
+        self._last_epoch = 0
+        self._epoch_boundary: "int | None" = None
+        self._await_epoch_commit: "set[int] | None" = None
         self._orig_commit = sim._on_commit
         sim._on_commit = self._commit
         sim._chaos_monitor = self
@@ -97,7 +103,43 @@ class InvariantMonitor:
                     f"height {height} after heal "
                     f"(bound {self.max_rounds_after_heal})",
                 )
-        return self._orig_commit(i, height, value)
+        # Bounded rounds-to-commit after churn: armed at each epoch
+        # switch below; checked BEFORE the commit callback runs (the
+        # sim's boundary handler rotates the round machinery, so
+        # current_round must be read pre-rotation).
+        eawait = self._await_epoch_commit
+        if (
+            eawait is not None
+            and i in eawait
+            and self._epoch_boundary is not None
+            and height > self._epoch_boundary
+        ):
+            eawait.discard(i)
+            rounds = self.sim.replicas[i].proc.current_round + 1
+            self.commit_rounds_after_epoch.append(rounds)
+            if rounds > self.max_rounds_after_heal:
+                raise InvariantViolation(
+                    "epoch-liveness",
+                    f"replica {i} needed {rounds} rounds to commit "
+                    f"height {height} after the epoch "
+                    f"{self._last_epoch} switch "
+                    f"(bound {self.max_rounds_after_heal})",
+                )
+        ret = self._orig_commit(i, height, value)
+        sim = self.sim
+        if (
+            getattr(sim, "epoch_schedule", None) is not None
+            and sim.epoch > self._last_epoch
+        ):
+            self._last_epoch = sim.epoch
+            self.epoch_switches.append((sim.epoch, height))
+            self._epoch_boundary = height
+            self._await_epoch_commit = {
+                j
+                for j in range(sim.n)
+                if sim.alive[j] and j in self.honest
+            }
+        return ret
 
     def note_crash(self, victim: int, now: float) -> None:
         self.crashes.append((victim, now))
@@ -149,7 +191,68 @@ class InvariantMonitor:
                 "liveness",
                 f"run stalled below target; heights={result.heights}",
             )
+        self._check_epochs()
         return self
+
+    def _check_epochs(self) -> None:
+        """Dynamic-validator-set invariants (epoch runs only):
+
+        - **no retired key in a caught-up whitelist** — once a rotation
+          retires a key at its bound height, no replica at or past that
+          height may still whitelist it (so no commit can count it);
+        - **epoch-proof chain continuity** — the UNION of per-replica
+          proof chains covers every epoch 1..current and verifies
+          end-to-end from genesis. Per-replica chains legitimately have
+          gaps (a resync jumps a laggard OVER boundary commits, so it
+          never mints those proofs); the network-wide claim is that
+          SOMEONE certified every hop, and the hops link up.
+        """
+        sim = self.sim
+        sched = getattr(sim, "epoch_schedule", None)
+        if sched is None:
+            return
+        for sig, bad_from in sim._retired.items():
+            for j in sorted(self.honest):
+                if not sim.alive[j]:
+                    continue
+                r = sim.replicas[j]
+                if (
+                    r.proc.current_height >= bad_from
+                    and sig in r.procs_allowed
+                ):
+                    raise InvariantViolation(
+                        "retired-key",
+                        f"replica {j} at height {r.proc.current_height} "
+                        f"still whitelists a key retired from height "
+                        f"{bad_from}",
+                    )
+        certifiers = [
+            c for c in getattr(sim, "certifiers", []) if c is not None
+        ]
+        if not certifiers or sim.epoch == 0:
+            return
+        covered: dict = {}
+        for c in certifiers:
+            for e, pr in getattr(c, "proofs", {}).items():
+                covered.setdefault(e, pr)
+        missing = [
+            e for e in range(1, sim.epoch + 1) if e not in covered
+        ]
+        if missing:
+            raise InvariantViolation(
+                "epoch-chain",
+                f"no replica holds a transition proof for epochs "
+                f"{missing} (current epoch {sim.epoch})",
+            )
+        from hyperdrive_tpu.epochs import EpochChainError, verify_epoch_chain
+
+        try:
+            verify_epoch_chain(
+                sched.signatories(0),
+                [covered[e] for e in range(1, sim.epoch + 1)],
+            )
+        except EpochChainError as exc:
+            raise InvariantViolation("epoch-chain", str(exc)) from exc
 
     def _check_journal(self) -> None:
         """Cross-check the obs flight recorder against the chain: every

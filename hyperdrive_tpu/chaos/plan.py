@@ -216,3 +216,77 @@ class FaultPlan:
         )
         plan.validate(n)
         return plan
+
+    @classmethod
+    def churn(
+        cls,
+        seed: int,
+        n: int,
+        *,
+        est_virtual_time: float = 4.0,
+        crash: bool = True,
+        links: bool = True,
+    ) -> "FaultPlan":
+        """The epoch-churn scenario family (ISSUE: dynamic validator
+        sets). Three stressors composed so faults LAND ON epoch
+        machinery rather than around it:
+
+        - churn during an active partition — the partition window is
+          drawn wide (``est_virtual_time`` fractions) so with short
+          epochs at least one boundary election + key rotation commits
+          while up to f//2 replicas are isolated;
+        - crash-restore across an epoch boundary — the victim is chosen
+          from the isolated group when there is one, and its restart
+          window is long enough that the network usually crosses a
+          boundary while it is down, forcing the restore path to
+          re-apply epoch state (rotated whoami, new committee) before
+          rejoining;
+        - laggard rejoining under a rotated key — heal-time resync of
+          the isolated group exercises exactly the stale-generation
+          reject + retired-key bound in replica.py.
+
+        The caller supplies the epoch schedule on the Simulation side
+        (``epochs=EpochConfig(...)``); this plan only shapes WHEN the
+        network is hostile. ``est_virtual_time``: rough expected virtual
+        duration of the run, used to place the partition window."""
+        rng = random.Random((seed << 1) ^ 0x45504F43)
+        f = n // 3
+        isolated: list[int] = []
+        parts: tuple[Partition, ...] = ()
+        if f:
+            isolated = rng.sample(range(n), rng.randint(1, max(1, f // 2)))
+            at = est_virtual_time * rng.uniform(0.25, 0.4)
+            heal = at + est_virtual_time * rng.uniform(0.3, 0.45)
+            parts = (
+                Partition(at=at, heal=heal, groups=(tuple(isolated),)),
+            )
+        crashes: tuple[CrashRestart, ...] = ()
+        if crash and f:
+            victim = rng.choice(isolated) if isolated else rng.randrange(n)
+            crashes = (
+                CrashRestart(
+                    replica=victim,
+                    crash_at_step=rng.randint(300, 900),
+                    restart_after_steps=rng.randint(300, 800),
+                ),
+            )
+        link_faults: list[LinkFault] = []
+        if links:
+            for _ in range(rng.randint(0, 2)):
+                src, dst = rng.randrange(n), rng.randrange(n)
+                link_faults.append(
+                    LinkFault(
+                        src=src,
+                        dst=dst,
+                        drop=rng.choice([0.0, 0.05]),
+                        duplicate=rng.choice([0.0, 0.05]),
+                        delay=rng.choice([0.0, 0.1]),
+                        delay_min=0.01,
+                        delay_max=rng.uniform(0.05, 0.2),
+                    )
+                )
+        plan = cls(
+            links=tuple(link_faults), partitions=parts, crashes=crashes
+        )
+        plan.validate(n)
+        return plan

@@ -224,14 +224,21 @@ class DeviceWorkQueue:
             self._launchers[key] = got
         return got
 
-    def submit(self, launcher, payload) -> DeviceFuture:
+    def submit(self, launcher, payload, generation: int = 0) -> DeviceFuture:
         """Enqueue one command; returns its future. Auto-drains when
         ``max_depth`` is reached (including the command just
-        submitted), so a pipeline slot never grows unbounded."""
+        submitted), so a pipeline slot never grows unbounded.
+
+        ``generation`` tags the command with its epoch-keyed pubkey
+        table generation (epochs.py): commands only coalesce within one
+        (launcher, generation) pair, so a drain spanning an epoch
+        boundary SPLITS into one launch per generation instead of
+        mixing two key tables in one batch. Generation-less callers
+        (the default 0) coalesce exactly as before."""
         if self._closed:
             raise RuntimeError("queue is closed")
         fut = DeviceFuture(self)
-        self._pending.append((launcher, payload, fut))
+        self._pending.append((launcher, payload, fut, generation))
         self.submitted += 1
         if self.obs is not NULL_BOUND:
             self.obs.emit(
@@ -269,7 +276,10 @@ class DeviceWorkQueue:
                 for cmd in batch:
                     if cmd[2].cancelled():
                         continue
-                    key = id(cmd[0])
+                    # Coalesce per (launcher, table generation): an
+                    # epoch boundary inside one drain yields one launch
+                    # per generation — keys never mix within a batch.
+                    key = (id(cmd[0]), cmd[3])
                     if key not in groups:
                         groups[key] = []
                         order.append(key)
@@ -287,6 +297,10 @@ class DeviceWorkQueue:
                         )
                     self.launches += 1
                     self.coalesced += len(cmds) - 1
+                    if key[1] and hasattr(launcher, "set_generation"):
+                        # Generation-aware launchers swap their double-
+                        # buffered table before the coalesced launch.
+                        launcher.set_generation(key[1])
                     results = launcher.launch([c[1] for c in cmds])
                     if len(results) != len(cmds):
                         raise RuntimeError(
@@ -294,7 +308,7 @@ class DeviceWorkQueue:
                             f"{len(results)} results for {len(cmds)} "
                             "commands"
                         )
-                    for (_, _, fut), res in zip(cmds, results):
+                    for (_, _, fut, _), res in zip(cmds, results):
                         if not fut.cancelled():
                             fut._resolve(res)
                         resolved += 1

@@ -50,6 +50,7 @@ from hyperdrive_tpu.replica import (
     ResetHeight,
     merge_drain,
 )
+from hyperdrive_tpu.scheduler import RoundRobin
 from hyperdrive_tpu.testutil import (
     BroadcasterCallbacks,
     CatcherCallbacks,
@@ -130,6 +131,13 @@ class ScenarioRecord:
     #: messages never enter the record, so replay needs no knowledge of
     #: the FaultPlan — only of when replicas died, revived, and jumped.
     lifecycle: list[tuple[int, int, int, int]] = field(default_factory=list)
+    #: Epoch configuration, ``(epoch_length, committee_size,
+    #: rekey_per_epoch, seed, stakes)`` for dynamic-validator-set runs
+    #: (epochs.py) or None. Replay rebuilds the identical EpochSchedule
+    #: from these five values — elections and re-keys are deterministic
+    #: functions of them plus the committed boundary values, which the
+    #: message stream reproduces.
+    epochs: "tuple | None" = None
 
     OP_CRASH = 0
     OP_RESTORE = 1
@@ -142,8 +150,10 @@ class ScenarioRecord:
     #: batched ingestion did not exist then, so every old record was
     #: captured under per-message dispatch. v5 appends the chaos
     #: lifecycle-op trailer (pre-v5 dumps load with no lifecycle ops).
+    #: v6 appends the epoch-config trailer (pre-v6 dumps load with no
+    #: epochs — dynamic validator sets did not exist then).
     MAGIC = 0x48594456  # "HYDV"
-    VERSION = 5
+    VERSION = 6
 
     def marshal(self, w: Writer) -> None:
         w.u32(self.MAGIC)
@@ -169,6 +179,16 @@ class ScenarioRecord:
             w.u32(pos)
             w.u32(replica)
             w.i64(aux)
+        w.bool(self.epochs is not None)
+        if self.epochs is not None:
+            epoch_length, committee, rekey, eseed, stakes = self.epochs
+            w.u32(epoch_length)
+            w.u32(committee)
+            w.u32(rekey)
+            w.u64(eseed)
+            w.u32(len(stakes))
+            for s in stakes:
+                w.u64(s)
 
     @classmethod
     def unmarshal(cls, r: Reader) -> "ScenarioRecord":
@@ -176,7 +196,7 @@ class ScenarioRecord:
         if magic != cls.MAGIC:
             raise SerdeError(f"not a scenario dump (magic {magic:#x})")
         version = r.u32()
-        if version not in (2, 3, 4, cls.VERSION):
+        if version not in (2, 3, 4, 5, cls.VERSION):
             raise SerdeError(
                 f"scenario dump version {version} unsupported "
                 f"(expected {cls.VERSION})"
@@ -222,6 +242,18 @@ class ScenarioRecord:
             rec.lifecycle = [
                 (r.u32(), r.u32(), r.u32(), r.i64()) for _ in range(nops)
             ]
+        if version >= 6 and r.bool():
+            epoch_length = r.u32()
+            committee = r.u32()
+            rekey = r.u32()
+            eseed = r.u64()
+            nstakes = r.u32()
+            if nstakes > 1 << 20:
+                raise SerdeError("stake count too large")
+            rec.epochs = (
+                epoch_length, committee, rekey, eseed,
+                tuple(r.u64() for _ in range(nstakes)),
+            )
         return rec
 
     def dump(self, path: str) -> None:
@@ -336,6 +368,10 @@ class SimulationResult:
     #: commit-proof sibling of :meth:`commit_digest` for pipelined ==
     #: sequential and cross-replica equality checks.
     cert_digests: "list[str] | None" = None
+    #: The Simulation behind a :meth:`Simulation.replay` result (live
+    #: ``run()`` callers already hold theirs). The chaos replay CLI
+    #: re-verifies the epoch-proof chain off the replayed certifiers.
+    sim: "Simulation | None" = field(default=None, repr=False, compare=False)
 
     def assert_safety(self) -> None:
         """All replicas — including ones that later died — must agree
@@ -419,6 +455,9 @@ class Simulation:
         obs_capacity: int = 65536,
         chaos=None,
         certificates: bool = False,
+        epochs=None,
+        catchup_every: Optional[int] = None,
+        catchup_lag: Optional[int] = None,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -769,6 +808,81 @@ class Simulation:
                 hashlib.sha256(b"sim-replica-%d-%d" % (seed, i)).digest()
                 for i in range(n)
             ]
+        #: Dynamic validator sets (epochs.py): pass ``epochs=EpochConfig``
+        #: to partition heights into epochs, elect a stake-weighted
+        #: committee at every boundary commit, and rotate keys. Identities
+        #: are derived per (pool index, key generation); ``_identity[i]``
+        #: tracks replica i's CURRENT signatory (rekeys replace it) while
+        #: ``self.signatories`` stays the generation-0 pool for record /
+        #: replay compatibility. ``_retired`` maps a retired identity to
+        #: the first height where votes under it are stale — shared by
+        #: reference with every replica (the stale-vote admission check).
+        self.epoch_schedule = None
+        self.epoch = 0
+        self._identity = list(self.signatories)
+        self._retired: dict = {}
+        self._replica_epoch = [0] * n
+        if epochs is not None:
+            if burst:
+                raise ValueError(
+                    "epochs advance on lock-step boundary commits; use "
+                    "burst=False (the settle layer rotates per-launch "
+                    "table generations instead — see tallyflush)"
+                )
+            if sign:
+                raise ValueError(
+                    "epochs derive identities per (index, generation); "
+                    "the deterministic keyring has no generation axis — "
+                    "run epoch scenarios unsigned"
+                )
+            if payload_bytes:
+                raise ValueError(
+                    "payload reconstruction pins k = 2f+1 at "
+                    "construction; epoch-rotated thresholds are not "
+                    "supported on the payload path"
+                )
+            from hyperdrive_tpu.epochs import (
+                EpochSchedule,
+                default_signatory,
+            )
+
+            stakes = tuple(epochs.stakes) or (1,) * n
+            if len(stakes) != n:
+                raise ValueError(
+                    f"epochs.stakes has {len(stakes)} entries for "
+                    f"{n} replicas"
+                )
+            ns = b"sim-%d" % seed
+            sig_fn = (
+                lambda idx, gen, _ns=ns: default_signatory(
+                    idx, gen, namespace=_ns
+                )
+            )
+            self.epoch_schedule = EpochSchedule(
+                stakes,
+                epochs.committee_size or n,
+                epochs.epoch_length,
+                epochs.seed or seed,
+                rekey_per_epoch=epochs.rekey_per_epoch,
+                signatory_fn=sig_fn,
+            )
+            derived = [sig_fn(i, 0) for i in range(n)]
+            if signatories is not None and list(signatories) != derived:
+                raise ValueError(
+                    "epochs derive identities from the schedule's "
+                    "signatory function; a signatories override that "
+                    "differs would desynchronize elections (replay an "
+                    "epoch dump with the same seed instead)"
+                )
+            self.signatories = derived
+            self._identity = list(derived)
+            self.record.epochs = (
+                int(epochs.epoch_length),
+                int(epochs.committee_size or n),
+                int(epochs.rekey_per_epoch),
+                int(epochs.seed or seed),
+                stakes,
+            )
         self.record.signatories = list(self.signatories)
         self._max_capacity = max_capacity
         #: Sender -> tie-break index for the shared-lane sort; seeded with
@@ -859,6 +973,21 @@ class Simulation:
         #: without a plan: replay of a chaos record restores crash
         #: victims from checkpoints it re-derives at the recorded commit
         #: points (identical delivery stream -> identical Process bytes).
+        #: Laggard catch-up sweep tuning (PR 4 constants, promoted):
+        #: ``catchup_every`` delivery steps between sweeps, ``catchup_lag``
+        #: tolerated height lag before a laggard is jumped forward. None =
+        #: the module defaults (unchanged behavior); a tighter sweep
+        #: bounds rejoin latency at the cost of more resync churn.
+        self._catchup_every = (
+            _CATCHUP_EVERY if catchup_every is None else int(catchup_every)
+        )
+        self._catchup_lag = (
+            _CATCHUP_LAG if catchup_lag is None else int(catchup_lag)
+        )
+        if self._catchup_every < 1:
+            raise ValueError("catchup_every must be >= 1")
+        if self._catchup_lag < 0:
+            raise ValueError("catchup_lag must be >= 0")
         self._chaos = chaos
         self._chaos_monitor = None
         from hyperdrive_tpu.utils.checkpoint import CheckpointStore
@@ -904,6 +1033,16 @@ class Simulation:
                     verifier_for(i) if verifier_for else None,
                 )
             )
+        if self.epoch_schedule is not None:
+            # One shared retired-identity map: the vote admission check
+            # (replica._buffer_vote) is a statement about the NETWORK's
+            # key history — "identity X is invalid from height H" — not
+            # about the receiving replica's own epoch progress, so every
+            # replica reads the same dict by reference and a laggard
+            # still finishing the boundary height keeps accepting the
+            # old key's votes at heights below H.
+            for r in self.replicas:
+                r.retired = self._retired
         if device_tally:
             # The grid answers the hot quorum queries; the host keeps the
             # logs (checkpoints, evidence) but skips the derived per-value
@@ -1062,20 +1201,41 @@ class Simulation:
 
         certifier = None
         if self.certificates_on:
-            from hyperdrive_tpu.certificates import Certifier
-
-            certifier = Certifier(
-                list(self.signatories),
-                self.f,
-                # Bind the settle layer's batch verifier lazily: its
-                # last_transcript is the launch that verified this
-                # commit's quorum (b"" on unsigned/ladder paths).
-                transcript_source=lambda: getattr(
-                    self.batch_verifier, "last_transcript", b""
-                ),
-                obs=self.obs.scoped(i),
+            # Bind the settle layer's batch verifier lazily: its
+            # last_transcript is the launch that verified this
+            # commit's quorum (b"" on unsigned/ladder paths).
+            transcript_source = lambda: getattr(  # noqa: E731
+                self.batch_verifier, "last_transcript", b""
             )
+            if self.epoch_schedule is not None:
+                from hyperdrive_tpu.epochs import EpochCertifier
+
+                certifier = EpochCertifier(
+                    self.epoch_schedule,
+                    transcript_source=transcript_source,
+                    obs=self.obs.scoped(i),
+                )
+            else:
+                from hyperdrive_tpu.certificates import Certifier
+
+                certifier = Certifier(
+                    list(self.signatories),
+                    self.f,
+                    transcript_source=transcript_source,
+                    obs=self.obs.scoped(i),
+                )
             self.certifiers.append(certifier)
+
+        # Epoch mode: consensus runs under epoch 0's elected committee
+        # (quorum f = k // 3, round-robin over committee order, committee
+        # whitelist), while the replica keeps its own pool identity — a
+        # non-member is a follower: it tracks commits but its votes are
+        # filtered by everyone's whitelist.
+        committee = (
+            list(self.epoch_schedule.signatories(0))
+            if self.epoch_schedule is not None
+            else list(self.signatories)
+        )
 
         return Replica(
             ReplicaOptions(
@@ -1086,7 +1246,7 @@ class Simulation:
                 obs=self.obs.scoped(i),
             ),
             self.signatories[i],
-            list(self.signatories),
+            committee,
             timer,
             proposer,
             validator,
@@ -1102,7 +1262,7 @@ class Simulation:
             ),
             verifier=verifier,
             flusher=(
-                self._flusher_for(i, list(self.signatories))
+                self._flusher_for(i, committee)
                 if self._flusher_for is not None
                 else None
             ),
@@ -1130,7 +1290,102 @@ class Simulation:
             self._reconstruct_commit(i, height, value)
         if height >= self.target_height:
             self._pending_replicas.discard(i)
+        if (
+            self.epoch_schedule is not None
+            and self.epoch_schedule.is_boundary(height)
+        ):
+            return self._epoch_advance(i, height, value)
         return (0, None)
+
+    # ------------------------------------------------------------- epochs
+
+    def _epoch_advance(self, i: int, height: Height, value: Value):
+        """Replica ``i`` committed an epoch boundary: compute (or fetch)
+        the deterministic transition, install the network-level effects
+        once (first committer wins — every later committer of the same
+        boundary value fetches the identical cached transition; a
+        different value trips the schedule's fork check), and hand the
+        Process its next-height committee: the returned ``(f,
+        scheduler)`` pair flows through the commit seam into
+        ``start_round(0)`` of ``height + 1``."""
+        sched = self.epoch_schedule
+        tr = sched.transition_at(height, value)
+        if tr.epoch > self.epoch:
+            self._epoch_install(tr, height)
+        r = self.replicas[i]
+        sigs = list(tr.signatories)
+        r.procs_allowed = set(sigs)
+        for s in sigs:
+            r.mq.order_of(s)
+        # The replica's own identity may have rotated in this transition
+        # (or an earlier one it is only now catching up to).
+        r.proc.whoami = self._identity[i]
+        if self._replica_epoch[i] != tr.epoch:
+            self._replica_epoch[i] = tr.epoch
+            if r.obs is not _OBS_NULL:
+                r.obs.emit("epoch.switch", height, -1, tr.epoch)
+        if self.certifiers and self.certifiers[i].epoch != tr.epoch:
+            # Normally EpochCertifier.observe_commit already rotated
+            # itself at this boundary; this catches certifier-less
+            # paths through the seam (restored replicas whose certifier
+            # missed the boundary rotate in _apply_epoch_state).
+            self.certifiers[i].rotate_to(tr.epoch)
+        return len(sigs) // 3, RoundRobin(sigs)
+
+    def _epoch_install(self, tr, height: Height) -> None:
+        """One-time network-level effects of a transition: rotated
+        identities become current (the pool member signs with the new
+        key from ``height + 1`` on), retired identities enter the shared
+        stale-vote map, and the sim-track obs events mark the switch."""
+        new_by_index = {v.index: v.signatory for v in tr.committee}
+        for idx, old in zip(tr.rekeyed, tr.retired):
+            fresh = new_by_index[idx]
+            self._identity[idx] = fresh
+            # Partition routing (_chaos_deliver) keys on sender; the
+            # rotated identity maps to the same replica slot.
+            self._order_pos[fresh] = idx
+            self._retired[old] = height + 1
+        self.epoch = tr.epoch
+        if self._obs_sim is not _OBS_NULL:
+            self._obs_sim.emit(
+                "epoch.elect", height, -1,
+                "e%d j%d l%d r%d" % (
+                    tr.epoch, len(tr.joined), len(tr.left),
+                    len(tr.rekeyed),
+                ),
+            )
+            self._obs_sim.emit("epoch.begin", height + 1, -1, tr.epoch)
+
+    def _resync_sigs(self, target: Height) -> tuple:
+        """The signatory set a ResetHeight to ``target`` must carry:
+        the committee of ``target``'s epoch (clamped to the latest
+        elected — the schedule cannot see past the last committed
+        boundary), or the static whitelist outside epoch mode."""
+        sched = self.epoch_schedule
+        if sched is None:
+            return tuple(self.signatories)
+        e = min(sched.latest_epoch, sched.epoch_of(target))
+        return sched.signatories(e)
+
+    def _apply_epoch_state(self, i: int, target: Height) -> None:
+        """Epoch effects of a resync/restore jump to ``target`` that the
+        ResetHeight itself cannot carry: the replica's own (possibly
+        rotated) identity and its certifier's committee rotation. Must
+        run BEFORE the ResetHeight is handled — start_round(0) at the
+        target may make this replica the proposer, and it must propose
+        under its current key."""
+        sched = self.epoch_schedule
+        if sched is None:
+            return
+        e = min(sched.latest_epoch, sched.epoch_of(target))
+        r = self.replicas[i]
+        r.proc.whoami = self._identity[i]
+        if self.certifiers and self.certifiers[i].epoch != e:
+            self.certifiers[i].rotate_to(e)
+        if self._replica_epoch[i] != e:
+            self._replica_epoch[i] = e
+            if r.obs is not _OBS_NULL:
+                r.obs.emit("epoch.switch", target, -1, e)
 
     def _on_sched_drain(self, resolved: int) -> None:
         """Queue drain hook: every in-flight speculative settle just
@@ -1515,10 +1770,10 @@ class Simulation:
         # (replica/replica.go:222-235) on a timer. Swept resyncs are
         # recorded as RESYNC lifecycle ops like any other, so replay
         # reproduces them without knowing the cadence.
-        if steps % _CATCHUP_EVERY == 0:
+        if steps % self._catchup_every == 0:
             net = self._net_height()
-            if net > _CATCHUP_LAG + 1:
-                self._chaos_resync(net, lag=_CATCHUP_LAG)
+            if net > self._catchup_lag + 1:
+                self._chaos_resync(net, lag=self._catchup_lag)
 
     def _chaos_deliver(self, to: int, msg):
         """Apply the fault plan to one pending delivery. Returns the
@@ -1587,12 +1842,13 @@ class Simulation:
         (the periodic sweep) tolerates the normal commit wavefront —
         only a replica the network has demonstrably left behind is
         rescued."""
-        sigs = tuple(self.signatories)
+        sigs = self._resync_sigs(target)
         resynced = 0
         for i in range(self.n):
             r = self.replicas[i]
             if self.alive[i] and target - r.proc.current_height > lag:
                 self._note_lifecycle(ScenarioRecord.OP_RESYNC, i, target)
+                self._apply_epoch_state(i, target)
                 r.handle(ResetHeight(height=target, signatories=sigs))
                 resynced += 1
         return resynced
@@ -1699,13 +1955,17 @@ class Simulation:
         r.restore(self._ckpt_store.latest(victim))
         self.alive[victim] = True
         if net_height > r.proc.current_height:
+            self._apply_epoch_state(victim, net_height)
             r.handle(
                 ResetHeight(
                     height=net_height,
-                    signatories=tuple(self.signatories),
+                    signatories=self._resync_sigs(net_height),
                 )
             )
         else:
+            # The checkpoint's whoami may predate a rotation that
+            # happened while the victim was down (epoch mode).
+            self._apply_epoch_state(victim, r.proc.current_height)
             r.proc.resume()
         if not any(
             h >= self.target_height for h in self.commits[victim]
@@ -1725,9 +1985,10 @@ class Simulation:
         elif kind == ScenarioRecord.OP_RESTORE:
             self._apply_restore(replica, aux)
         else:  # OP_RESYNC
+            self._apply_epoch_state(replica, aux)
             self.replicas[replica].handle(
                 ResetHeight(
-                    height=aux, signatories=tuple(self.signatories)
+                    height=aux, signatories=self._resync_sigs(aux)
                 )
             )
 
@@ -2759,6 +3020,17 @@ class Simulation:
         settled, reproducing the original window boundaries (pass
         ``batch_verifier=`` to re-verify during replay).
         """
+        if record.epochs is not None and "epochs" not in kwargs:
+            from hyperdrive_tpu.epochs import EpochConfig
+
+            epoch_length, committee, rekey, eseed, stakes = record.epochs
+            kwargs["epochs"] = EpochConfig(
+                epoch_length=epoch_length,
+                committee_size=committee,
+                rekey_per_epoch=rekey,
+                seed=eseed,
+                stakes=stakes,
+            )
         sim = cls(
             n=record.n,
             target_height=record.target_height,
@@ -2819,6 +3091,7 @@ class Simulation:
             commits=sim.commits,
             record=record,
             alive=sim.alive,
+            sim=sim,
         )
 
 

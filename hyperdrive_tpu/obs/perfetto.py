@@ -33,6 +33,11 @@ _INSTANTS = {
     "sched.coalesce": "coalesce",
     "sched.drain": "drain",
     "sched.gated": "commit gated",
+    "epoch.begin": "epoch begin",
+    "epoch.elect": "epoch elect",
+    "epoch.switch": "epoch switch",
+    "epoch.proof": "epoch proof",
+    "epoch.stale_vote": "stale vote",
 }
 
 _PHASE_OPENERS = {

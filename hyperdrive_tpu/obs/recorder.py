@@ -84,6 +84,11 @@ EVENT_KINDS = frozenset(
         "chaos.heal",
         "chaos.crash",
         "chaos.restore",
+        "epoch.begin",
+        "epoch.elect",
+        "epoch.switch",
+        "epoch.proof",
+        "epoch.stale_vote",
     }
 )
 

@@ -707,6 +707,15 @@ class TpuBatchVerifier:
         #: :mod:`hyperdrive_tpu.certificates` folds into emitted quorum
         #: certificates. b"" until the first RLC chunk verifies.
         self.last_transcript = b""
+        #: Epoch-keyed pubkey-table generation (epochs.py). When nonzero
+        #: it is framed into the RLC binder — and therefore into
+        #: :attr:`last_transcript` — so a certificate minted off a queued
+        #: launch commits to WHICH validator-set generation verified its
+        #: quorum. The DeviceWorkQueue's drain calls
+        #: :meth:`set_generation` before each coalesced launch; windows
+        #: from different generations never share a batch (queue.py
+        #: groups by (launcher, generation)).
+        self.generation = 0
         #: How many windows fell back to the per-signature kernel.
         self.rlc_fallbacks = 0
         #: Flight-recorder handle (obs/recorder.py; NULL_BOUND = off).
@@ -752,6 +761,16 @@ class TpuBatchVerifier:
         if self.backend == "pallas":
             return self._pallas_verify(batch)
         return verify_kernel
+
+    def set_generation(self, generation: int) -> None:
+        """Install the epoch table generation for subsequent launches.
+
+        Called by the async queue's drain right before a coalesced
+        launch whose commands carry a nonzero generation tag; blocking
+        callers may set :attr:`generation` directly at rotation time.
+        The ladder itself is table-free (pubkeys ride in each lane), so
+        the swap is pure transcript binding — O(1), no device traffic."""
+        self.generation = int(generation)
 
     def warmup(self) -> None:
         """Compile the kernel for every bucket shape up front (XLA compiles
@@ -810,6 +829,17 @@ class TpuBatchVerifier:
                     + s
                     for p, d, s in chunk
                 )
+                if self.generation:
+                    # Generation frame first: the z weights and the
+                    # bound transcript both commit to the pubkey-table
+                    # generation the launch verified under, so an
+                    # epoch-N certificate can never replay an
+                    # epoch-N+1 launch's transcript (or vice versa).
+                    binder = (
+                        b"hd-gen"
+                        + int(self.generation).to_bytes(8, "little")
+                        + binder
+                    )
                 m_nib, z_nib, c_nib = rlc_scalars(
                     arrays[5], arrays[6], prevalid, binder
                 )

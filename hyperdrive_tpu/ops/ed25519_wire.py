@@ -697,6 +697,13 @@ class TpuWireVerifier:
         #: size gate here would duplicate routing that already exists a
         #: layer up.
         self.table = table
+        #: Epoch table generations (epochs.py), double-buffered: the
+        #: current AND previous generation's ValidatorTable stay device-
+        #: resident so a drain straddling an epoch boundary can launch
+        #: the old generation's windows and the new generation's windows
+        #: back-to-back without re-uploading either table.
+        self.generation = 0
+        self._tables: dict = {0: table} if table is not None else {}
         self._chal_fn = make_chalwire_verify_fn(jit=True)
         self._chal_grouped = make_challenge_grouped_fn()
         self._semi_fn = make_semiwire_verify_fn(jit=True)
@@ -714,6 +721,41 @@ class TpuWireVerifier:
             "format_bytes": 0,
         }
         self._stats_lock = threading.Lock()
+
+    def install_table(self, table, generation=None) -> None:
+        """Hot-swap the resident validator table at an epoch boundary.
+
+        The new generation's coordinate tensors upload here (off the
+        verify path); the PREVIOUS generation's table is retained so
+        in-flight windows tagged with the old generation still verify
+        against the keys they were signed under. Older generations are
+        evicted — two live tables bound device memory at 2x one epoch's
+        committee, and anything older is stale by the retired-key rule
+        (replica.py rejects those votes before they reach a verifier)."""
+        if generation is None:
+            generation = self.generation + 1
+        generation = int(generation)
+        prev = self.generation
+        self._tables = {
+            g: t for g, t in self._tables.items() if g == prev
+        }
+        self._tables[generation] = table
+        self.table = table
+        self.generation = generation
+
+    def set_generation(self, generation: int) -> None:
+        """Select which resident table generation the next launch uses
+        (the DeviceWorkQueue drain hook). Only the double-buffered
+        current/previous generations are addressable."""
+        generation = int(generation)
+        got = self._tables.get(generation)
+        if got is None:
+            raise KeyError(
+                f"table generation {generation} is not resident "
+                f"(have {sorted(self._tables)})"
+            )
+        self.table = got
+        self.generation = generation
 
     def reset_stats(self) -> None:
         with self._stats_lock:

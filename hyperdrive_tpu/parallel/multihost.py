@@ -105,12 +105,29 @@ class ShardVerifyService:
         self.certificates.setdefault(tenant, {})[cert.height] = cert
         return True
 
-    def submit(self, tenant, items):
+    def submit(self, tenant, items, generation: int = 0):
         """Enqueue one tenant's verify batch; returns its
         :class:`~hyperdrive_tpu.devsched.DeviceFuture`. ``tenant`` is an
-        opaque accounting key (replica id, shard id)."""
+        opaque accounting key (replica id, shard id). ``generation``
+        tags the batch with its epoch pubkey-table generation
+        (epochs.py): tenants on different generations — mid-rotation,
+        some tenants already switched — still share the queue, but
+        their windows coalesce per generation, never into a mixed-key
+        launch."""
         self.tenants[tenant] = self.tenants.get(tenant, 0) + 1
-        return self.queue.submit(self._launcher, items)
+        return self.queue.submit(self._launcher, items, generation)
+
+    def rotate(self, generation: int, table=None) -> None:
+        """Propagate an epoch rotation to the shared verifier: installs
+        ``table`` when the verifier holds resident state
+        (:meth:`~hyperdrive_tpu.ops.ed25519_wire.TpuWireVerifier.
+        install_table` double-buffers it) and records the generation on
+        transcript-binding verifiers. Tenants then pass ``generation``
+        to :meth:`submit`; in-flight commands keep their old tag."""
+        if table is not None and hasattr(self.verifier, "install_table"):
+            self.verifier.install_table(table, generation)
+        elif hasattr(self.verifier, "set_generation"):
+            self.verifier.set_generation(generation)
 
     def flusher(self, validators, **kwargs):
         """A queue-backed :class:`~hyperdrive_tpu.tallyflush.

@@ -260,6 +260,17 @@ class Replica:
         # bound so a current-height flood cannot bypass DoS limits.
         self._lane: list = []
         self._lane_counts: dict = {}
+        #: Retired identities (epochs.py key rotation): signatory ->
+        #: first height at which votes under it are stale. The bound is
+        #: a height, not a blanket ban, because the retiring boundary's
+        #: own height legitimately carries old-key votes — a laggard
+        #: still finishing it must keep accepting them. The harness
+        #: shares one dict by reference across all replicas; deployments
+        #: populate it from their epoch schedule. Empty = no admission
+        #: cost beyond one truthiness check.
+        self.retired: dict = {}
+        #: Stale-generation votes rejected (epoch.stale_vote events).
+        self.stale_votes = 0
 
     # --------------------------------------------------------- observability
 
@@ -424,6 +435,7 @@ class Replica:
         cap = self.opts.max_capacity
         cur = self.proc.current_height
         dh = self.did_handle_message
+        retired = self.retired
         n_pv = n_pc = n_pp = 0
         for msg in msgs:
             t = type(msg)
@@ -435,6 +447,13 @@ class Replica:
                 else:
                     n_pp += 1
                 h = msg.height
+                if retired:
+                    bad_from = retired.get(msg.sender)
+                    if bad_from is not None and h >= bad_from:
+                        self._note_stale(msg)
+                        if dh is not None:
+                            dh()
+                        continue
                 if h >= cur:
                     if h == cur:
                         c = counts.get(msg.sender, 0)
@@ -531,6 +550,11 @@ class Replica:
         cur = self.proc.current_height
         if h < cur:
             return
+        if self.retired:
+            bad_from = self.retired.get(msg.sender)
+            if bad_from is not None and h >= bad_from:
+                self._note_stale(msg)
+                return
         if h == cur and self.opts.external_flush:
             c = self._lane_counts.get(msg.sender, 0)
             if c < self.opts.max_capacity:
@@ -543,6 +567,33 @@ class Replica:
             self.mq.insert_prevote(msg)
         else:
             self.mq.insert_precommit(msg)
+
+    def _note_stale(self, msg) -> None:
+        """A vote signed under a retired key generation at a height
+        where the rotation is already binding: drop it before it can
+        buffer. First rejection logs at WARNING (the
+        ``transport.peer.dropped`` convention — one loud line per
+        replica, then counters); every rejection emits
+        ``epoch.stale_vote`` so round-anatomy reports see the churn."""
+        self.stale_votes += 1
+        if self.stale_votes == 1:
+            self.logger.warning(
+                "stale-generation vote %s",
+                _kv(
+                    sender=msg.sender,
+                    height=msg.height,
+                    stale_from=self.retired.get(msg.sender),
+                ),
+            )
+        if self.tracer is not NULL_TRACER:
+            self.tracer.count("replica.msg.stale_vote")
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "epoch.stale_vote",
+                msg.height,
+                getattr(msg, "round", -1),
+                self.stale_votes,
+            )
 
     def _flush(self) -> None:
         """Drain the queue into the Process until quiescent

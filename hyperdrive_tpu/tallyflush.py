@@ -77,6 +77,13 @@ class DeviceTallyFlusher:
             1, len(validators), r_slots=r_slots, buckets=buckets
         )
         self._pos = {s: i for i, s in enumerate(validators)}
+        self._r_slots = r_slots
+        self._buckets = buckets
+        #: Epoch-keyed pubkey-table generation (epochs.py). Tags every
+        #: queued verify command so the DeviceWorkQueue never coalesces
+        #: windows from different validator-set generations into one
+        #: launch — a drain spanning an epoch boundary splits instead.
+        self.generation = 0
         if tally_check is None:
             # Sanitizer HDS004 (ANALYSIS.md): under HD_SANITIZE every
             # launch's device counts are cross-checked against the host
@@ -148,6 +155,34 @@ class DeviceTallyFlusher:
         if self.certifier is not None:
             self.certifier.reset()
 
+    def rotate_validators(self, validators, generation=None) -> None:
+        """Install the next epoch's signatory list (whitelist order).
+
+        Epoch-boundary hot swap: rebuilds the sender->column map, grows
+        a fresh grid when the committee size changed (same-size
+        committees reuse the allocation — the next settle's height move
+        resets the plane anyway), and bumps :attr:`generation` so queued
+        verify commands submitted after this point land in their own
+        coalesced launch. In-flight windows keep their OLD generation
+        tag: the queue settles them under the table they were signed
+        against, never a mixed batch."""
+        validators = list(validators)
+        if generation is None:
+            generation = self.generation + 1
+        self.generation = int(generation)
+        if len(validators) != self.grid.V:
+            from hyperdrive_tpu.ops.votegrid import VoteGrid
+
+            self.grid = VoteGrid(
+                1, len(validators), r_slots=self._r_slots,
+                buckets=self._buckets,
+            )
+        self._pos = {s: i for i, s in enumerate(validators)}
+        # Pre-rotation scatters are meaningless under the new column
+        # order; force the next settle to reset the grid plane.
+        self._height = None
+        self._dirty = set()
+
     @async_scope
     def _flush_async(self, replica) -> None:
         """The devsched flush schedule: drain windows NOW, settle at the
@@ -178,6 +213,7 @@ class DeviceTallyFlusher:
             fut = queue.submit(
                 launcher,
                 [(m.sender, m.digest(), m.signature) for m in window],
+                self.generation,
             )
             self._inflight.append(fut)
 

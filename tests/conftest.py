@@ -48,6 +48,21 @@ jax.config.update(
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
+# Capability probes: environment-blocked features, not code defects.
+# Tests that need them carry `requires_shard_map` / `requires_multiprocess`
+# markers and are skipped WITH A REASON when the probe fails, so tier-1
+# output separates "this build can't run it" from "this code is broken".
+#
+# - shard_map: the sharded verify/tally paths call the first-class
+#   ``jax.shard_map`` API; older jax builds only ship the
+#   ``jax.experimental`` spelling and fail with AttributeError.
+# - multiprocess: the two-process distributed tests need a jaxlib whose
+#   CPU backend can host cross-process collectives; builds without the
+#   distributed runtime raise XlaRuntimeError at
+#   ``jax.distributed.initialize``.
+HAS_SHARD_MAP = hasattr(jax, "shard_map")
+HAS_MULTIPROCESS = HAS_SHARD_MAP and jax.__version_info__ >= (0, 5)
+
 
 def pytest_configure(config):
     # Tier-1 runs `-m 'not slow'` under a hard wall-clock cap (ROADMAP):
@@ -59,12 +74,36 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 `-m 'not slow'` run"
     )
+    config.addinivalue_line(
+        "markers",
+        "requires_shard_map: needs the first-class jax.shard_map API",
+    )
+    config.addinivalue_line(
+        "markers",
+        "requires_multiprocess: needs a multiprocess-collective jaxlib",
+    )
     # Stdlib line-coverage measurement (no pytest-cov in the build
     # image) — see tests/_linecov.py. Opt-in: HD_LINECOV=1.
     if os.environ.get("HD_LINECOV"):
         import _linecov
 
         _linecov.start()
+
+
+def pytest_collection_modifyitems(config, items):
+    skip_sm = pytest.mark.skip(
+        reason="this jax build has no first-class jax.shard_map "
+        f"(jax {jax.__version__})"
+    )
+    skip_mp = pytest.mark.skip(
+        reason="this jaxlib has no multiprocess collective runtime "
+        f"(jax {jax.__version__})"
+    )
+    for item in items:
+        if not HAS_SHARD_MAP and "requires_shard_map" in item.keywords:
+            item.add_marker(skip_sm)
+        if not HAS_MULTIPROCESS and "requires_multiprocess" in item.keywords:
+            item.add_marker(skip_mp)
 
 
 def pytest_sessionfinish(session, exitstatus):

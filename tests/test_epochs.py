@@ -1,0 +1,329 @@
+"""Dynamic validator sets: election, epoch proofs, churn under chaos.
+
+Unit layer: the stake-weighted proportional election (arXiv:2004.12990),
+the EpochSchedule state machine (strict boundary order, idempotence,
+fork detection, key rotation + retirement), and the epoch-proof wire
+format + O(1)-per-hop chain verification.
+
+Integration layer: full Simulation runs with ``epochs=EpochConfig(...)``
+— record/replay determinism, stale-generation vote rejection,
+checkpoint restore across an epoch boundary, the catchup-sweep rejoin
+spec, and the 64-validator churn acceptance scenario (partition spanning
+a boundary, crash-restore inside it, ~25% membership churn + one key
+rotation per epoch).
+"""
+
+import dataclasses
+
+import pytest
+
+from hyperdrive_tpu.chaos.monitor import InvariantMonitor
+from hyperdrive_tpu.chaos.plan import CrashRestart, FaultPlan, Partition
+from hyperdrive_tpu.codec import Reader, Writer
+from hyperdrive_tpu.epochs import (
+    EpochChainError,
+    EpochConfig,
+    EpochSchedule,
+    default_signatory,
+    elect_committee,
+    marshal_epoch_proof,
+    set_digest,
+    unmarshal_epoch_proof,
+    verify_epoch_chain,
+)
+from hyperdrive_tpu.harness.sim import ScenarioRecord, Simulation
+from hyperdrive_tpu.messages import Prevote
+
+V = bytes(range(32))
+
+
+# ----------------------------------------------------------------- election
+
+
+def test_elect_committee_deterministic_distinct_sized():
+    stakes = (3, 1, 4, 1, 5, 9, 2, 6)
+    a = elect_committee(stakes, 5, b"material")
+    b = elect_committee(stakes, 5, b"material")
+    assert a == b
+    assert len(a) == 5 and len(set(a)) == 5
+    assert all(0 <= i < 8 for i in a)
+    # Different material draws a different committee (overwhelmingly).
+    assert elect_committee(stakes, 5, b"other") != a
+
+
+def test_elect_committee_is_stake_proportional():
+    # One validator holds ~90% of total stake: it must win a seat in
+    # essentially every election. A uniform sampler would seat it in
+    # only k/n of them.
+    stakes = (100,) + (1,) * 11
+    wins = sum(
+        0 in elect_committee(stakes, 3, b"m%d" % i) for i in range(64)
+    )
+    assert wins >= 60
+    # Zero-stake candidates are never seated.
+    stakes = (0, 1, 1, 1)
+    for i in range(16):
+        assert 0 not in elect_committee(stakes, 3, b"z%d" % i)
+
+
+def test_elect_committee_rejects_oversized():
+    with pytest.raises(ValueError):
+        elect_committee((1, 0, 1), 3, b"m")  # only 2 staked candidates
+
+
+# ----------------------------------------------------------------- schedule
+
+
+def test_schedule_boundaries_and_strict_order():
+    sched = EpochSchedule((1,) * 8, 6, 2, 5)
+    assert sched.epoch_of(1) == 0 and sched.epoch_of(2) == 0
+    assert sched.epoch_of(3) == 1 and sched.epoch_of(4) == 1
+    assert sched.is_boundary(2) and sched.is_boundary(4)
+    assert not sched.is_boundary(1) and not sched.is_boundary(3)
+    assert sched.boundary_height(0) == 2  # commit at 2 elects epoch 1
+    assert sched.boundary_height(1) == 4
+    with pytest.raises(ValueError):
+        sched.transition_at(4, V)  # epoch 2's boundary before epoch 1's
+    # Querying a committee that does not exist yet raises too.
+    with pytest.raises(Exception):
+        sched.signatories(1)
+
+
+def test_schedule_rotation_retires_old_identity():
+    sched = EpochSchedule((1,) * 8, 6, 2, 5, rekey_per_epoch=1)
+    tr = sched.transition_at(2, V)
+    assert tr.epoch == 1
+    assert len(tr.committee) == 6 == len(tr.signatories)
+    assert tr.set_digest == set_digest(tr.signatories)
+    assert len(tr.rekeyed) == 1 == len(tr.retired)
+    idx = tr.rekeyed[0]
+    assert sched.generation_of(idx) == 1
+    assert tr.retired[0] == default_signatory(idx, 0)
+    assert default_signatory(idx, 1) not in tr.retired
+    # Idempotent: the same boundary value returns the same transition.
+    assert sched.transition_at(2, V).set_digest == tr.set_digest
+    # Fork check: a different value at the same boundary is a safety
+    # violation and must raise, not silently recompute.
+    with pytest.raises(ValueError):
+        sched.transition_at(2, bytes(32))
+
+
+def test_schedule_committee_subset_of_pool():
+    sched = EpochSchedule((1,) * 10, 7, 3, 9)
+    for e, h in ((1, 3), (2, 6), (3, 9)):
+        tr = sched.transition_at(h, bytes([e]) * 32)
+        assert len(tr.signatories) == 7
+        assert {v.index for v in tr.committee} <= set(range(10))
+        assert sched.f(e) == 7 // 3
+    assert sched.latest_epoch == 3
+
+
+# -------------------------------------------------------------- epoch proofs
+
+
+def _epoch_sim(n=8, target=8, seed=3, **kw):
+    kw.setdefault(
+        "epochs",
+        EpochConfig(epoch_length=2, committee_size=6, rekey_per_epoch=1),
+    )
+    kw.setdefault("certificates", True)
+    kw.setdefault("observe", True)
+    return Simulation(n, target, seed=seed, **kw)
+
+
+def _union_proofs(sim):
+    covered = {}
+    for c in sim.certifiers:
+        for e, pr in c.proofs.items():
+            covered.setdefault(e, pr)
+    return [covered[e] for e in sorted(covered)]
+
+
+def test_epoch_proof_chain_verifies_and_roundtrips():
+    sim = _epoch_sim()
+    res = sim.run()
+    assert res.completed
+    proofs = _union_proofs(sim)
+    assert [p.epoch for p in proofs] == list(range(1, sim.epoch + 1))
+    genesis = sim.epoch_schedule.signatories(0)
+    assert verify_epoch_chain(genesis, proofs) == len(proofs)
+
+    # Wire roundtrip: marshal -> unmarshal -> marshal is a fixed point
+    # and the rehydrated chain still verifies.
+    def blob(ps):
+        w = Writer()
+        for p in ps:
+            marshal_epoch_proof(p, w)
+        return w.data()
+
+    r = Reader(blob(proofs))
+    back = [unmarshal_epoch_proof(r) for _ in proofs]
+    assert blob(back) == blob(proofs)
+    assert verify_epoch_chain(genesis, back) == len(proofs)
+
+
+def test_epoch_proof_chain_rejects_tampering():
+    sim = _epoch_sim()
+    sim.run()
+    proofs = _union_proofs(sim)
+    genesis = sim.epoch_schedule.signatories(0)
+    # Tampered next-set digest: the certificate no longer commits to it.
+    bad = list(proofs)
+    bad[0] = dataclasses.replace(bad[0], next_set_digest=bytes(32))
+    with pytest.raises(EpochChainError):
+        verify_epoch_chain(genesis, bad)
+    # A gap in the chain is not a verifiable chain.
+    if len(proofs) >= 2:
+        with pytest.raises(EpochChainError):
+            verify_epoch_chain(genesis, [proofs[0], *proofs[2:]])
+    # Wrong genesis: hop 1's certificate was signed by nobody we trust.
+    with pytest.raises(EpochChainError):
+        verify_epoch_chain([bytes(32)] * len(genesis), proofs)
+
+
+# ---------------------------------------------------------- harness behavior
+
+
+def test_epoch_sim_record_replays_identically(tmp_path):
+    sim = _epoch_sim(seed=11)
+    res = sim.run()
+    assert res.completed and sim.epoch >= 3
+    path = str(tmp_path / "epochs.bin")
+    sim.record.dump(path)
+    rec = ScenarioRecord.load(path)
+    assert rec.epochs is not None
+    replayed = Simulation.replay(rec, certificates=True)
+    assert replayed.commits == res.commits
+    assert replayed.completed
+
+
+def test_stale_generation_vote_rejected():
+    sim = _epoch_sim(seed=13)
+    r = sim.replicas[0]
+    old = sim.signatories[1]
+    r.retired = {old: 3}
+    # At or past the retirement bound: dropped, counted, never buffered.
+    r.handle(Prevote(height=5, round=0, value=V, sender=old))
+    assert r.stale_votes == 1
+    r.handle(Prevote(height=7, round=0, value=V, sender=old))
+    assert r.stale_votes == 2
+    # Below the bound the old key is still valid — a laggard finishing
+    # the boundary height keeps its quorum. No stale count.
+    r.handle(Prevote(height=2, round=0, value=V, sender=old))
+    assert r.stale_votes == 2
+    kinds = [e.kind for e in sim.obs.snapshot()]
+    assert kinds.count("epoch.stale_vote") == 2
+
+
+def test_checkpoint_restore_across_epoch_boundary():
+    # Crash a replica, keep it down long enough that the network crosses
+    # at least one epoch boundary (election + key rotation) while only
+    # its checkpoint survives; the restore path must re-apply epoch
+    # state (rotated whoami, new committee whitelist) BEFORE rejoining,
+    # and the run must stay fork- and equivocation-free.
+    victim = 5
+    plan = FaultPlan(
+        crashes=(
+            CrashRestart(
+                replica=victim, crash_at_step=400, restart_after_steps=3000
+            ),
+        )
+    )
+    sim = _epoch_sim(seed=17, target=10, chaos=plan, delivery_cost=1e-3)
+    mon = InvariantMonitor(sim)
+    res = sim.run(max_steps=400_000)
+    mon.check_final(res)
+    assert mon.crashes and mon.restores
+    # The network moved past epoch 1's boundary while the victim was
+    # down: its restore resynced it beyond that boundary.
+    assert mon.restores[0][1] > sim.epoch_schedule.boundary_height(0)
+    r = sim.replicas[victim]
+    assert r.proc.whoami == sim._identity[victim]
+    assert not any(
+        e.kind == "equivocation" for e in sim.obs.snapshot()
+    ), "restored replica equivocated"
+
+
+def test_catchup_sweep_bounds_rejoin_latency():
+    # With heal-time resync disabled, the periodic laggard sweep is the
+    # ONLY rejoin mechanism — so a tighter sweep cadence must strictly
+    # bound how long an isolated replica stays behind, observable as
+    # total steps to completion.
+    def run(every):
+        plan = FaultPlan(
+            partitions=(
+                Partition(
+                    at=0.5,
+                    heal=1.5,
+                    groups=((3,),),
+                    resync_on_heal=False,
+                ),
+            )
+        )
+        sim = Simulation(
+            8,
+            8,
+            seed=23,
+            delivery_cost=1e-3,
+            chaos=plan,
+            catchup_every=every,
+        )
+        res = sim.run(max_steps=400_000)
+        assert res.completed
+        return res.steps
+
+    assert run(64) <= run(1024)
+
+
+def test_catchup_params_validate():
+    with pytest.raises(ValueError):
+        Simulation(4, 2, seed=1, catchup_every=0)
+    with pytest.raises(ValueError):
+        Simulation(4, 2, seed=1, catchup_lag=-1)
+
+
+# ------------------------------------------------------- acceptance scenario
+
+
+def test_acceptance_64_validator_churn(tmp_path):
+    # The ISSUE acceptance scenario: 64 validators, committee 48 (~25%
+    # expected churn per election) + one key rotation per epoch, >= 3
+    # epoch transitions, a partition spanning a boundary with a
+    # crash-restore inside it. All honest replicas commit identical
+    # digests, the union epoch-proof chain verifies end-to-end, the
+    # invariant monitor stays silent, and the run replays exactly from
+    # its own dumped record.
+    n = 64
+    plan = FaultPlan.churn(7, n, est_virtual_time=8.0)
+    assert plan.partitions and plan.crashes
+    sim = Simulation(
+        n,
+        13,
+        seed=7,
+        timeout=5.0,
+        delivery_cost=1e-4,
+        epochs=EpochConfig(
+            epoch_length=4, committee_size=48, rekey_per_epoch=1
+        ),
+        certificates=True,
+        observe=True,
+        chaos=plan,
+    )
+    mon = InvariantMonitor(sim, max_rounds_after_heal=12)
+    res = sim.run(max_steps=3_000_000)
+    mon.check_final(res)  # fork/digest/liveness/epoch invariants
+    assert res.completed
+    assert sim.epoch >= 3 and len(mon.epoch_switches) >= 3
+    assert mon.heals and mon.crashes and mon.restores
+    assert sim._retired, "no key was ever rotated out"
+
+    proofs = _union_proofs(sim)
+    assert [p.epoch for p in proofs] == list(range(1, sim.epoch + 1))
+    hops = verify_epoch_chain(sim.epoch_schedule.signatories(0), proofs)
+    assert hops == sim.epoch
+
+    path = str(tmp_path / "accept64.bin")
+    sim.record.dump(path)
+    replayed = Simulation.replay(ScenarioRecord.load(path))
+    assert replayed.completed
+    assert replayed.commits == res.commits

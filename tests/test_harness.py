@@ -453,6 +453,7 @@ def test_device_tally_signed_full_pipeline(tmp_path):
     assert replayed.heights == dev.heights
 
 
+@pytest.mark.requires_shard_map
 def test_device_tally_sharded_mesh_consensus():
     # Sharded CONSENSUS on the 8-device virtual mesh: the vote grid's
     # validator axis is split across devices, every settle's quorum counts
@@ -506,6 +507,7 @@ def test_device_tally_sharded_mesh_consensus():
         pytest.param(1024, 1, 72, True, 100_000_000, id="1024-signed"),
     ],
 )
+@pytest.mark.requires_shard_map
 def test_device_tally_sharded_at_scale(n, target, seed, sign, max_steps):
     # The >256-validator operating points (SURVEY §5's scaling story):
     # the vote grid's validator axis sharded 8 ways drives a full

@@ -12,6 +12,13 @@ example IS a scenario, and a failing one shrinks to a minimal
 reproduction.
 """
 
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property-based specs need hypothesis (not in this image)",
+)
+
 from hypothesis import given, settings, strategies as st
 
 from hyperdrive_tpu.harness import Simulation

@@ -25,6 +25,7 @@ def test_devices_available():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.requires_shard_map
 def test_sharded_step_matches_host():
     mesh = make_mesh(hr=2, val=4)
     step = sharded_verify_tally(mesh)
@@ -55,6 +56,7 @@ def test_sharded_step_matches_host():
         assert bool(np.asarray(flags["quorum_matching"])[r])
 
 
+@pytest.mark.requires_shard_map
 def test_sharded_chalwire_matches_packed_step():
     """The 68 B/lane challenge pipeline over the mesh: same signatures
     through sharded_chalwire_tally (device SHA-512 + mod-L + ladder,
@@ -114,6 +116,7 @@ def test_sharded_chalwire_matches_packed_step():
         )
 
 
+@pytest.mark.requires_shard_map
 def test_1d_and_2d_meshes():
     for hr, val in ((1, 8), (2, 4), (4, 2)):
         mesh = make_mesh(hr=hr, val=val)
@@ -130,6 +133,7 @@ def test_mesh_shape_validation():
         make_mesh(hr=3)  # 3 does not divide 8
 
 
+@pytest.mark.requires_shard_map
 def test_dryrun_multichip_is_self_checking():
     """The driver's dry run verifies real signatures and exact psum'd
     tallies — it must pass on the virtual 8-device mesh, and its internal

@@ -64,6 +64,7 @@ def test_replicate_places_full_copy_everywhere():
     np.testing.assert_array_equal(np.asarray(arr), val)
 
 
+@pytest.mark.requires_multiprocess
 def test_sharded_step_on_hybrid_mesh_matches_plain_mesh():
     R, V = 2, 4
     ring = KeyRing.deterministic(V, namespace=b"mh")
@@ -101,6 +102,7 @@ def test_sharded_step_on_hybrid_mesh_matches_plain_mesh():
     assert int(counts_a["matching"][1]) == V - 1
 
 
+@pytest.mark.requires_multiprocess
 def test_two_process_distributed_step_and_consensus():
     # The REAL multi-process branches — jax.distributed rendezvous, hybrid
     # DCN mesh construction, host_local_array_to_global_array,

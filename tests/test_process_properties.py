@@ -17,6 +17,13 @@ asserts on.
 import random
 from types import SimpleNamespace
 
+import pytest
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property-based specs need hypothesis (not in this image)",
+)
+
 from hypothesis import given, settings, strategies as st
 
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer

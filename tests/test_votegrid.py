@@ -192,6 +192,7 @@ def test_view_l28_lane_requires_exact_pair():
     assert view.prevotes_for(1, V_B) is None  # wrong value
 
 
+@pytest.mark.requires_shard_map
 def test_sharded_grid_matches_unsharded():
     # 8-device CPU mesh: validator axis sharded, scatter rows routed by
     # global index, counts psum'd — must equal the single-device grid
