@@ -403,7 +403,8 @@ def run_sustained(validators: int = N_VALIDATORS, rounds: int = ROUNDS,
 
 
 def run_insert_leg(validators: int = N_VALIDATORS, replicas: int = 64,
-                   rounds: int = 2, trials: int = 5):
+                   rounds: int = 2, trials: int = 5,
+                   inject_slowdown: float = 0.0):
     """Host-side automaton INSERT leg: columnar settle fast path
     (``Process.ingest_insert_cols`` over a shared ``WindowColumns`` view)
     against the object path (per-replica keep/allowed filter comprehension
@@ -456,6 +457,11 @@ def run_insert_leg(validators: int = N_VALIDATORS, replicas: int = 64,
         for _ in range(replicas):
             p = Process(senders[0], f=f)
             p.ingest_insert_cols(cols, keep, allowed)
+            if inject_slowdown:
+                # Sentinel self-test hook (tests/test_benchdiff.py):
+                # deliberately tax the columnar leg so the paired-ratio
+                # gate must flag the run.
+                time.sleep(inject_slowdown)
         return total / (time.perf_counter() - t0)
 
     leg_obj(), leg_col()  # warm allocator + bytecode caches
@@ -479,7 +485,100 @@ def run_insert_leg(validators: int = N_VALIDATORS, replicas: int = 64,
     }
 
 
+def run_quick(sim_trials: int = 3, insert_trials: int = 7,
+              heights: int = 8, inject_slowdown: float = 0.0) -> dict:
+    """The pinned quick bench: the CI perf sentinel's input.
+
+    Pure host — the pipelined consensus sim rides the HostVerifier leg
+    and the insert leg never touches a device — so any CPU runner can
+    regenerate it. The artifact nominates its own regression gates via
+    ``benchdiff_gate`` (see obs/benchdiff.py), and only MACHINE-PORTABLE
+    series are gated: the insert leg's paired columnar/object speedup
+    ratios divide the runner's speed out, while the absolute sim wall
+    series stays informational (a committed baseline from one machine
+    must not fail a differently-sized CI runner). The metrics-registry
+    snapshot of the last sim run is embedded whole, so registry-visible
+    regressions (occupancy collapse, queue-wait blowup, launch-count
+    drift — all deterministic under the virtual clock) diff exactly.
+    """
+    from hyperdrive_tpu.harness import Simulation
+
+    kw = dict(
+        n=4, target_height=heights, seed=7, sign=True, burst=True,
+        observe=True, pipeline_heights=True,
+    )
+    sim = None
+    wall = []
+    for _ in range(sim_trials):
+        sim = Simulation(**kw)
+        t0 = time.perf_counter()
+        res = sim.run()
+        wall.append(time.perf_counter() - t0)
+        if not res.completed:
+            raise RuntimeError("quick-bench sim failed to complete")
+    snap = sim.metrics_snapshot()
+
+    insert = run_insert_leg(
+        validators=32, replicas=24, rounds=2, trials=insert_trials,
+        inject_slowdown=inject_slowdown,
+    )
+    # The gated series: paired per-trial ratios under a speedup name so
+    # the sentinel compares them in the higher-is-better direction. Only
+    # the SERIES is gated — its bound adapts to the run's own scatter
+    # (median absolute deviation), where a scalar median would hold the
+    # default threshold against micro-benchmark timer noise.
+    insert["speedup_series"] = insert["insert_leg_paired_ratios"]
+
+    return {
+        "schema": "hyperdrive-quick-bench-v1",
+        "benchdiff_gate": [
+            "insert.speedup_series",
+        ],
+        "insert": insert,
+        "consensus": {
+            "heights": heights,
+            "replicas": kw["n"],
+            "seed": kw["seed"],
+            "sim_trials": sim_trials,
+            "sim_wall_s": [round(w, 4) for w in wall],
+            "journal_digest": sim.obs.digest(),
+            "registry_digest": sim.registry.digest(),
+        },
+        "metrics_snapshot": snap,
+    }
+
+
+def _main_quick(argv) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="python bench.py --quick")
+    p.add_argument("-o", "--output", default=None)
+    p.add_argument("--sim-trials", type=int, default=3)
+    p.add_argument("--insert-trials", type=int, default=7)
+    p.add_argument("--heights", type=int, default=8)
+    p.add_argument("--inject-slowdown", type=float, default=0.0)
+    ns = p.parse_args(argv)
+    out = run_quick(
+        sim_trials=ns.sim_trials, insert_trials=ns.insert_trials,
+        heights=ns.heights, inject_slowdown=ns.inject_slowdown,
+    )
+    blob = json.dumps(out, indent=1, sort_keys=True)
+    if ns.output:
+        with open(ns.output, "w") as fh:
+            fh.write(blob + "\n")
+        print(json.dumps({
+            "quick": ns.output,
+            "journal_digest": out["consensus"]["journal_digest"],
+        }))
+    else:
+        print(blob)
+    return 0
+
+
 def main():
+    if "--quick" in sys.argv[1:]:
+        args = [a for a in sys.argv[1:] if a != "--quick"]
+        sys.exit(_main_quick(args))
     backend = sys.argv[1] if len(sys.argv) > 1 else None
     try:
         r = run_sustained(backend=backend)
@@ -522,6 +621,10 @@ def _consensus_metrics() -> dict:
         return {
             "tracer_snapshot": sim.tracer.snapshot(),
             "commit_anatomy": phase_summary(sim.obs.snapshot()),
+            # The uniform registry view (tracer series absorbed +
+            # devtel/launch series when pipelining): what the obs CLI's
+            # ``metrics`` subcommand and the quick bench also export.
+            "metrics_snapshot": sim.metrics_snapshot(),
         }
     except Exception as e:  # the rider must never sink the headline run
         return {"consensus_metrics_error": str(e)}

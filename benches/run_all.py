@@ -72,6 +72,10 @@ def _sim_metrics(sim, res, wall: float) -> dict:
         # self-contained record: a regression diff never needs a re-run
         # to ask "what did sim.settle.* look like that day".
         "tracer_snapshot": snap,
+        # The uniform registry view (tracer absorbed + devtel launch
+        # series when the run pipelines): the same shape the obs CLI's
+        # ``metrics`` subcommand and the quick-bench sentinel export.
+        "metrics_snapshot": sim.metrics_snapshot(),
     }
     if len(sim.obs):
         from hyperdrive_tpu.obs.report import phase_summary

@@ -22,6 +22,7 @@ __all__ = [
     "drain_point",
     "device_fetch",
     "set_fetch_observer",
+    "set_fetch_probe",
 ]
 
 #: Optional callback invoked with the ``why`` string on every
@@ -36,6 +37,21 @@ def set_fetch_observer(cb) -> None:
     """Install (or, with None, remove) the device_fetch observer."""
     global _fetch_observer
     _fetch_observer = cb
+
+
+#: Optional timing probe bracketing the materialization itself — the
+#: device-telemetry tap (obs/devtel.py) installs it for the duration of
+#: one coalesced launch so the launch's wall time decomposes into a
+#: sync share. Same discipline as the observer: module global, default
+#: None, one load + None check on the untapped path.
+_fetch_probe = None
+
+
+def set_fetch_probe(probe) -> None:
+    """Install (or, with None, remove) the device_fetch timing probe —
+    an object with ``fetch_begin(why)`` / ``fetch_end(why)`` hooks."""
+    global _fetch_probe
+    _fetch_probe = probe
 
 
 def hot_path(fn=None):
@@ -106,4 +122,11 @@ def device_fetch(x, *, why: str = ""):
 
     if _fetch_observer is not None:
         _fetch_observer(why)
+    probe = _fetch_probe
+    if probe is not None:
+        probe.fetch_begin(why)
+        try:
+            return np.asarray(x)
+        finally:
+            probe.fetch_end(why)
     return np.asarray(x)

@@ -665,6 +665,14 @@ class MetricNameRule:
 
     Everything else — f-strings, concatenation, ``.format()``/arbitrary
     call results, non-conforming literals — is flagged.
+
+    Additionally, ``.emit`` literals under the *closed* event families
+    (``sched.launch.*``, ``verify.occupancy.*``, ``metrics.*``) must be
+    members of the recorder's EVENT_KINDS taxonomy: these families are
+    machine-consumed (Perfetto device track, tenant report, registry
+    snapshot), so a well-formed-but-unknown name there is a silent
+    taxonomy fork the journal digest test cannot catch in files the
+    test's grep does not cover.
     """
 
     code = "HD005"
@@ -674,6 +682,9 @@ class MetricNameRule:
     _METHODS = frozenset({"count", "observe", "span", "emit"})
     _RECEIVERS = frozenset({"tracer", "obs", "recorder"})
     _NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
+    #: Event-name prefixes whose membership is closed: an ``.emit``
+    #: literal under one of these must appear in EVENT_KINDS verbatim.
+    _CLOSED_PREFIXES = ("sched.launch.", "verify.occupancy.", "metrics.")
 
     def check(self, ctx):
         findings: list = []
@@ -688,7 +699,7 @@ class MetricNameRule:
             recv = _dotted(n.func.value)
             if recv is None or recv.split(".")[-1] not in self._RECEIVERS:
                 continue
-            problem = self._problem(n.args[0])
+            problem = self._problem(n.args[0], n.func.attr)
             if problem:
                 findings.append(Finding(
                     self.code, ctx.path, n.lineno,
@@ -698,16 +709,31 @@ class MetricNameRule:
                 ))
         return findings
 
-    def _problem(self, arg):
+    def _problem(self, arg, method="count"):
         """None if ``arg`` is an acceptable name form, else a description."""
         if isinstance(arg, ast.Constant):
             if isinstance(arg.value, str) and self._NAME_RE.match(arg.value):
+                if method == "emit" and arg.value.startswith(
+                    self._CLOSED_PREFIXES
+                ):
+                    # Imported lazily so the lint core stays importable
+                    # even if the obs package is being refactored.
+                    from hyperdrive_tpu.obs.recorder import EVENT_KINDS
+
+                    if arg.value not in EVENT_KINDS:
+                        return (
+                            f"literal {arg.value!r} is under a closed "
+                            "event family but is not in EVENT_KINDS"
+                        )
                 return None
             return f"literal {arg.value!r} is not lowercase dotted form"
         if isinstance(arg, (ast.Name, ast.Attribute, ast.Subscript)):
             return None  # table lookup; literals audited where defined
         if isinstance(arg, ast.IfExp):
-            return self._problem(arg.body) or self._problem(arg.orelse)
+            return (
+                self._problem(arg.body, method)
+                or self._problem(arg.orelse, method)
+            )
         if isinstance(arg, ast.JoinedStr):
             return "is an f-string built per call"
         if isinstance(arg, ast.BinOp):

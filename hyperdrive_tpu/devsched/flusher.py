@@ -61,6 +61,11 @@ class QueueFlusher:
             fut = queue.submit(
                 launcher,
                 [(m.sender, m.digest(), m.signature) for m in window],
+                origin=(
+                    self.obs.replica
+                    if self.obs is not NULL_BOUND else None
+                ),
+                rows=len(window),
             )
             self._inflight.append(fut)
             self.submitted += 1

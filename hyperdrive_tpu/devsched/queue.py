@@ -20,6 +20,7 @@ ShardVerifyService`).
 from __future__ import annotations
 
 from hyperdrive_tpu.analysis.annotations import drain_point
+from hyperdrive_tpu.obs.devtel import NULL_DEVTEL
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
 __all__ = [
@@ -53,7 +54,10 @@ class DeviceFuture:
     when called early (the blocking escape hatch — inside async scopes
     prefer ``add_done_callback``, which HD006 enforces)."""
 
-    __slots__ = ("_queue", "_value", "_done", "_cancelled", "_callbacks")
+    __slots__ = (
+        "_queue", "_value", "_done", "_cancelled", "_callbacks",
+        "seq", "launch_id",
+    )
 
     def __init__(self, queue: "DeviceWorkQueue"):
         self._queue = queue
@@ -61,6 +65,12 @@ class DeviceFuture:
         self._done = False
         self._cancelled = False
         self._callbacks: list = []
+        #: Device-telemetry attribution (obs/devtel.py): the command's
+        #: submission sequence number, and — once resolved — the id of
+        #: the coalesced launch that carried it. Both stay None when
+        #: the queue runs unprobed.
+        self.seq = None
+        self.launch_id = None
 
     def done(self) -> bool:
         return self._done
@@ -186,12 +196,16 @@ class DeviceWorkQueue:
     future does.
     """
 
-    def __init__(self, max_depth: int = 0, obs=None, tracer=None):
+    def __init__(self, max_depth: int = 0, obs=None, tracer=None,
+                 devtel=None):
         self.max_depth = int(max_depth)
         self.obs = obs if obs is not None else NULL_BOUND
         self.tracer = tracer
+        #: Launch probe (obs/devtel.py): NULL_DEVTEL = off, and every
+        #: probe touch point below guards on that identity first.
+        self.devtel = devtel if devtel is not None else NULL_DEVTEL
         self.on_drain = None
-        self._pending: list = []  # (launcher, payload, future)
+        self._pending: list = []  # (launcher, payload, future, gen, meta)
         self._launchers: dict = {}  # id(verifier) -> VerifyLauncher
         self._draining = False
         self._closed = False
@@ -224,7 +238,8 @@ class DeviceWorkQueue:
             self._launchers[key] = got
         return got
 
-    def submit(self, launcher, payload, generation: int = 0) -> DeviceFuture:
+    def submit(self, launcher, payload, generation: int = 0,
+               origin=None, rows=None) -> DeviceFuture:
         """Enqueue one command; returns its future. Auto-drains when
         ``max_depth`` is reached (including the command just
         submitted), so a pipeline slot never grows unbounded.
@@ -234,11 +249,22 @@ class DeviceWorkQueue:
         (launcher, generation) pair, so a drain spanning an epoch
         boundary SPLITS into one launch per generation instead of
         mixing two key tables in one batch. Generation-less callers
-        (the default 0) coalesce exactly as before."""
+        (the default 0) coalesce exactly as before.
+
+        ``origin`` / ``rows`` feed the launch probe when one is
+        installed: the submitting track (replica index, tenant id, -1
+        for the sim) and the command's requested lane count. Both are
+        accounting-only — scheduling ignores them."""
         if self._closed:
             raise RuntimeError("queue is closed")
         fut = DeviceFuture(self)
-        self._pending.append((launcher, payload, fut, generation))
+        meta = None
+        if self.devtel is not NULL_DEVTEL:
+            if rows is None:
+                rows = len(payload) if hasattr(payload, "__len__") else 0
+            meta = self.devtel.command(origin, rows)
+            fut.seq = meta.seq
+        self._pending.append((launcher, payload, fut, generation, meta))
         self.submitted += 1
         if self.obs is not NULL_BOUND:
             self.obs.emit(
@@ -284,6 +310,20 @@ class DeviceWorkQueue:
                         groups[key] = []
                         order.append(key)
                     groups[key].append(cmd)
+                devtel = self.devtel
+                if devtel is not NULL_DEVTEL and len(order) > 1:
+                    # Generation splits: extra launches the SAME
+                    # launcher pays because its commands straddled an
+                    # epoch boundary (distinct-launcher groups are
+                    # ordinary fan-out, not splits).
+                    per_launcher: dict = {}
+                    for k in order:
+                        per_launcher[k[0]] = per_launcher.get(k[0], 0) + 1
+                    gen_splits = sum(
+                        v - 1 for v in per_launcher.values()
+                    )
+                    if gen_splits:
+                        devtel.splits(gen_splits)
                 for key in order:
                     cmds = groups[key]
                     launcher = cmds[0][0]
@@ -301,17 +341,46 @@ class DeviceWorkQueue:
                         # Generation-aware launchers swap their double-
                         # buffered table before the coalesced launch.
                         launcher.set_generation(key[1])
-                    results = launcher.launch([c[1] for c in cmds])
+                    rec = None
+                    if devtel is not NULL_DEVTEL:
+                        rec = devtel.launch_begin(
+                            getattr(launcher, "kind", "launch"),
+                            key[1],
+                            [c[4] for c in cmds],
+                        )
+                    payloads = [c[1] for c in cmds]
+                    if rec is not None:
+                        devtel.mark_pack(rec)
+                    try:
+                        results = launcher.launch(payloads)
+                    except BaseException:
+                        if rec is not None:
+                            devtel.launch_end(rec)
+                        raise
+                    if rec is not None:
+                        devtel.mark_dispatch(rec)
+                        devtel.launch_lanes(rec, launcher)
                     if len(results) != len(cmds):
+                        if rec is not None:
+                            devtel.launch_end(rec)
                         raise RuntimeError(
                             f"launcher {launcher!r} returned "
                             f"{len(results)} results for {len(cmds)} "
                             "commands"
                         )
-                    for (_, _, fut, _), res in zip(cmds, results):
-                        if not fut.cancelled():
-                            fut._resolve(res)
-                        resolved += 1
+                    try:
+                        for (_, _, fut, _, _), res in zip(cmds, results):
+                            if rec is not None:
+                                fut.launch_id = rec.launch_id
+                            if not fut.cancelled():
+                                fut._resolve(res)
+                            resolved += 1
+                    finally:
+                        # Closed in a finally so a callback raising
+                        # (SpeculationMismatch) still seals the record
+                        # and removes the fetch probe.
+                        if rec is not None:
+                            devtel.launch_end(rec)
         finally:
             self._draining = False
         if resolved:

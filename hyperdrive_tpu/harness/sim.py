@@ -542,6 +542,25 @@ class Simulation:
         #: Sim-level emit handle (replica = -1): settle/verify/tally launch
         #: events that belong to the harness, not any one replica.
         self._obs_sim = self.obs.scoped(-1)
+        # Metrics registry + device-telemetry probe (obs/metrics.py,
+        # obs/devtel.py), both on the virtual clock so the snapshot is
+        # digest-identical across fixed-seed runs. The registry always
+        # exists (it is where metrics_snapshot() folds the tracer in);
+        # the launch probe only when observing — NULL_DEVTEL keeps the
+        # unobserved queue at one pointer compare per submit/drain.
+        from hyperdrive_tpu.obs.devtel import NULL_DEVTEL, DeviceTelemetry
+        from hyperdrive_tpu.obs.metrics import Registry
+
+        self.registry = Registry(time_fn=lambda: self.clock.now)
+        self.devtel = (
+            DeviceTelemetry(
+                recorder=self.obs,
+                registry=self.registry,
+                time_fn=lambda: self.clock.now,
+            )
+            if observe
+            else NULL_DEVTEL
+        )
         # The delivery queue is consumed via a head index (O(1) per step;
         # list.pop(0) would make 256-replica x 10k-height runs quadratic).
         self.queue: list[tuple[int, object]] = []
@@ -563,6 +582,15 @@ class Simulation:
 
         self.burst = burst
         self.batch_verifier = batch_verifier
+        # An observed run adopts a device verifier's recorder handle so
+        # its kernel-side occupancy probes (verify.occupancy.*) land in
+        # the same journal as the queue's launch records; an explicitly
+        # pre-bound handle wins.
+        if observe and batch_verifier is not None:
+            from hyperdrive_tpu.obs.recorder import NULL_BOUND
+
+            if getattr(batch_verifier, "obs", None) is NULL_BOUND:
+                batch_verifier.obs = self._obs_sim
         #: certificates=True: every replica's Process carries a
         #: certificates.Certifier minting a constant-size
         #: QuorumCertificate at each commit (transcript-bound to the
@@ -734,6 +762,7 @@ class Simulation:
                 max_depth=self._pipeline_depth,
                 obs=self.obs.scoped(-2),
                 tracer=self.tracer,
+                devtel=self.devtel,
             )
         if self._sched is not None:
             self._sched.on_drain = self._on_sched_drain
@@ -747,6 +776,8 @@ class Simulation:
                 self._sched.obs = self.obs.scoped(-2)
             if self._sched.tracer is None:
                 self._sched.tracer = self.tracer
+            if self._sched.devtel is NULL_DEVTEL:
+                self._sched.devtel = self.devtel
         #: Per-replica flusher factory ``(i, signatories) -> flusher``
         #: for LOCK-STEP pipelining: queue-backed flushers (devsched
         #: QueueFlusher / DeviceTallyFlusher with ``queue=``) submit
@@ -763,10 +794,15 @@ class Simulation:
                 "harness path (use pipeline_heights there)"
             )
         #: Commit finalizations gated on in-flight speculation:
-        #: (replica, height, value) in commit order, flushed by
-        #: _on_sched_drain once the covering futures resolve.
+        #: (replica, height, value, covering future) in commit order,
+        #: flushed by _on_sched_drain once the covering futures
+        #: resolve. The future carries the launch probe's attribution
+        #: (launch_id) so the finalize event links commit -> launch.
         self._gated_commits: list = []
         self._spec_inflight = 0
+        #: The most recent speculative-settle future: what a commit
+        #: raised while speculation is in flight is gated on.
+        self._spec_last_fut = None
         #: Rows accumulated in the open pipeline slot — the row-aware
         #: drain trigger (_settle_speculative) closes the slot just
         #: before a submission would spill into a larger verify bucket,
@@ -1281,7 +1317,9 @@ class Simulation:
             # effects — the recorded commit, completion accounting —
             # wait. Rollback-free: a speculation mismatch raises out of
             # the drain before any gated commit is finalized.
-            self._gated_commits.append((i, height, value))
+            self._gated_commits.append(
+                (i, height, value, self._spec_last_fut)
+            )
             if self._obs_sim is not _OBS_NULL:
                 self._obs_sim.emit("sched.gated", height, -1, i)
             return (0, None)
@@ -1393,17 +1431,47 @@ class Simulation:
         commits are confirmed — finalize them in commit order."""
         self._spec_inflight = 0
         self._spec_rows = 0
+        self._spec_last_fut = None
         if not self._gated_commits:
             return
         gated = self._gated_commits
         self._gated_commits = []
-        for i, height, value in gated:
+        for i, height, value, fut in gated:
             self.commits[i][height] = value
+            if (
+                self._obs_sim is not _OBS_NULL
+                and fut is not None
+                and fut.launch_id is not None
+            ):
+                # Close the cross-layer loop on the replica's own
+                # track: this commit finalized because THAT coalesced
+                # launch confirmed its speculation (the Perfetto
+                # exporter draws the drain -> commit flow arrow from
+                # this event).
+                self.obs.emit(
+                    "sched.launch.commit", i, height, -1, fut.launch_id
+                )
             if height >= self.target_height:
                 self._pending_replicas.discard(i)
 
     def _completed(self) -> bool:
         return not self._pending_replicas
+
+    def metrics_snapshot(self) -> dict:
+        """The run's metrics-registry snapshot (obs/metrics.py), with
+        the tracer's counters/histograms folded in — the one uniform
+        view the obs CLI exports and bench artifacts embed. On the
+        virtual clock everything in it is deterministic, so two
+        fixed-seed runs snapshot to identical bytes
+        (``self.registry.digest()``)."""
+        self.registry.absorb_tracer(self.tracer)
+        snap = self.registry.snapshot()
+        if self._obs_sim is not _OBS_NULL:
+            self._obs_sim.emit(
+                "metrics.snapshot", -1, -1,
+                len(snap["counters"]) + len(snap["histograms"]),
+            )
+        return snap
 
     def run(self, max_steps: int = 2_000_000, start: bool = True) -> SimulationResult:
         """Drive the network to the target height. ``start=False`` resumes
@@ -2497,8 +2565,10 @@ class Simulation:
             self._spec_rows += len(items)
             self._spec_inflight += 1
             fut = sched.submit(
-                sched.verify_launcher(self.batch_verifier), items
+                sched.verify_launcher(self.batch_verifier), items,
+                origin=-1, rows=len(items),
             )
+            self._spec_last_fut = fut
             expected = expect
 
             def confirm(f, expected=expected, items=items):
@@ -2532,7 +2602,7 @@ class Simulation:
         # instead of speculating extra heights past the target.
         if self._gated_commits and any(
             h >= self.target_height and i in self._pending_replicas
-            for i, h, _ in self._gated_commits
+            for i, h, _, _ in self._gated_commits
         ):
             self._sched.drain()
 

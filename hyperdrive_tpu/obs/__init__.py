@@ -1,9 +1,11 @@
-"""Consensus observability: flight recorder, anatomy report, traces.
+"""Consensus observability: flight recorder, anatomy report, traces,
+device-launch telemetry, metrics registry, and the perf sentinel.
 
 See OBSERVABILITY.md for the event taxonomy and CLI usage. The hot-path
 contract is the same as utils/trace.py's NULL_TRACER: components hold a
 recorder handle that defaults to the shared no-op singleton, and guard
-any non-trivial event construction with an identity check.
+any non-trivial event construction with an identity check (device
+telemetry follows suit with NULL_DEVTEL).
 """
 
 from hyperdrive_tpu.obs.recorder import (
@@ -17,8 +19,28 @@ from hyperdrive_tpu.obs.recorder import (
     Recorder,
     load_journal,
 )
-from hyperdrive_tpu.obs.report import anatomy, phase_summary, render_table
-from hyperdrive_tpu.obs.perfetto import export, to_trace_events
+from hyperdrive_tpu.obs.report import (
+    anatomy,
+    phase_summary,
+    render_table,
+    render_tenant_table,
+    tenant_summary,
+)
+from hyperdrive_tpu.obs.perfetto import DEVICE_TID, export, to_trace_events
+from hyperdrive_tpu.obs.devtel import (
+    NULL_DEVTEL,
+    DeviceTelemetry,
+    LaunchRecord,
+    NullDeviceTelemetry,
+)
+from hyperdrive_tpu.obs.metrics import (
+    Gauge,
+    Registry,
+    histogram_stats,
+    merge_histograms,
+    to_prometheus,
+)
+from hyperdrive_tpu.obs.benchdiff import compare as benchdiff_compare
 
 __all__ = [
     "EVENT_KINDS",
@@ -33,6 +55,19 @@ __all__ = [
     "anatomy",
     "phase_summary",
     "render_table",
+    "render_tenant_table",
+    "tenant_summary",
+    "DEVICE_TID",
     "export",
     "to_trace_events",
+    "NULL_DEVTEL",
+    "DeviceTelemetry",
+    "LaunchRecord",
+    "NullDeviceTelemetry",
+    "Gauge",
+    "Registry",
+    "histogram_stats",
+    "merge_histograms",
+    "to_prometheus",
+    "benchdiff_compare",
 ]

@@ -1,8 +1,14 @@
-"""``python -m hyperdrive_tpu.obs`` — record, report, export.
+"""``python -m hyperdrive_tpu.obs`` — record, report, export, metrics,
+benchdiff.
 
-    record  run a short observed sim and save its event journal
-    report  render the round-anatomy table from a saved journal
-    export  convert a saved journal to Perfetto/Chrome trace JSON
+    record     run a short observed sim and save its event journal
+    report     render the round-anatomy table from a saved journal
+               (``--tenants`` for per-origin device-launch latency)
+    export     convert a saved journal to Perfetto/Chrome trace JSON
+    metrics    run a short observed sim, print its metrics-registry
+               snapshot (JSON; ``--prometheus FILE`` for exposition text)
+    benchdiff  diff two bench artifacts, exit nonzero on a gated
+               perf regression (the CI sentinel)
 
 ``record`` exists so CI (and anyone without a saved journal) can go
 from nothing to a viewable trace in two commands:
@@ -18,7 +24,13 @@ import json
 import sys
 
 from hyperdrive_tpu.obs.recorder import load_journal
-from hyperdrive_tpu.obs.report import anatomy, phase_summary, render_table
+from hyperdrive_tpu.obs.report import (
+    anatomy,
+    phase_summary,
+    render_table,
+    render_tenant_table,
+    tenant_summary,
+)
 from hyperdrive_tpu.obs.perfetto import export
 
 
@@ -52,6 +64,17 @@ def _cmd_record(ns):
 
 def _cmd_report(ns):
     journal = load_journal(ns.journal)
+    if ns.tenants:
+        rows = tenant_summary(journal["events"])
+        if ns.json:
+            print(json.dumps({"tenants": rows}, indent=1))
+            return 0
+        if not rows:
+            print("no sched.launch.* events in journal window "
+                  "(record with device telemetry on)")
+            return 1
+        print(render_tenant_table(rows))
+        return 0
     rows = anatomy(journal["events"])
     if ns.json:
         print(
@@ -93,6 +116,62 @@ def _cmd_export(ns):
     return 0
 
 
+def _cmd_metrics(ns):
+    # Imported here: the sim pulls in jax; the registry itself is stdlib.
+    from hyperdrive_tpu.harness import Simulation
+    from hyperdrive_tpu.obs.metrics import to_prometheus
+
+    extra = {}
+    if ns.pipeline:
+        # pipeline_heights requires burst mode and a batch verifier;
+        # sign=True supplies the jax-free HostVerifier default.
+        extra = dict(sign=True, burst=True, pipeline_heights=True)
+    sim = Simulation(
+        n=ns.replicas,
+        target_height=ns.heights,
+        seed=ns.seed,
+        timeout=ns.timeout,
+        delivery_cost=ns.delivery_cost,
+        observe=True,
+        **extra,
+    )
+    res = sim.run()
+    snap = sim.metrics_snapshot()
+    if ns.output:
+        with open(ns.output, "w") as fh:
+            json.dump(snap, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+    if ns.prometheus:
+        with open(ns.prometheus, "w") as fh:
+            fh.write(to_prometheus(snap))
+    print(
+        json.dumps(
+            {
+                "completed": res.completed,
+                "counters": len(snap["counters"]),
+                "gauges": len(snap["gauges"]),
+                "histograms": len(snap["histograms"]),
+                "digest": sim.registry.digest(),
+                "snapshot": ns.output,
+                "prometheus": ns.prometheus,
+            }
+        )
+    )
+    return 0 if res.completed else 1
+
+
+def _cmd_benchdiff(ns):
+    from hyperdrive_tpu.obs.benchdiff import main as benchdiff_main
+
+    return benchdiff_main(
+        ns.old,
+        ns.new,
+        threshold=ns.threshold,
+        gates=ns.gate or None,
+        as_json=ns.json,
+    )
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m hyperdrive_tpu.obs",
@@ -112,12 +191,44 @@ def main(argv=None):
     rep = sub.add_parser("report", help="round-anatomy table from journal")
     rep.add_argument("journal")
     rep.add_argument("--json", action="store_true")
+    rep.add_argument(
+        "--tenants",
+        action="store_true",
+        help="per-origin device-launch latency summary instead",
+    )
     rep.set_defaults(fn=_cmd_report)
 
     exp = sub.add_parser("export", help="journal -> Perfetto trace JSON")
     exp.add_argument("journal")
     exp.add_argument("-o", "--output", default="trace.json")
     exp.set_defaults(fn=_cmd_export)
+
+    met = sub.add_parser(
+        "metrics", help="run an observed sim, print registry snapshot"
+    )
+    met.add_argument("-o", "--output", default=None,
+                     help="also write the snapshot JSON here")
+    met.add_argument("--prometheus", default=None,
+                     help="write Prometheus exposition text here")
+    met.add_argument("--replicas", type=int, default=4)
+    met.add_argument("--heights", type=int, default=5)
+    met.add_argument("--seed", type=int, default=91)
+    met.add_argument("--timeout", type=float, default=20.0)
+    met.add_argument("--delivery-cost", type=float, default=0.001)
+    met.add_argument("--pipeline", action="store_true",
+                     help="pipelined heights (exercises device telemetry)")
+    met.set_defaults(fn=_cmd_metrics)
+
+    bd = sub.add_parser(
+        "benchdiff", help="perf sentinel: diff two bench JSON artifacts"
+    )
+    bd.add_argument("old")
+    bd.add_argument("new")
+    bd.add_argument("--threshold", type=float, default=0.08)
+    bd.add_argument("--gate", action="append", default=[],
+                    help="extra gated metric path (repeatable)")
+    bd.add_argument("--json", action="store_true")
+    bd.set_defaults(fn=_cmd_benchdiff)
 
     ns = p.parse_args(argv)
     return ns.fn(ns)

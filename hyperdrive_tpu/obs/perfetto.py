@@ -6,6 +6,15 @@ pid, ``B``/``E`` duration spans for rounds and consensus phases, and
 ``i`` instant events for timeout fires, commits, equivocations, and
 wire anomalies. Timestamps are the journal's (virtual) seconds scaled
 to microseconds, so a sim second reads as a second in the UI.
+
+Device telemetry (``sched.launch.*`` events, obs/devtel.py) renders as
+its own **device** track (tid -3): one complete slice per coalesced
+launch carrying the probe's args (rows, lanes, occupancy %, queue
+wait), plus flow arrows stitching the cross-layer story together —
+``cmdflow`` from each submitter's ``submit`` slice to the launch that
+carried the command, and ``commitflow`` from the launch back to every
+gated commit it finalized, so a commit's wall time decomposes across
+the host/device boundary in one trace.
 """
 
 from __future__ import annotations
@@ -15,6 +24,11 @@ import json
 __all__ = ["to_trace_events", "export"]
 
 PID = 0
+
+#: The device track's tid: one rung below the devsched queue track
+#: (-2), mirroring the journal's replica>=0 / sim -1 / devsched -2
+#: layering.
+DEVICE_TID = -3
 
 _INSTANTS = {
     "timeout.propose.fired": "timeout propose",
@@ -33,6 +47,7 @@ _INSTANTS = {
     "sched.coalesce": "coalesce",
     "sched.drain": "drain",
     "sched.gated": "commit gated",
+    "sched.launch.split": "gen split",
     "epoch.begin": "epoch begin",
     "epoch.elect": "epoch elect",
     "epoch.switch": "epoch switch",
@@ -90,6 +105,29 @@ def to_trace_events(events):
     # it), so the counter is as deterministic as the journal itself.
     sched_depth = 0
 
+    # Device-track state (sched.launch.* events): the launch being
+    # assembled (begin..end bracket), completed launches' time spans
+    # for the commit flows, and a running id for commitflow arrows
+    # (each Chrome flow id is one polyline, so N commits off one
+    # launch need N distinct ids).
+    launch_open = None
+    launch_spans = {}  # launch_id -> (begin_ts, end_ts)
+    commit_flows = 0
+
+    def flow(ph, ts, tid, fid, cat, name):
+        ev = {
+            "ph": ph,
+            "ts": _us(ts),
+            "pid": PID,
+            "tid": tid,
+            "id": fid,
+            "cat": cat,
+            "name": name,
+        }
+        if ph == "f":
+            ev["bp"] = "e"
+        out.append(ev)
+
     for ev in events:
         ts, replica, height, round_, kind, detail = (
             ev[0], ev[1], ev[2], ev[3], ev[4], ev[5],
@@ -108,6 +146,87 @@ def to_trace_events(events):
                     "args": {"depth": sched_depth},
                 }
             )
+        if kind.startswith("sched.launch."):
+            if kind == "sched.launch.submit":
+                # A zero-ish slice anchors the flow start on the
+                # submitter's track (flows bind to slices, and the sim
+                # track has no spans of its own).
+                out.append(
+                    {
+                        "ph": "X",
+                        "ts": _us(ts),
+                        "dur": 1.0,
+                        "pid": PID,
+                        "tid": tid,
+                        "name": "submit",
+                        "cat": "devtel",
+                        "args": {"seq": detail},
+                    }
+                )
+                flow("s", ts, tid, int(detail), "cmdflow", "cmd")
+            elif kind == "sched.launch.begin":
+                launch_open = {
+                    "id": detail,
+                    "ts": ts,
+                    "cmds": [],
+                    "args": {"launch_id": detail},
+                }
+            elif kind == "sched.launch.cmd":
+                if launch_open is not None:
+                    launch_open["cmds"].append(detail)
+            elif kind in (
+                "sched.launch.rows",
+                "sched.launch.lanes",
+                "sched.launch.occupancy",
+                "sched.launch.queue_wait",
+            ):
+                if launch_open is not None:
+                    leaf = kind.rsplit(".", 1)[1]
+                    launch_open["args"][leaf] = detail
+            elif kind == "sched.launch.end":
+                if launch_open is not None:
+                    tids.add(DEVICE_TID)
+                    t0 = launch_open["ts"]
+                    args = launch_open["args"]
+                    args["commands"] = len(launch_open["cmds"])
+                    out.append(
+                        {
+                            "ph": "X",
+                            "ts": _us(t0),
+                            "dur": max(_us(ts) - _us(t0), 1.0),
+                            "pid": PID,
+                            "tid": DEVICE_TID,
+                            "name": f"launch {launch_open['id']}",
+                            "cat": "launch",
+                            "args": args,
+                        }
+                    )
+                    for seq in launch_open["cmds"]:
+                        flow("f", t0, DEVICE_TID, int(seq),
+                             "cmdflow", "cmd")
+                    launch_spans[launch_open["id"]] = (t0, ts)
+                    launch_open = None
+            elif kind == "sched.launch.commit":
+                out.append(
+                    {
+                        "ph": "X",
+                        "ts": _us(ts),
+                        "dur": 1.0,
+                        "pid": PID,
+                        "tid": tid,
+                        "name": "commit finalize",
+                        "cat": "devtel",
+                        "args": {"height": height, "launch_id": detail},
+                    }
+                )
+                span = launch_spans.get(detail)
+                if span is not None:
+                    commit_flows += 1
+                    flow("s", span[0], DEVICE_TID, commit_flows,
+                         "commitflow", "commit")
+                    flow("f", ts, tid, commit_flows,
+                         "commitflow", "commit")
+
         if kind == "round.start":
             close_round(tid, ts)
             begin(
@@ -158,7 +277,9 @@ def to_trace_events(events):
     for tid in sorted(tids):
         # tid -2 is the devsched work-queue track (sim.py scopes the
         # queue's recorder handle there); -1 is the sim's own track.
-        if tid == -2:
+        if tid == DEVICE_TID:
+            name = "device"
+        elif tid == -2:
             name = "devsched"
         elif tid < 0:
             name = "sim"

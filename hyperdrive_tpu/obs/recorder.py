@@ -73,6 +73,28 @@ EVENT_KINDS = frozenset(
         "sched.coalesce",
         "sched.drain",
         "sched.gated",
+        # Device-pipeline telemetry (obs/devtel.py): one launch-probe
+        # family per coalesced DeviceWorkQueue drain. submit/cmd carry
+        # the per-command sequence number the Perfetto exporter keys
+        # its submit->drain flow arrows on; commit closes the loop on
+        # the gated replica's track with the covering launch_id.
+        "sched.launch.submit",
+        "sched.launch.begin",
+        "sched.launch.cmd",
+        "sched.launch.rows",
+        "sched.launch.lanes",
+        "sched.launch.occupancy",
+        "sched.launch.queue_wait",
+        "sched.launch.split",
+        "sched.launch.end",
+        "sched.launch.commit",
+        # Lanes-requested vs bucket-padded economics per verify chunk
+        # (ops/ed25519_jax.py).
+        "verify.occupancy.rows",
+        "verify.occupancy.lanes",
+        "verify.occupancy.pct",
+        # Metrics registry (obs/metrics.py) lifecycle marks.
+        "metrics.snapshot",
         "flush.launch",
         "flush.settle",
         "fetch.sync",
@@ -131,7 +153,10 @@ class Recorder:
         passes False; TcpNode wiring needs True.
     """
 
-    __slots__ = ("capacity", "_ring", "total", "_time_fn", "_lock", "_seq")
+    __slots__ = (
+        "capacity", "_ring", "total", "_time_fn", "_lock", "_seq",
+        "_dropped",
+    )
 
     def __init__(self, capacity=65536, time_fn=None, threadsafe=False):
         if capacity <= 0:
@@ -142,25 +167,31 @@ class Recorder:
         self._time_fn = time_fn
         self._lock = threading.Lock() if threadsafe else None
         self._seq = 0
+        self._dropped = 0
 
     # ------------------------------------------------------------ insert
 
     def emit(self, kind, replica, height, round_, detail=None):
-        ts = self._time_fn() if self._time_fn is not None else self._tick()
-        ev = Event((ts, replica, height, round_, kind, detail))
+        # The whole emit — timestamp draw (the fallback _tick mutates
+        # _seq), ring write, and overwrite accounting — runs under the
+        # lock in threadsafe mode: a torn total/_dropped pair would let
+        # `dropped` disagree with what snapshot() actually returns.
         lock = self._lock
         if lock is None:
-            self._insert(ev)
+            self._insert(kind, replica, height, round_, detail)
         else:
             with lock:
-                self._insert(ev)
+                self._insert(kind, replica, height, round_, detail)
 
-    def _insert(self, ev):
+    def _insert(self, kind, replica, height, round_, detail):
+        ts = self._time_fn() if self._time_fn is not None else self._tick()
+        ev = Event((ts, replica, height, round_, kind, detail))
         ring = self._ring
         if len(ring) < self.capacity:
             ring.append(ev)
         else:
             ring[self.total % self.capacity] = ev
+            self._dropped += 1
         self.total += 1
 
     def _tick(self):
@@ -178,10 +209,25 @@ class Recorder:
 
     @property
     def dropped(self):
-        return max(0, self.total - self.capacity)
+        """Events the ring overwrote — an explicit counter maintained
+        under the same lock as the ring write, so a concurrent reader
+        never sees it disagree with the snapshot (the old derived
+        ``total - capacity`` could tear against a mid-flight insert)."""
+        lock = self._lock
+        if lock is None:
+            return self._dropped
+        with lock:
+            return self._dropped
 
     def snapshot(self):
         """Events oldest-to-newest, as a new list of :class:`Event`."""
+        lock = self._lock
+        if lock is None:
+            return self._snapshot()
+        with lock:
+            return self._snapshot()
+
+    def _snapshot(self):
         ring = self._ring
         if self.total <= self.capacity:
             return list(ring)

@@ -16,7 +16,13 @@ timeout-driven progress, and totals far above the run median.
 
 from __future__ import annotations
 
-__all__ = ["anatomy", "phase_summary", "render_table"]
+__all__ = [
+    "anatomy",
+    "phase_summary",
+    "render_table",
+    "tenant_summary",
+    "render_tenant_table",
+]
 
 _TIMEOUT_FIRES = (
     "timeout.propose.fired",
@@ -154,6 +160,111 @@ def phase_summary(events):
         "timeout_driven": sum(1 for r in rows if r["timeouts"] > 0),
         "extra_round_commits": sum(1 for r in rows if r["rounds"] > 1),
     }
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def tenant_summary(events):
+    """Per-origin (tenant/replica) device-launch latency rows.
+
+    Reconstructed purely from ``sched.launch.*`` journal events
+    (obs/devtel.py), so it works on saved journals with no live
+    registry: a command's *verify* latency is submit ts -> the end ts
+    of the launch that carried it, and its *commit* latency extends to
+    the ``sched.launch.commit`` event naming that launch. Rows are one
+    per origin track (tenant id under ShardVerifyService, replica /
+    -1 sim under the scheduler), with p50/p95 over the run.
+    """
+    submits = {}  # seq -> (origin, ts)
+    seq_launch = {}  # seq -> launch_id
+    launch_end = {}  # launch_id -> end ts
+    commit_ts = {}  # launch_id -> [commit ts, ...]
+    open_id = None
+    for ev in events:
+        ts, origin, kind, detail = ev[0], ev[1], ev[4], ev[5]
+        if kind == "sched.launch.submit":
+            submits[detail] = (origin, ts)
+        elif kind == "sched.launch.begin":
+            open_id = detail
+        elif kind == "sched.launch.cmd":
+            if open_id is not None:
+                seq_launch[detail] = open_id
+        elif kind == "sched.launch.end":
+            if open_id is not None:
+                launch_end[open_id] = ts
+                open_id = None
+        elif kind == "sched.launch.commit":
+            commit_ts.setdefault(detail, []).append(ts)
+
+    per = {}  # origin -> state
+
+    def row(origin):
+        r = per.get(origin)
+        if r is None:
+            r = {"submits": 0, "launches": set(), "verify": [], "commit": []}
+            per[origin] = r
+        return r
+
+    for seq, (origin, t0) in submits.items():
+        r = row(origin)
+        r["submits"] += 1
+        lid = seq_launch.get(seq)
+        if lid is None:
+            continue
+        r["launches"].add(lid)
+        t_end = launch_end.get(lid)
+        if t_end is not None:
+            r["verify"].append(max(0.0, t_end - t0))
+        for tc in commit_ts.get(lid, ()):
+            r["commit"].append(max(0.0, tc - t0))
+
+    rows = []
+    for origin in sorted(per):
+        r = per[origin]
+        v = sorted(r["verify"])
+        c = sorted(r["commit"])
+        rows.append(
+            {
+                "tenant": origin,
+                "submits": r["submits"],
+                "launches": len(r["launches"]),
+                "verify_p50_s": _quantile(v, 0.50),
+                "verify_p95_s": _quantile(v, 0.95),
+                "commit_p50_s": _quantile(c, 0.50),
+                "commit_p95_s": _quantile(c, 0.95),
+                "commits": len(c),
+            }
+        )
+    return rows
+
+
+def render_tenant_table(rows):
+    """The tenant-summary rows as an aligned text table."""
+    cols = [
+        ("tenant", "tenant"),
+        ("subs", "submits"),
+        ("launches", "launches"),
+        ("vrfy p50", "verify_p50_s"),
+        ("vrfy p95", "verify_p95_s"),
+        ("cmt p50", "commit_p50_s"),
+        ("cmt p95", "commit_p95_s"),
+        ("commits", "commits"),
+    ]
+    table = [[h for h, _ in cols]]
+    for r in rows:
+        table.append([_fmt(r[k]) for _, k in cols])
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
 
 
 def _fmt(v):

@@ -812,6 +812,18 @@ class TpuBatchVerifier:
                     pending.append(self._verify_chunk_deduped(chunk, scan))
                     continue
             arrays, prevalid, n = self.host.pack(chunk, _scan=scan)
+            if self.obs is not _OBS_NULL_BOUND:
+                # Bucket-padding economics per launch: lanes requested
+                # vs the static shape actually compiled — what the
+                # padding bill costs this chunk (devtel aggregates the
+                # same ratio across queue drains).
+                lanes = int(arrays[0].shape[0])
+                self.obs.emit("verify.occupancy.rows", -1, -1, n)
+                self.obs.emit("verify.occupancy.lanes", -1, -1, lanes)
+                self.obs.emit(
+                    "verify.occupancy.pct", -1, -1,
+                    int(round(100 * n / max(lanes, 1))),
+                )
             if not prevalid.any():
                 pending.append((None, None, prevalid, n))
                 continue

@@ -85,12 +85,18 @@ def msm_plan(n: int, windows: int) -> dict:
     reduction depth (combine-tree levels + bucket suffix chain)."""
     G, g = plan_groups(n)
     depth = (G - 1).bit_length() + (N_BUCKETS - 1)
+    padded = G * g
     return {
         "windows": windows,
         "groups": G,
         "group_size": g,
         "buckets": N_BUCKETS,
         "reduction_depth": depth,
+        # Group-layout padding economics (the devtel occupancy probes'
+        # kernel-side counterpart): lanes the [G, g] fold actually
+        # walks vs the n requested.
+        "padded_lanes": padded,
+        "lane_occupancy_pct": int(round(100 * n / max(padded, 1))),
     }
 
 

@@ -62,7 +62,7 @@ class ShardVerifyService:
     """
 
     def __init__(self, verifier, queue=None, max_depth: int = 8,
-                 obs=None, tracer=None):
+                 obs=None, tracer=None, devtel=None):
         from hyperdrive_tpu.devsched import DeviceWorkQueue
 
         self.verifier = verifier
@@ -70,11 +70,19 @@ class ShardVerifyService:
             queue
             if queue is not None
             else DeviceWorkQueue(max_depth=max_depth, obs=obs,
-                                 tracer=tracer)
+                                 tracer=tracer, devtel=devtel)
         )
+        if devtel is not None:
+            # An externally-built queue adopts the service's probe (the
+            # same late-binding the sim applies to its queue).
+            self.queue.devtel = devtel
         self._launcher = self.queue.verify_launcher(verifier)
         #: Commands submitted per tenant key (observability).
         self.tenants: dict = {}
+        #: Tenant key -> small stable int track id (first-submit order):
+        #: what the launch probe records as each command's origin, so
+        #: journal events and registry labels agree on the tenant axis.
+        self.tenant_ids: dict = {}
         #: tenant -> {height -> QuorumCertificate}: O(1) commit proofs
         #: accepted through :meth:`accept_certificate`. A proof that
         #: fails the certifier's check never lands here.
@@ -100,7 +108,19 @@ class ShardVerifyService:
         under ``tenant`` on success. This replaces shipping the 2f+1
         precommits a remote shard would otherwise need to trust the
         commit."""
-        if not certifier.verify(cert):
+        from hyperdrive_tpu.obs.devtel import NULL_DEVTEL
+
+        devtel = self.queue.devtel
+        t0 = devtel.now() if devtel is not NULL_DEVTEL else 0.0
+        ok = certifier.verify(cert)
+        if devtel is not NULL_DEVTEL:
+            # Per-tenant commit latency: the O(1) proof re-check that
+            # finalizes a remote shard's commit locally.
+            tid = self.tenant_ids.get(tenant)
+            if tid is None:
+                tid = self.tenant_ids[tenant] = len(self.tenant_ids)
+            devtel.tenant_latency(tid, devtel.now() - t0, "commit")
+        if not ok:
             return False
         self.certificates.setdefault(tenant, {})[cert.height] = cert
         return True
@@ -115,7 +135,27 @@ class ShardVerifyService:
         their windows coalesce per generation, never into a mixed-key
         launch."""
         self.tenants[tenant] = self.tenants.get(tenant, 0) + 1
-        return self.queue.submit(self._launcher, items, generation)
+        tid = self.tenant_ids.get(tenant)
+        if tid is None:
+            tid = self.tenant_ids[tenant] = len(self.tenant_ids)
+        fut = self.queue.submit(
+            self._launcher, items, generation,
+            origin=tid, rows=len(items),
+        )
+        from hyperdrive_tpu.obs.devtel import NULL_DEVTEL
+
+        devtel = self.queue.devtel
+        if devtel is not NULL_DEVTEL:
+            # Per-tenant verify latency: submit -> resolution, on the
+            # probe's (injectable) clock, into a labeled mergeable
+            # histogram (tenant.verify.latency{label=<tid>}).
+            t0 = devtel.now()
+
+            def _observe(f, devtel=devtel, t0=t0, tid=tid):
+                devtel.tenant_latency(tid, devtel.now() - t0, "verify")
+
+            fut.add_done_callback(_observe)
+        return fut
 
     def rotate(self, generation: int, table=None) -> None:
         """Propagate an epoch rotation to the shared verifier: installs

@@ -214,6 +214,11 @@ class DeviceTallyFlusher:
                 launcher,
                 [(m.sender, m.digest(), m.signature) for m in window],
                 self.generation,
+                origin=(
+                    self.obs.replica
+                    if self.obs is not NULL_BOUND else None
+                ),
+                rows=len(window),
             )
             self._inflight.append(fut)
 

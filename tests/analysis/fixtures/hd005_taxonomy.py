@@ -1,0 +1,39 @@
+"""HD005 fixture: closed-family emit literals must be in EVENT_KINDS.
+
+Well-formed lowercase dotted names that sit under the closed event
+families (sched.launch.*, verify.occupancy.*, metrics.*) but are not
+members of the recorder taxonomy are silent forks — the grep-based
+journal test only audits files it covers, the lint covers the rest.
+"""
+
+
+class Pipeline:
+    def __init__(self, obs, recorder):
+        self.obs = obs
+        self.recorder = recorder
+
+    def bad_unknown_launch_kind(self, lid):
+        self.obs.emit("sched.launch.finish", -2, -1, -1, lid)  # BAD: fork
+
+    def bad_unknown_occupancy(self, pct):
+        self.obs.emit("verify.occupancy.ratio", -1, -1, -1, pct)  # BAD: fork
+
+    def bad_unknown_metrics(self):
+        self.recorder.emit("metrics.flush", -1, -1, -1, 0)  # BAD: fork
+
+    def good_taxonomy_members(self, lid, pct):
+        self.obs.emit("sched.launch.begin", -2, -1, -1, lid)
+        self.obs.emit("verify.occupancy.pct", -1, -1, -1, pct)
+        self.obs.emit("metrics.snapshot", -1, -1, -1, 0)
+
+    def good_open_family(self):
+        # Families outside the closed prefixes stay grep-audited only:
+        # a conforming literal is enough.
+        self.obs.emit("commit", 5, 0)
+
+    def good_non_emit_methods(self, v):
+        # count/observe feed the tracer registry, not the journal; the
+        # closed-taxonomy check is emit-only.
+        self.tracer = None
+        self.obs.count("sched.launch.custom.counter", 1)
+        self.obs.observe("metrics.custom.latency", v)

@@ -87,6 +87,32 @@ def test_hd005_fixture_flags_dynamic_names_not_table_lookups():
     assert "not lowercase dotted" in msgs
 
 
+def test_hd005_taxonomy_fixture_flags_closed_family_forks():
+    path = os.path.join(FIXTURES, "hd005_taxonomy.py")
+    findings = run_on(path)
+    assert {f.rule for f in findings} == {"HD005"}
+    # One unknown name per closed family (sched.launch.*,
+    # verify.occupancy.*, metrics.*) — and none of the GOOD members,
+    # open-family literals, or non-emit methods.
+    assert len(findings) == 3
+    src = open(path).read()
+    bad_lines = {
+        i + 1 for i, text in enumerate(src.splitlines()) if "# BAD" in text
+    }
+    assert set(lines_of(findings, "HD005")) == bad_lines
+    assert all("EVENT_KINDS" in f.message for f in findings)
+
+
+def test_hd005_taxonomy_tracks_recorder_event_kinds():
+    # The closed families validated by the lint must actually exist in
+    # the taxonomy, so the rule and the recorder cannot drift apart.
+    from hyperdrive_tpu.analysis.rules import MetricNameRule
+    from hyperdrive_tpu.obs.recorder import EVENT_KINDS
+
+    for prefix in MetricNameRule._CLOSED_PREFIXES:
+        assert any(k.startswith(prefix) for k in EVENT_KINDS), prefix
+
+
 def test_hd006_fixture_flags_blocking_fetches_not_drain_points():
     path = os.path.join(FIXTURES, "hd006_async_fetch.py")
     findings = run_on(path)

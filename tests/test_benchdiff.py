@@ -1,0 +1,173 @@
+"""Perf-regression sentinel (obs/benchdiff.py): the CI gate's contract.
+
+Self-vs-self must pass, an injected slowdown on a gated series must
+fail with nonzero exit, runner-speed scale factors on ungated absolute
+metrics must NOT fail, and noisy series get widened bounds from their
+own scatter.
+"""
+
+import copy
+import json
+
+import pytest
+
+from hyperdrive_tpu.obs.benchdiff import (
+    _direction,
+    compare,
+    main as benchdiff_main,
+    render,
+)
+
+ARTIFACT = {
+    "benchdiff_gate": ["consensus.block_wall_s", "verify.speedup"],
+    "consensus": {
+        # Per-block wall series: medians compare, one outlier is free.
+        "block_wall_s": [0.010, 0.011, 0.010, 0.012, 0.010, 0.011],
+        "heights_per_s": 95.0,
+    },
+    "verify": {"speedup": [3.0, 3.1, 2.9, 3.0], "rows": 4096},
+    "meta": {"seed": 7},
+}
+
+
+def test_self_vs_self_passes():
+    v = compare(ARTIFACT, copy.deepcopy(ARTIFACT))
+    assert not v["failed"]
+    assert v["regressions"] == []
+    assert v["gates"] == ARTIFACT["benchdiff_gate"]
+
+
+def test_injected_slowdown_on_gated_series_fails():
+    slow = copy.deepcopy(ARTIFACT)
+    slow["consensus"]["block_wall_s"] = [
+        v * 1.6 for v in slow["consensus"]["block_wall_s"]
+    ]
+    v = compare(ARTIFACT, slow)
+    assert v["failed"]
+    [reg] = v["gated_regressions"]
+    assert reg["path"] == "consensus.block_wall_s"
+    assert reg["series"] and reg["delta"] == pytest.approx(0.6, abs=0.05)
+    assert "REGRESSION [GATED]" in render(v)
+    assert "FAIL" in render(v)
+
+
+def test_gated_ratio_drop_fails_in_the_higher_is_better_direction():
+    worse = copy.deepcopy(ARTIFACT)
+    worse["verify"]["speedup"] = [1.5, 1.6, 1.4, 1.5]
+    v = compare(ARTIFACT, worse)
+    assert v["failed"]
+    assert any(
+        e["path"] == "verify.speedup" for e in v["gated_regressions"]
+    )
+    # A speedup INCREASE is an improvement, never a regression.
+    better = copy.deepcopy(ARTIFACT)
+    better["verify"]["speedup"] = [6.0, 6.1, 5.9, 6.0]
+    v2 = compare(ARTIFACT, better)
+    assert not v2["failed"]
+    assert any(e["path"] == "verify.speedup" for e in v2["improvements"])
+
+
+def test_ungated_regression_reports_but_does_not_fail():
+    slower = copy.deepcopy(ARTIFACT)
+    slower["consensus"]["heights_per_s"] = 40.0
+    v = compare(ARTIFACT, slower)
+    assert not v["failed"]  # informational: not a nominated gate
+    assert any(
+        e["path"] == "consensus.heights_per_s" for e in v["regressions"]
+    )
+
+
+def test_noise_bound_widens_with_series_scatter():
+    noisy = {
+        "benchdiff_gate": ["wall_s"],
+        # Median 1.0, MAD 0.3 -> bound 4 * 0.3 = 120%: a 50% median
+        # shift is within this series' own run-to-run scatter.
+        "wall_s": [0.7, 1.0, 1.3, 0.6, 1.0, 1.4, 1.0],
+    }
+    shifted = {"benchdiff_gate": ["wall_s"], "wall_s": [1.5] * 7}
+    v = compare(noisy, shifted)
+    assert not v["failed"]
+    # A tight series holds the default threshold instead.
+    tight = {"benchdiff_gate": ["wall_s"], "wall_s": [1.0] * 7}
+    v2 = compare(tight, {"benchdiff_gate": ["wall_s"], "wall_s": [1.5] * 7})
+    assert v2["failed"]
+
+
+def test_direction_inference():
+    assert _direction("consensus.heights_per_s") == 1
+    assert _direction("verify.speedup") == 1
+    assert _direction("consensus.block_wall_s") == -1
+    assert _direction("tenant.latency") == -1
+    assert _direction("meta.seed") == 0
+
+
+def test_unknown_direction_skipped_unless_gated():
+    old = {"mystery": 10.0}
+    new = {"mystery": 100.0}
+    v = compare(old, new)
+    assert any(s["path"] == "mystery" for s in v["skipped"])
+    v2 = compare(old, new, gates=["mystery"])  # gated: lower-is-better
+    assert v2["failed"]
+
+
+def test_gate_prefix_covers_subtree():
+    old = {"consensus": {"commit_wall_s": 1.0, "drop_rate": 0.1}}
+    new = {"consensus": {"commit_wall_s": 2.0, "drop_rate": 0.1}}
+    v = compare(old, new, gates=["consensus"])
+    assert v["failed"]
+    assert v["gated_regressions"][0]["path"] == "consensus.commit_wall_s"
+
+
+def test_shape_mismatch_and_short_series_skip():
+    v = compare(
+        {"a_wall_s": [1.0, 1.0, 1.0], "b_wall_s": [1.0, 2.0]},
+        {"a_wall_s": 1.0, "b_wall_s": [1.0, 2.0]},
+    )
+    reasons = {s["path"]: s["reason"] for s in v["skipped"]}
+    assert reasons["a_wall_s"] == "shape-mismatch"
+    assert reasons["b_wall_s"] == "short-series"
+
+
+def test_zero_baseline_skips_rather_than_divides():
+    v = compare({"lat_s": 0.0}, {"lat_s": 0.5}, gates=["lat_s"])
+    assert not v["failed"]
+    assert any(s["reason"] == "zero-baseline" for s in v["skipped"])
+    v2 = compare({"lat_s": 0.0}, {"lat_s": 0.0}, gates=["lat_s"])
+    assert not v2["failed"]
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(ARTIFACT))
+    new.write_text(json.dumps(ARTIFACT))
+    assert benchdiff_main(str(old), str(new)) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out
+
+    slow = copy.deepcopy(ARTIFACT)
+    slow["consensus"]["block_wall_s"] = [
+        v * 2 for v in slow["consensus"]["block_wall_s"]
+    ]
+    new.write_text(json.dumps(slow))
+    assert benchdiff_main(str(old), str(new)) == 1
+    assert "FAIL" in capsys.readouterr().out
+
+    assert benchdiff_main(str(old), str(new), as_json=True) == 1
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["failed"] is True
+
+
+def test_obs_cli_benchdiff_subcommand(tmp_path, capsys):
+    from hyperdrive_tpu.obs.__main__ import main as obs_main
+
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(ARTIFACT))
+    new.write_text(json.dumps(ARTIFACT))
+    assert obs_main(["benchdiff", str(old), str(new)]) == 0
+    capsys.readouterr()
+    slow = copy.deepcopy(ARTIFACT)
+    slow["verify"]["speedup"] = [1.0, 1.0, 1.0, 1.0]
+    new.write_text(json.dumps(slow))
+    assert obs_main(["benchdiff", str(old), str(new)]) == 1

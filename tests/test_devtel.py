@@ -1,0 +1,408 @@
+"""Device-pipeline telemetry: launch probes, registry, tenant latency.
+
+Jax-free unit coverage for obs/devtel.py + obs/metrics.py and their
+wiring through the device work queue and the multi-tenant verify
+service — all on an injected fake clock, so every asserted number is
+exact, never "close enough".
+"""
+
+import threading
+
+import pytest
+
+from hyperdrive_tpu.analysis.annotations import device_fetch, set_fetch_probe
+from hyperdrive_tpu.devsched import DeviceWorkQueue
+from hyperdrive_tpu.obs.devtel import (
+    NULL_DEVTEL,
+    DeviceTelemetry,
+    NullDeviceTelemetry,
+)
+from hyperdrive_tpu.obs.metrics import (
+    Registry,
+    histogram_stats,
+    merge_histograms,
+    to_prometheus,
+)
+from hyperdrive_tpu.obs.recorder import EVENT_KINDS, Recorder
+from hyperdrive_tpu.obs.report import tenant_summary
+from hyperdrive_tpu.utils.trace import Histogram, Tracer
+from hyperdrive_tpu.verifier import NullVerifier
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+class EchoLauncher:
+    kind = "echo"
+
+    def __init__(self):
+        self.launches = []
+
+    def launch(self, payloads):
+        self.launches.append([len(p) for p in payloads])
+        return [list(p) for p in payloads]
+
+
+def probed_queue(clock=None):
+    clock = clock or FakeClock()
+    rec = Recorder(capacity=256, time_fn=clock)
+    devtel = DeviceTelemetry(
+        recorder=rec, registry=Registry(time_fn=clock), time_fn=clock
+    )
+    return DeviceWorkQueue(devtel=devtel), devtel, rec, clock
+
+
+# --------------------------------------------------------- queue probe
+
+
+def test_drain_produces_attributed_launch_record():
+    q, devtel, rec, clock = probed_queue()
+    launcher = EchoLauncher()
+    f1 = q.submit(launcher, [1, 2, 3], origin=0, rows=3)
+    clock.now = 1.0
+    f2 = q.submit(launcher, [4], origin=5, rows=1)
+    clock.now = 2.5
+    q.drain()
+
+    assert (f1.seq, f2.seq) == (0, 1)
+    assert f1.launch_id == f2.launch_id == 0
+    [lr] = devtel.records
+    assert lr.kind == "echo"
+    assert lr.commands == 2 and lr.rows == 4
+    assert lr.lanes == 4 and lr.occupancy_pct == 100  # no bucket ladder
+    assert lr.queue_wait_max == pytest.approx(2.5)  # f1 waited 0.0 -> 2.5
+    assert lr.queue_wait_sum == pytest.approx(2.5 + 1.5)
+    assert lr.origins == (0, 5)
+    d = lr.as_dict()
+    assert d["launch_id"] == 0 and d["rows"] == 4
+
+    kinds = [e.kind for e in rec.snapshot()]
+    assert kinds == [
+        "sched.launch.submit", "sched.launch.submit",
+        "sched.launch.begin", "sched.launch.cmd", "sched.launch.cmd",
+        "sched.launch.rows", "sched.launch.lanes",
+        "sched.launch.occupancy", "sched.launch.queue_wait",
+        "sched.launch.end",
+    ]
+    # Submit events ride the submitter's track; the launch rides -2.
+    submits = [e for e in rec.snapshot() if e.kind == "sched.launch.submit"]
+    assert [e.replica for e in submits] == [0, 5]
+    # queue_wait journal detail is integer microseconds.
+    [qw] = [e for e in rec.snapshot() if e.kind == "sched.launch.queue_wait"]
+    assert qw.detail == 2_500_000
+
+    snap = devtel.registry.snapshot()
+    assert snap["counters"]["devtel.submitted"] == 2
+    assert snap["counters"]["devtel.launches"] == 1
+    assert snap["counters"]["devtel.launch.rows"] == 4
+    assert snap["gauges"]["devtel.launch.last_id"] == 0
+    assert snap["histograms"]["devtel.launch.coalesce"]["count"] == 1
+    assert snap["histograms"]["devtel.launch.queue_wait.latency"][
+        "p50"
+    ] == pytest.approx(2.5)
+
+
+def test_generation_split_emits_and_counts():
+    q, devtel, rec, _ = probed_queue()
+    launcher = EchoLauncher()
+    q.submit(launcher, [1], generation=0, origin=0, rows=1)
+    q.submit(launcher, [2], generation=1, origin=0, rows=1)
+    q.drain()
+    assert len(devtel.records) == 2
+    assert [lr.generation for lr in devtel.records] == [0, 1]
+    splits = [e for e in rec.snapshot() if e.kind == "sched.launch.split"]
+    assert [e.detail for e in splits] == [1]
+    snap = devtel.registry.snapshot()
+    assert snap["counters"]["devtel.launch.gen_splits"] == 1
+
+
+def test_lanes_resolve_from_bucket_ladder():
+    q, devtel, _, _ = probed_queue()
+
+    class LadderedVerifier:
+        buckets = (4, 8, 16)
+
+        def verify_signatures(self, items):
+            return [True] * len(items)
+
+    launcher = q.verify_launcher(LadderedVerifier())
+    q.submit(launcher, [(b"\x00" * 32, b"\x01" * 32, None)] * 5,
+             origin=0, rows=5)
+    q.drain()
+    [lr] = devtel.records
+    assert lr.rows == 5 and lr.lanes == 8  # padded to the 8-lane bucket
+    assert lr.occupancy_pct == 62
+
+
+def test_fetch_probe_attributes_sync_time_inside_launch():
+    clock = FakeClock()
+    devtel = DeviceTelemetry(registry=Registry(time_fn=clock),
+                             time_fn=clock)
+
+    class FetchingLauncher:
+        kind = "fetching"
+
+        def launch(self, payloads):
+            clock.now += 0.25  # dispatch work
+            device_fetch([1, 2, 3], why="test sync")
+            clock.now += 0.5  # more dispatch after the sync
+            return [list(p) for p in payloads]
+
+    # The annotations-module fetch probe only times the bracket when a
+    # launch is open, so wrap through the queue.
+    q = DeviceWorkQueue(devtel=devtel)
+    q.submit(FetchingLauncher(), [7], origin=0, rows=1)
+
+    # Make the fetch itself cost 0.125 virtual seconds.
+    orig_begin = devtel.fetch_begin
+
+    def slow_begin(why):
+        orig_begin(why)
+        clock.now += 0.125
+
+    devtel.fetch_begin = slow_begin
+    q.drain()
+    [lr] = devtel.records
+    assert lr.syncs == 1
+    assert lr.t_sync == pytest.approx(0.125)
+    # Dispatch excludes the sync share it bracketed.
+    assert lr.t_dispatch == pytest.approx(0.75)
+    assert lr.wall == pytest.approx(0.875)
+    # Probe uninstalled after the drain: raw fetches no longer tap it.
+    device_fetch([1], why="outside launch")
+    assert devtel.records[-1].syncs == 1
+
+
+def test_launcher_exception_still_seals_record_and_probe():
+    q, devtel, rec, _ = probed_queue()
+
+    class Boom:
+        kind = "boom"
+
+        def launch(self, payloads):
+            raise RuntimeError("device fell over")
+
+    q.submit(Boom(), [1], origin=0, rows=1)
+    with pytest.raises(RuntimeError, match="fell over"):
+        q.drain()
+    assert len(devtel.records) == 1  # sealed on the error path
+    assert any(e.kind == "sched.launch.end" for e in rec.snapshot())
+    from hyperdrive_tpu.analysis import annotations
+
+    assert annotations._fetch_probe is None
+
+
+def test_null_devtel_is_inert_and_default():
+    q = DeviceWorkQueue()
+    assert q.devtel is NULL_DEVTEL
+    fut = q.submit(EchoLauncher(), [1, 2])
+    q.drain()
+    assert fut.seq is None and fut.launch_id is None
+    assert isinstance(NULL_DEVTEL, NullDeviceTelemetry)
+    assert NULL_DEVTEL.command(0, 3) is None
+    assert NULL_DEVTEL.launch_begin("echo", 0, []) is None
+
+
+def test_devtel_event_kinds_are_in_taxonomy():
+    for k in (
+        "sched.launch.submit", "sched.launch.begin", "sched.launch.cmd",
+        "sched.launch.rows", "sched.launch.lanes",
+        "sched.launch.occupancy", "sched.launch.queue_wait",
+        "sched.launch.split", "sched.launch.end", "sched.launch.commit",
+        "verify.occupancy.rows", "verify.occupancy.lanes",
+        "verify.occupancy.pct", "metrics.snapshot",
+    ):
+        assert k in EVENT_KINDS, k
+
+
+# ------------------------------------------------------- tenant service
+
+
+def test_shard_service_attributes_per_tenant_latency():
+    from hyperdrive_tpu.parallel.multihost import ShardVerifyService
+
+    clock = FakeClock()
+    devtel = DeviceTelemetry(registry=Registry(time_fn=clock),
+                             time_fn=clock)
+    svc = ShardVerifyService(NullVerifier(), devtel=devtel)
+    rows = [(b"\x00" * 32, b"\x01" * 32, None)]
+    svc.submit("tenant-a", rows * 2)
+    clock.now = 0.5
+    svc.submit("tenant-b", rows * 3)
+    clock.now = 2.0
+    svc.drain()
+
+    assert svc.tenant_ids == {"tenant-a": 0, "tenant-b": 1}
+    snap = devtel.registry.snapshot()
+    lat = snap["histograms"]["tenant.verify.latency"]
+    assert set(lat) == {"0", "1"}
+    assert lat["0"]["p50"] == pytest.approx(2.0)
+    assert lat["1"]["p50"] == pytest.approx(1.5)
+    # The launch record carries both tenants' origins.
+    assert devtel.records[-1].origins == (0, 1)
+
+
+def test_tenant_summary_reconstructs_from_journal():
+    q, devtel, rec, clock = probed_queue()
+    launcher = EchoLauncher()
+    q.submit(launcher, [1, 2], origin=0, rows=2)
+    clock.now = 1.0
+    q.submit(launcher, [3], origin=1, rows=1)
+    clock.now = 3.0
+    q.drain()
+    # A gated commit finalized off that launch, 1s after the drain.
+    clock.now = 4.0
+    rec.emit("sched.launch.commit", 2, 9, -1, 0)
+
+    rows = tenant_summary(rec.snapshot())
+    by = {r["tenant"]: r for r in rows}
+    assert set(by) == {0, 1}
+    assert by[0]["submits"] == 1 and by[0]["launches"] == 1
+    assert by[0]["verify_p50_s"] == pytest.approx(3.0)
+    assert by[1]["verify_p50_s"] == pytest.approx(2.0)
+    assert by[0]["commit_p50_s"] == pytest.approx(4.0)
+    assert by[1]["commit_p50_s"] == pytest.approx(3.0)
+    assert by[0]["commits"] == 1
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_counters_gauges_histograms_and_labels():
+    clock = FakeClock()
+    reg = Registry(time_fn=clock)
+    reg.count("a.b", 3)
+    reg.count("a.b")
+    reg.set_gauge("g.depth", 7)
+    reg.observe("h.lat", 0.5)
+    reg.observe("h.lat", 1.5)
+    reg.count("t.per", 2, label="x")
+    reg.observe("t.lat", 0.25, label="x")
+    with reg.span("s.lat"):
+        clock.now += 2.0
+    snap = reg.snapshot()
+    assert snap["counters"]["a.b"] == 4
+    assert snap["counters"]["t.per"] == {"x": 2}
+    assert snap["gauges"]["g.depth"] == 7
+    assert snap["histograms"]["h.lat"]["count"] == 2
+    assert snap["histograms"]["h.lat"]["mean"] == pytest.approx(1.0)
+    assert snap["histograms"]["t.lat"]["x"]["count"] == 1
+    assert snap["histograms"]["s.lat"]["p50"] == pytest.approx(2.0)
+
+
+def test_registry_digest_is_deterministic_and_sensitive():
+    a, b = Registry(time_fn=lambda: 0.0), Registry(time_fn=lambda: 0.0)
+    for reg in (a, b):
+        reg.count("x.y", 2)
+        reg.observe("z.lat", 1.0)
+    assert a.digest() == b.digest()
+    b.count("x.y")
+    assert a.digest() != b.digest()
+
+
+def test_registry_merge_adds_counters_and_merges_histograms():
+    a, b = Registry(), Registry()
+    a.count("c", 1)
+    b.count("c", 2)
+    a.observe("h", 1.0)
+    b.observe("h", 3.0)
+    b.set_gauge("g", 9)
+    a.count("lc", 1, label="t0")
+    b.count("lc", 4, label="t0")
+    b.observe("lh", 2.0, label="t1")
+    a.merge(b)
+    snap = a.snapshot()
+    assert snap["counters"]["c"] == 3
+    assert snap["counters"]["lc"] == {"t0": 5}
+    assert snap["gauges"]["g"] == 9
+    assert snap["histograms"]["h"]["count"] == 2
+    assert snap["histograms"]["lh"]["t1"]["count"] == 1
+
+
+def test_merge_histograms_is_exact_and_rejects_ladder_mismatch():
+    a, b = Histogram(), Histogram()
+    for v in (0.1, 0.2):
+        a.observe(v)
+    for v in (0.3, 0.4, 0.5):
+        b.observe(v)
+    m = merge_histograms(a, b)
+    assert m.total == 5
+    assert m.sum == pytest.approx(1.5)
+    assert [x + y for x, y in zip(a.counts, b.counts)] == m.counts
+    with pytest.raises(ValueError, match="buckets"):
+        merge_histograms(a, Histogram(buckets=(1.0, 2.0)))
+
+
+def test_absorb_tracer_shares_objects_by_reference():
+    reg = Registry()
+    tracer = Tracer(threadsafe=False)
+    tracer.count("sim.step", 5)
+    tracer.observe("sim.lat", 0.5)
+    reg.absorb_tracer(tracer)
+    tracer.count("sim.step", 2)  # updates after absorb are visible
+    snap = reg.snapshot()
+    assert snap["counters"]["sim.step"] == 7
+    assert snap["histograms"]["sim.lat"]["count"] == 1
+
+
+def test_to_prometheus_renders_all_shapes():
+    reg = Registry()
+    reg.count("req.total", 3)
+    reg.count("req.by", 1, label="a b")
+    reg.set_gauge("depth", 2)
+    reg.observe("lat.s", 0.5)
+    reg.observe("lat.by", 0.25, label="t0")
+    text = to_prometheus(reg.snapshot())
+    assert "# TYPE hd_req_total counter" in text
+    assert "hd_req_total 3" in text
+    assert 'hd_req_by{label="a b"} 1' in text
+    assert "# TYPE hd_depth gauge" in text
+    assert "# TYPE hd_lat_s summary" in text
+    assert 'hd_lat_s{quantile="50"} 0.5' in text
+    assert "hd_lat_s_count 1" in text
+    assert 'hd_lat_by{label="t0",quantile="95"} 0.25' in text
+    assert text.endswith("\n")
+
+
+def test_histogram_stats_keys():
+    h = Histogram()
+    h.observe(1.0)
+    row = histogram_stats(h)
+    assert set(row) == {"count", "sum", "mean", "p50", "p95", "p99"}
+
+
+# --------------------------------------------- recorder dropped (threads)
+
+
+def test_threaded_emits_keep_total_dropped_len_consistent():
+    # The satellite spec for the Recorder.dropped atomicity fix: many
+    # writer threads hammering a tiny ring must never lose or double
+    # count a drop — total == len + dropped exactly, under the lock.
+    rec = Recorder(capacity=32, threadsafe=True)
+    n_threads, per_thread = 8, 500
+
+    def hammer(i):
+        bound = rec.scoped(i)
+        for j in range(per_thread):
+            bound.emit("commit", j, 0)
+
+    threads = [
+        threading.Thread(target=hammer, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = n_threads * per_thread
+    assert rec.total == total
+    assert len(rec) == 32
+    assert rec.dropped == total - 32
+    # Snapshot under the same lock: a consistent, fully-formed window.
+    snap = rec.snapshot()
+    assert len(snap) == 32
+    assert all(e.kind == "commit" for e in snap)

@@ -157,3 +157,101 @@ def test_live_tracer_snapshot_matches_observations(rng):
     assert snap["counters"]["a.b"] == 4
     assert snap["histograms"]["lat.s"]["count"] == 3
     assert abs(snap["histograms"]["lat.s"]["mean"] - 0.2) < 1e-12
+
+
+# ------------------------------------------- device track + flow arrows
+
+
+_PIPELINED = dict(
+    n=4, target_height=6, seed=7, sign=True, burst=True, observe=True,
+    pipeline_heights=True,
+)
+
+
+def _pipelined_sim():
+    # Jax-free: sign=True defaults the batch verifier to HostVerifier.
+    from hyperdrive_tpu.harness.sim import Simulation
+
+    sim = Simulation(**_PIPELINED)
+    assert sim.run().completed
+    return sim
+
+
+def test_device_track_slices_carry_launch_args_and_name():
+    import json
+
+    from hyperdrive_tpu.obs.perfetto import DEVICE_TID, to_trace_events
+
+    sim = _pipelined_sim()
+    trace = to_trace_events(sim.obs.snapshot())
+    launches = [
+        e for e in trace
+        if e.get("tid") == DEVICE_TID and e["ph"] == "X"
+    ]
+    assert launches, "pipelined observed run must render device slices"
+    for e in launches:
+        args = e["args"]
+        assert {"launch_id", "rows", "lanes", "occupancy",
+                "queue_wait", "commands"} <= set(args)
+        assert e["dur"] >= 1.0
+    # The device track is named in the metadata.
+    names = {
+        m["tid"]: m["args"]["name"]
+        for m in trace
+        if m["ph"] == "M" and m["name"] == "thread_name"
+    }
+    assert names[DEVICE_TID] == "device"
+    json.dumps(trace)  # schema stays JSON-serializable end to end
+
+
+def test_every_gated_commit_links_to_exactly_one_launch():
+    from hyperdrive_tpu.obs.perfetto import DEVICE_TID, to_trace_events
+
+    sim = _pipelined_sim()
+    events = sim.obs.snapshot()
+    commits = [e for e in events if e.kind == "sched.launch.commit"]
+    assert commits, "pipelined run must gate commits behind launches"
+    launch_ids = {
+        e.detail for e in events if e.kind == "sched.launch.end"
+    }
+    for c in commits:
+        assert c.detail in launch_ids  # exactly one covering launch
+
+    trace = to_trace_events(events)
+    # Flow-arrow pairing: within each category every id appears exactly
+    # once as a start and once as a finish — one unbroken arrow per
+    # command (cmdflow) and per gated commit (commitflow).
+    starts = sorted(
+        (e["cat"], e["id"]) for e in trace if e["ph"] == "s"
+    )
+    finishes = sorted(
+        (e["cat"], e["id"]) for e in trace if e["ph"] == "f"
+    )
+    assert starts == finishes
+    assert len(starts) == len(set(starts))
+    n_commit_flows = sum(
+        1 for c, _ in starts if c == "commitflow"
+    )
+    assert n_commit_flows == len(commits)
+    # Commit-flow starts anchor on the device track, finishes on the
+    # committing replica's track.
+    for e in trace:
+        if e["ph"] == "s" and e["cat"] == "commitflow":
+            assert e["tid"] == DEVICE_TID
+        if e["ph"] == "f" and e["cat"] == "commitflow":
+            assert e["tid"] >= 0
+
+
+def test_fixed_seed_runs_are_digest_identical_journal_registry_trace():
+    import json
+
+    from hyperdrive_tpu.obs.perfetto import to_trace_events
+
+    a, b = _pipelined_sim(), _pipelined_sim()
+    assert a.obs.digest() == b.obs.digest()
+    a.metrics_snapshot()
+    b.metrics_snapshot()
+    assert a.registry.digest() == b.registry.digest()
+    trace_a = json.dumps(to_trace_events(a.obs.snapshot()), sort_keys=True)
+    trace_b = json.dumps(to_trace_events(b.obs.snapshot()), sort_keys=True)
+    assert trace_a == trace_b
