@@ -1,0 +1,325 @@
+"""Open-loop overload bench: latency-vs-offered-load, sim + real sockets.
+
+Produces the BENCH_r08 artifact (graceful-degradation evidence for the
+backpressure spine, ROBUSTNESS.md "Overload doctrine"):
+
+- **sim sweep** — the deterministic harness under escalating
+  behavior-neutral duplicate storms, three runs per rate: unloaded
+  baseline, storm with the admission gate, storm without it. The
+  committed chain is asserted digest-identical across all three (the
+  plateau is exact: offered load never bends the chain or sheds a
+  certificate); the wall-clock curves show what the storm *costs*, and
+  the gated ``admission_benefit_per_s_ratio_series`` (ungated wall /
+  gated wall) pins the gate's overhead-vs-savings balance so a
+  regression that makes admission more expensive than the Process work
+  it sheds fails the sentinel.
+
+- **real-socket sweep** — a live :class:`~hyperdrive_tpu.transport.
+  TcpNode` with the admission gate on its wire ingress, fed by the
+  open-loop :class:`~hyperdrive_tpu.load.generator.TcpLoadGenerator`
+  past saturation. The storm is duplicates (shed); interleaved unique
+  probe prevotes measure *admitted-work* delivery latency
+  (send-schedule time -> replica inbox time). Per rate: offered /
+  admitted / shed-by-class and probe p50/p95/p99; the gated series is
+  p99 normalized to the lowest rate's p99 — bounded blowup, not
+  collapse.
+
+Both gated series are machine-portable ratios, nominated in the
+artifact's ``benchdiff_gate`` list; the CI overload-soak job diffs a
+fresh ``--quick`` run against the committed BENCH_r08.json with
+``python -m hyperdrive_tpu.obs benchdiff``.
+
+Usage::
+
+    python benches/overload_bench.py [-o BENCH_r08.json] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from hyperdrive_tpu.harness.sim import Simulation  # noqa: E402
+from hyperdrive_tpu.load.backpressure import (  # noqa: E402
+    SHED_DUPLICATES,
+    AdmissionGate,
+    BackpressureController,
+)
+from hyperdrive_tpu.load.generator import (  # noqa: E402
+    LoadProfile,
+    TcpLoadGenerator,
+)
+from hyperdrive_tpu.load.schedule import PoissonSchedule  # noqa: E402
+from hyperdrive_tpu.messages import Prevote  # noqa: E402
+from hyperdrive_tpu.obs.metrics import Registry  # noqa: E402
+from hyperdrive_tpu.transport import (  # noqa: E402
+    TcpNode,
+    encode_frame,
+)
+
+SEED = 23
+
+
+def _quantile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+# ------------------------------------------------------------------ sim
+
+def _sim(seed, target, load=None):
+    extra = {} if load is None else {"load": load}
+    return Simulation(
+        n=4,
+        target_height=target,
+        seed=seed,
+        timeout=1.0,
+        delivery_cost=1e-3,
+        certificates=True,
+        observe=True,
+        **extra,
+    )
+
+
+def _timed_run(sim):
+    w0 = time.perf_counter()
+    res = sim.run()
+    return res, time.perf_counter() - w0
+
+
+def sim_sweep(rates, target, trials):
+    """The deterministic-harness sweep, three runs per (trial, rate):
+    unloaded baseline, storm with the admission gate, storm without it
+    (raw Process-dedup path). Virtual committed throughput under the
+    behavior-neutral storm is *exactly* flat — the loaded chain equals
+    the unloaded chain digest-for-digest, asserted per rate — so the
+    wall-clock curves carry the degradation story: how much wall each
+    offered rate costs, and how much of that cost the admission gate
+    sheds before it reaches the Process (the gated
+    ``admission_benefit_per_s_ratio_series``, gated >= ~1)."""
+    out = {
+        "rates": list(rates),
+        "trials": trials,
+        "digest_equal": [],
+        "certs_intact": [],
+        "injected": [],
+        "shed": [],
+        "unloaded_commits_per_s": [],
+        "gated_commits_per_s": {},
+        "ungated_commits_per_s": {},
+        "admission_benefit_per_s_ratio_series": [],
+    }
+    for t in range(trials):
+        base_sim = _sim(SEED + t, target)
+        base, base_wall = _timed_run(base_sim)
+        out["unloaded_commits_per_s"].append(round(target / base_wall, 2))
+        for rate in rates:
+            gated = _sim(
+                SEED + t, target,
+                load=LoadProfile(rate=rate, seed=SEED + t),
+            )
+            gres, gwall = _timed_run(gated)
+            ungated = _sim(
+                SEED + t, target,
+                load=LoadProfile(rate=rate, seed=SEED + t,
+                                 admission=False),
+            )
+            ures, uwall = _timed_run(ungated)
+            out["gated_commits_per_s"].setdefault(str(rate), []).append(
+                round(target / gwall, 2)
+            )
+            out["ungated_commits_per_s"].setdefault(str(rate), []).append(
+                round(target / uwall, 2)
+            )
+            out["admission_benefit_per_s_ratio_series"].append(
+                round(uwall / gwall, 4)
+            )
+            if t == 0:
+                snap = gated.overload_snapshot()
+                out["digest_equal"].append(
+                    gres.commit_digest() == base.commit_digest()
+                    and ures.commit_digest() == base.commit_digest()
+                )
+                out["certs_intact"].append(
+                    all(
+                        set(bc.certs) == set(lc.certs)
+                        for bc, lc in zip(
+                            base_sim.certifiers, gated.certifiers
+                        )
+                    )
+                )
+                out["injected"].append(snap["injected"])
+                out["shed"].append(snap["shed"])
+    return out
+
+
+# ----------------------------------------------------------- real socket
+
+class _ProbeSink:
+    """A TcpNode 'replica' that timestamps every delivered prevote by
+    its value — the receive side of the latency probes."""
+
+    def __init__(self):
+        self.recv = {}
+
+    def propose(self, msg, stop):
+        pass
+
+    def prevote(self, msg, stop):
+        self.recv.setdefault(msg.value, time.monotonic())
+
+    def precommit(self, msg, stop):
+        pass
+
+
+def _probe_frames(n_arrivals, probe_every):
+    """The storm frame list: one shared duplicate prevote everywhere,
+    a unique probe prevote every ``probe_every``-th slot."""
+    dup = encode_frame(
+        Prevote(height=5, round=0, value=b"\x11" * 32, sender=b"\x22" * 32)
+    )
+    frames = []
+    probe_slots = {}
+    for k in range(n_arrivals):
+        if k % probe_every == 0:
+            value = k.to_bytes(32, "little")
+            frames.append(
+                encode_frame(
+                    Prevote(
+                        height=5, round=0, value=value, sender=b"\x33" * 32
+                    )
+                )
+            )
+            probe_slots[k] = value
+        else:
+            frames.append(dup)
+    return frames, probe_slots
+
+
+def socket_sweep(rates, duration, probe_every=16):
+    out = {
+        "rates": list(rates),
+        "duration_s": duration,
+        "offered": [],
+        "sent": [],
+        "admitted": [],
+        "shed": [],
+        "behind_max_s": [],
+        "probe_p50_s": [],
+        "probe_p95_s": [],
+        "probe_p99_s": [],
+        "probes_delivered": [],
+        "p99_latency_ratio_series": [],
+        "shed_classes_ok": True,
+    }
+    for i, rate in enumerate(rates):
+        registry = Registry()
+        ctrl = BackpressureController(registry=registry, threadsafe=True)
+        ctrl.floor = SHED_DUPLICATES
+        ctrl.poll()
+        gate = AdmissionGate(ctrl, registry=registry, threadsafe=True)
+        node = TcpNode(admission=gate, registry=registry, seed=SEED)
+        sink = _ProbeSink()
+        node.add_replica(sink)
+        node.start()
+        try:
+            schedule = PoissonSchedule(rate, seed=SEED + i)
+            arrivals = schedule.arrivals(duration)
+            frames, probe_slots = _probe_frames(len(arrivals), probe_every)
+            gen = TcpLoadGenerator(
+                [("127.0.0.1", node.port)], frames, schedule,
+                duration=duration,
+            )
+            gen.start()
+            gen.join(duration + 10.0)
+            time.sleep(0.3)  # let the read loop drain the tail
+            lats = []
+            for k, value in probe_slots.items():
+                t_recv = sink.recv.get(value)
+                if t_recv is not None and gen.t0 is not None:
+                    lats.append(max(0.0, t_recv - (gen.t0 + arrivals[k])))
+            lats.sort()
+            snap = gate.snapshot()
+            out["offered"].append(len(arrivals))
+            out["sent"].append(gen.sent)
+            out["admitted"].append(snap["admitted"])
+            out["shed"].append(snap["shed"])
+            out["behind_max_s"].append(round(gen.behind_max, 4))
+            out["probe_p50_s"].append(_quantile(lats, 0.50))
+            out["probe_p95_s"].append(_quantile(lats, 0.95))
+            out["probe_p99_s"].append(_quantile(lats, 0.99))
+            out["probes_delivered"].append(len(lats))
+            # The pinned gate may shed ONLY behavior-neutral classes.
+            if set(snap["shed"]) - {"duplicate", "stale_height"}:
+                out["shed_classes_ok"] = False
+        finally:
+            node.stop()
+    base_p99 = out["probe_p99_s"][0] if out["probe_p99_s"] else None
+    if base_p99:
+        out["p99_latency_ratio_series"] = [
+            round(p / base_p99, 4)
+            for p in out["probe_p99_s"]
+            if p is not None
+        ]
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output", default="BENCH_r08.json")
+    ap.add_argument("--quick", action="store_true",
+                    help="CI-sized sweep (shorter, fewer trials)")
+    ns = ap.parse_args(argv)
+
+    if ns.quick:
+        sim_rates, target, trials = [1000.0, 8000.0], 6, 2
+        sock_rates, duration = [1000.0, 4000.0, 12000.0], 0.6
+    else:
+        sim_rates, target, trials = [1000.0, 4000.0, 16000.0], 8, 3
+        sock_rates, duration = [1000.0, 4000.0, 12000.0, 24000.0], 1.0
+
+    doc = {
+        "measured_at": datetime.datetime.now().strftime(
+            "%Y-%m-%d %H:%M:%S"
+        ),
+        "benchdiff_gate": [
+            "overload.sim.admission_benefit_per_s_ratio_series",
+            "overload.real.p99_latency_ratio_series",
+        ],
+        "overload": {
+            "sim": sim_sweep(sim_rates, target, trials),
+            "real": socket_sweep(sock_rates, duration),
+        },
+    }
+    ok = (
+        all(doc["overload"]["sim"]["digest_equal"])
+        and all(doc["overload"]["sim"]["certs_intact"])
+        and doc["overload"]["real"]["shed_classes_ok"]
+    )
+    doc["graceful_degradation_ok"] = ok
+    with open(ns.output, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({
+        "artifact": ns.output,
+        "graceful_degradation_ok": ok,
+        "sim_admission_benefit": doc["overload"]["sim"][
+            "admission_benefit_per_s_ratio_series"
+        ],
+        "real_p99_ratio": doc["overload"]["real"][
+            "p99_latency_ratio_series"
+        ],
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -684,7 +684,8 @@ class MetricNameRule:
     _NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)*$")
     #: Event-name prefixes whose membership is closed: an ``.emit``
     #: literal under one of these must appear in EVENT_KINDS verbatim.
-    _CLOSED_PREFIXES = ("sched.launch.", "verify.occupancy.", "metrics.")
+    _CLOSED_PREFIXES = ("sched.launch.", "verify.occupancy.", "metrics.",
+                        "load.", "admission.")
 
     def check(self, ctx):
         findings: list = []

@@ -5,7 +5,7 @@ Usage::
     python -m hyperdrive_tpu.chaos soak [--scenarios N] [--seed S]
         [--n N_REPLICAS] [--target H] [--out DIR] [--replay-every K]
         [--pipelined-every K] [--certs-every K] [--churn-every K]
-        [--dump-ok DIR]
+        [--overload-every K] [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -41,11 +41,13 @@ _SEED_STRIDE = 9973
 
 
 def _build(scen_seed: int, n: int, target: int, pipelined: bool = False,
-           certificates: bool = False):
+           certificates: bool = False, load=None):
     plan = FaultPlan.seeded(scen_seed, n)
     extra = {}
     if certificates:
         extra["certificates"] = True
+    if load is not None:
+        extra["load"] = load
     if pipelined:
         # Queue-backed settle path: every replica flushes through one
         # shared async device-work queue (jax-free QueueFlusher), so
@@ -186,6 +188,48 @@ def soak(args) -> int:
                         "pipelined",
                         "pipelined chain diverges from sequential",
                     )
+            if args.overload_every and k % args.overload_every == 0:
+                # The overload fault family (ISSUE 11): re-run the SAME
+                # plan with an open-loop duplicate storm + the admission
+                # spine pinned in the behavior-neutral band. The loaded
+                # run must commit the identical chain — injected
+                # duplicates consume no steps/clock/rng, and the gate
+                # sheds only classes the Process ignores anyway — and
+                # must actually have shed something (the storm is not
+                # allowed to be a no-op) while never shedding outside
+                # the admission vocabulary.
+                _, profile = FaultPlan.overload(scen_seed, n)
+                _, osim = _build(scen_seed, n, args.target, load=profile)
+                omon = InvariantMonitor(osim)
+                oresult = osim.run(max_steps=args.max_steps)
+                omon.check_final(oresult)
+                if oresult.commit_digest() != result.commit_digest():
+                    raise InvariantViolation(
+                        "overload",
+                        "overloaded chain diverges from unloaded run",
+                    )
+                osnap = osim.overload_snapshot()
+                # Guaranteed-shed prey only: vote duplicates at
+                # un-advanced heights (proposal dups and behind-the-
+                # commit-edge votes are admitted/filtered by doctrine).
+                if osnap["injected_sheddable"] and not osnap["shed"]:
+                    raise InvariantViolation(
+                        "overload",
+                        "sheddable storm injected but admission shed nothing",
+                    )
+                bad = set(osnap["shed"]) - {"duplicate", "stale_height"}
+                if bad:
+                    raise InvariantViolation(
+                        "overload",
+                        f"behavior-neutral run shed classes {sorted(bad)}",
+                    )
+                shed_str = ",".join(
+                    f"{c}:{n_}" for c, n_ in sorted(osnap["shed"].items())
+                ) or "-"
+                print(
+                    f"ok overload seed={scen_seed} n={n} "
+                    f"injected={osnap['injected']} shed={shed_str}"
+                )
         except (InvariantViolation, AssertionError) as err:
             failures += 1
             base = _dump_failure(args.out, scen_seed, sim, err)
@@ -330,6 +374,14 @@ def main(argv=None) -> int:
         default=4,
         help="re-run every Kth plan with quorum certificates enabled and "
         "cross-check chain digests + certificate integrity (0 = off)",
+    )
+    p.add_argument(
+        "--overload-every",
+        type=int,
+        default=4,
+        help="re-run every Kth plan under an open-loop duplicate storm "
+        "with behavior-neutral admission and cross-check the commit "
+        "digest against the unloaded run (0 = off)",
     )
     p.add_argument(
         "--churn-every",

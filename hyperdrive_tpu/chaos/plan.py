@@ -218,6 +218,36 @@ class FaultPlan:
         return plan
 
     @classmethod
+    def overload(
+        cls,
+        seed: int,
+        n: int,
+        *,
+        rate: float = 2000.0,
+    ) -> "tuple[FaultPlan, object]":
+        """The overload fault family (ISSUE 11): open-loop load
+        COINCIDING with partitions and heals. Returns ``(plan,
+        profile)`` — the same :meth:`seeded` plan the unloaded baseline
+        runs, paired with a digest-safe
+        :class:`~hyperdrive_tpu.load.generator.LoadProfile` drawn from
+        the same seed. The acceptance contract: a run with both applied
+        commits the SAME chain digests as the plan alone, because the
+        profile stays pinned in the behavior-neutral admission band
+        (floor <= SHED_DUPLICATES) and the injector consumes no steps,
+        clock, or rng. The soak CLI's ``--overload-every`` leg asserts
+        exactly that equality."""
+        from hyperdrive_tpu.load.generator import LoadProfile
+
+        plan = cls.seeded(seed, n)
+        profile = LoadProfile.seeded(seed, rate=rate)
+        if profile.floor > 1:  # SHED_DUPLICATES
+            raise ValueError(
+                "overload family profiles must stay behavior-neutral "
+                f"(floor <= SHED_DUPLICATES), got floor={profile.floor}"
+            )
+        return plan, profile
+
+    @classmethod
     def churn(
         cls,
         seed: int,

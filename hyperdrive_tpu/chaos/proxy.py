@@ -44,6 +44,14 @@ class ChaosProxy:
     written (the link stays FIFO — real TCP links are). While
     partitioned, inbound frames are read and discarded, keeping the
     peer's connection alive so heal resumes without a redial.
+
+    ``bandwidth_bps > 0`` models a slow link rather than a lossy one:
+    every forwarded frame pays a serialization delay of ``frame bits /
+    bandwidth_bps`` seconds before the write (FIFO, so the slow-peer
+    backlog accumulates exactly as a saturated pipe would). This is the
+    overload family's slow-peer fault — the reader-side complement of
+    the sender's backpressure: the target node's peer queue toward a
+    throttled peer fills and sheds while healthy peers stay fast.
     """
 
     def __init__(
@@ -57,6 +65,7 @@ class ChaosProxy:
         duplicate: float = 0.0,
         delay: float = 0.0,
         delay_s: tuple[float, float] = (0.005, 0.05),
+        bandwidth_bps: float = 0.0,
     ) -> None:
         self._target = (target_host, target_port)
         self._rng = random.Random(seed)
@@ -65,6 +74,14 @@ class ChaosProxy:
         self.duplicate = duplicate
         self.delay = delay
         self.delay_s = delay_s
+        if bandwidth_bps < 0.0:
+            raise ValueError(
+                f"bandwidth_bps must be >= 0, got {bandwidth_bps}"
+            )
+        self.bandwidth_bps = bandwidth_bps
+        #: Cumulative seconds of serialization delay paid (tests assert
+        #: the throttle actually bit).
+        self.throttled_s = 0.0
         self._partitioned = threading.Event()
         self._stop = threading.Event()
         self.forwarded = 0
@@ -159,6 +176,15 @@ class ChaosProxy:
                         continue
                     if self.delay and r_delay < self.delay:
                         time.sleep(pause)
+                    if self.bandwidth_bps:
+                        # Slow link: serialization time proportional to
+                        # frame size, paid on every frame (deterministic
+                        # in size, not seeded — a pipe's width is not a
+                        # coin flip).
+                        pay = len(frame) * 8.0 / self.bandwidth_bps
+                        with self._count_lock:
+                            self.throttled_s += pay
+                        time.sleep(pay)
                     copies = (
                         2 if self.duplicate and r_dup < self.duplicate else 1
                     )

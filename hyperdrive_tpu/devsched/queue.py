@@ -205,6 +205,13 @@ class DeviceWorkQueue:
         #: probe touch point below guards on that identity first.
         self.devtel = devtel if devtel is not None else NULL_DEVTEL
         self.on_drain = None
+        #: Backpressure seam (load/backpressure.py): when a
+        #: BackpressureController is attached (``controller.watch(q)``),
+        #: every submit pushes the new depth and every drain pushes its
+        #: resolved count + latency (timed by the controller's clock, so
+        #: the queue itself stays wall-clock-free). None = no admission
+        #: coupling, exactly the pre-backpressure behavior.
+        self.controller = None
         self._pending: list = []  # (launcher, payload, future, gen, meta)
         self._launchers: dict = {}  # id(verifier) -> VerifyLauncher
         self._draining = False
@@ -266,6 +273,8 @@ class DeviceWorkQueue:
             fut.seq = meta.seq
         self._pending.append((launcher, payload, fut, generation, meta))
         self.submitted += 1
+        if self.controller is not None:
+            self.controller.note_depth(len(self._pending))
         if self.obs is not NULL_BOUND:
             self.obs.emit(
                 "sched.submit", -1, -1,
@@ -293,6 +302,10 @@ class DeviceWorkQueue:
             return 0
         self._draining = True
         resolved = 0
+        ctrl = self.controller
+        t0 = None
+        if ctrl is not None and ctrl.time_fn is not None:
+            t0 = ctrl.time_fn()
         try:
             while self._pending:
                 batch = self._pending
@@ -384,6 +397,11 @@ class DeviceWorkQueue:
         finally:
             self._draining = False
         if resolved:
+            if ctrl is not None:
+                ctrl.note_drain(
+                    resolved,
+                    (ctrl.time_fn() - t0) if t0 is not None else 0.0,
+                )
             if self.obs is not NULL_BOUND:
                 self.obs.emit("sched.drain", -1, -1, resolved)
             if self.tracer is not None:

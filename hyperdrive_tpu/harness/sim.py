@@ -458,6 +458,7 @@ class Simulation:
         epochs=None,
         catchup_every: Optional[int] = None,
         catchup_lag: Optional[int] = None,
+        load=None,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -1024,6 +1025,32 @@ class Simulation:
             raise ValueError("catchup_every must be >= 1")
         if self._catchup_lag < 0:
             raise ValueError("catchup_lag must be >= 0")
+        #: Open-loop overload injection (load/generator.py LoadProfile):
+        #: schedule arrivals are checked against the virtual clock at
+        #: every delivered vote, and each due arrival re-delivers that
+        #: vote inline as a gossip duplicate — consuming NO steps, NO
+        #: virtual time, and NO rng draws, so the real message schedule
+        #: (timeouts, chaos ticks, reorder swaps) is bit-identical to
+        #: the unloaded run and behavior-neutral shedding keeps commit
+        #: digests equal. Injected deliveries ARE recorded, so replay
+        #: reproduces the loaded run exactly.
+        self._load = None
+        self.load_controller = None
+        if load is not None:
+            if burst:
+                raise ValueError(
+                    "open-loop load injects per delivery; use lock-step "
+                    "mode (burst=False)"
+                )
+            if delivery_cost <= 0.0:
+                raise ValueError(
+                    "load arrivals are scheduled on the virtual clock, "
+                    "and without delivery pacing a busy network never "
+                    "advances it — pass delivery_cost > 0"
+                )
+            from hyperdrive_tpu.load.generator import LoadRuntime
+
+            self._load = LoadRuntime(load)
         self._chaos = chaos
         self._chaos_monitor = None
         from hyperdrive_tpu.utils.checkpoint import CheckpointStore
@@ -1079,6 +1106,35 @@ class Simulation:
             # old key's votes at heights below H.
             for r in self.replicas:
                 r.retired = self._retired
+        if self._load is not None and self._load.profile.admission:
+            # The backpressure spine rides the loaded run: one shared
+            # controller pinned at the profile's floor (pin=False also
+            # couples the device-queue depth/drain signals, the bench's
+            # escalation mode), one AdmissionGate per replica so dedup
+            # memory stays a local property of each ingress.
+            from hyperdrive_tpu.load.backpressure import (
+                AdmissionGate,
+                BackpressureController,
+            )
+
+            p = self._load.profile
+            ctrl = BackpressureController(
+                registry=self.registry,
+                obs=self._obs_sim,
+                time_fn=lambda: self.clock.now,
+            )
+            ctrl.floor = p.floor
+            if not p.pin and self._sched is not None:
+                ctrl.watch(self._sched)
+            ctrl.poll()
+            self.load_controller = ctrl
+            for i, r in enumerate(self.replicas):
+                r.admission = AdmissionGate(
+                    ctrl,
+                    height_fn=r.current_height,
+                    registry=self.registry,
+                    obs=self.obs.scoped(i),
+                )
         if device_tally:
             # The grid answers the hot quorum queries; the host keeps the
             # logs (checkpoints, evidence) but skips the derived per-value
@@ -1508,6 +1564,40 @@ class Simulation:
             ]
         return result
 
+    def overload_snapshot(self) -> dict:
+        """Aggregated overload accounting for a loaded run: injected
+        duplicates, network-wide offered/admitted/shed-by-class gate
+        counters, and the controller's level/transition count. The soak
+        CLI and the overload bench assert against this — notably that
+        no shed class outside the admission vocabulary ever appears
+        (certificates/proposals never shed)."""
+        lr = self._load
+        out: dict = {
+            "injected": lr.offered if lr is not None else 0,
+            #: Vote duplicates injected at un-advanced heights — the
+            #: storm fraction the gate MUST shed (a bursty storm landing
+            #: only on proposals legitimately sheds nothing).
+            "injected_sheddable": lr.sheddable if lr is not None else 0,
+            "offered": 0,
+            "admitted": 0,
+            "shed": {},
+            "level": 0,
+            "transitions": 0,
+        }
+        for r in self.replicas:
+            gate = r.admission
+            if gate is None:
+                continue
+            snap = gate.snapshot()
+            out["offered"] += snap["offered"]
+            out["admitted"] += snap["admitted"]
+            for cls, v in snap["shed"].items():
+                out["shed"][cls] = out["shed"].get(cls, 0) + v
+        if self.load_controller is not None:
+            out["level"] = self.load_controller.level
+            out["transitions"] = self.load_controller.transitions
+        return out
+
     def _run_delivery(self, max_steps: int) -> SimulationResult:
         """The delivery loop behind :meth:`run` (burst or lock-step)."""
         if self.burst:
@@ -1585,6 +1675,45 @@ class Simulation:
                 # the restore image is the exact mid-protocol state at
                 # the last message the process survived.
                 self._ckpt_store.save(to, self.replicas[to].proc)
+
+            lr = self._load
+            if lr is not None and (
+                type(msg) is Prevote
+                or type(msg) is Precommit
+                or type(msg) is Propose
+            ):
+                # Open-loop injection point: every schedule arrival due
+                # at this virtual instant re-delivers the CURRENT vote
+                # to the same replica as a gossip duplicate — inline,
+                # after the real delivery, with no step count, no clock
+                # advance, and no rng draw, so the unloaded trajectory
+                # is untouched. Duplicates are recorded (replay is
+                # exact) and checkpointed like any handled delivery.
+                k = lr.due(self.clock.now)
+                if k:
+                    self.registry.count("load.offered", k)
+                    obs = self._obs_sim
+                    if obs is not _OBS_NULL:
+                        obs.emit("load.offered", -1, -1, k)
+                        if k >= lr.profile.amp_cap:
+                            obs.emit("load.burst", -1, -1, k)
+                    r = self.replicas[to]
+                    # Vote duplicates at an un-advanced height are the
+                    # gate's guaranteed prey (the original just passed
+                    # through it, so the dedup key is warm); proposal
+                    # duplicates and votes behind the commit edge are
+                    # admitted/height-filtered by doctrine.
+                    if (
+                        type(msg) is not Propose
+                        and msg.height >= r.current_height()
+                    ):
+                        lr.sheddable += k
+                    capture = to in self._ckpt_capture
+                    for _ in range(k):
+                        record_messages.append((to, msg))
+                        r.handle(msg)
+                        if capture:
+                            self._ckpt_store.save(to, r.proc)
 
         if sched is not None:
             sched.drain()

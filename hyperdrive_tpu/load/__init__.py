@@ -1,0 +1,59 @@
+"""Open-loop load generation and the backpressure spine.
+
+Everything before this package drove the system closed-loop: the
+harness delivers a message, waits for the replica to digest it, then
+delivers the next — offered load can never exceed service rate by
+construction. Production traffic is open-loop: arrivals keep coming at
+their own rate whether or not the pipeline has caught up (ROADMAP item
+5), and the only question is what the system does past saturation.
+
+Three pieces:
+
+- :mod:`~hyperdrive_tpu.load.schedule` — deterministic seeded arrival
+  processes (Poisson and bursty), the open-loop clock both the sim
+  injector and the real-socket generator draw from.
+- :mod:`~hyperdrive_tpu.load.backpressure` — the admission spine: a
+  :class:`BackpressureController` watching DeviceWorkQueue depth /
+  drain latency / peer send-queue occupancy and exposing an admission
+  level (ACCEPT → SHED_DUPLICATES → SHED_LOW_PRIORITY →
+  CRITICAL_ONLY), plus the :class:`AdmissionGate` that classifies and
+  sheds messages under it. The shed-class doctrine (ROBUSTNESS.md)
+  follows arXiv:1911.04698's aggregation-gossip policy: certificates
+  and proposals are never shed, duplicates and stale-height votes go
+  first — exactly the classes the Process ignores anyway, which is why
+  behavior-neutral shedding commits the same chain as an unloaded run.
+- :mod:`~hyperdrive_tpu.load.generator` — :class:`LoadProfile` (the
+  sim-side open-loop injector, interpreted by ``Simulation(load=...)``)
+  and :class:`TcpLoadGenerator` (a wall-clock firehose of pre-encoded
+  frames at a real :class:`~hyperdrive_tpu.transport.TcpNode`).
+
+``python -m hyperdrive_tpu.load soak`` is the CI overload soak: a short
+open-loop run past saturation under HD_SANITIZE asserting no-fork,
+certificates-never-shed, and a bounded admitted-work p99.
+"""
+
+from hyperdrive_tpu.load.backpressure import (
+    ACCEPT,
+    CRITICAL_ONLY,
+    LEVEL_NAMES,
+    SHED_DUPLICATES,
+    SHED_LOW_PRIORITY,
+    AdmissionGate,
+    BackpressureController,
+)
+from hyperdrive_tpu.load.generator import LoadProfile, TcpLoadGenerator
+from hyperdrive_tpu.load.schedule import BurstSchedule, PoissonSchedule
+
+__all__ = [
+    "ACCEPT",
+    "SHED_DUPLICATES",
+    "SHED_LOW_PRIORITY",
+    "CRITICAL_ONLY",
+    "LEVEL_NAMES",
+    "AdmissionGate",
+    "BackpressureController",
+    "BurstSchedule",
+    "PoissonSchedule",
+    "LoadProfile",
+    "TcpLoadGenerator",
+]

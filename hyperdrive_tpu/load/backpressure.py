@@ -1,0 +1,366 @@
+"""The backpressure spine: admission levels, classification, shedding.
+
+Overload handling before this module was three disconnected fragments:
+TcpNode shed the oldest frame on per-peer queue overflow, the device
+work queue auto-drained at ``max_depth`` with no signal back to
+admission, and nothing distinguished a duplicate low-value vote from an
+irreplaceable proposal when deciding what to drop. This module is the
+connective tissue: a :class:`BackpressureController` turns pipeline
+signals (device-queue depth, drain latency, peer send-queue occupancy)
+into one admission level, and an :class:`AdmissionGate` applies that
+level at every ingress (wire delivery, broadcast, mq insert, replica
+buffering) with a fixed shed-class doctrine.
+
+Admission levels (escalating)::
+
+    ACCEPT             everything admitted
+    SHED_DUPLICATES    exact duplicates and stale-height votes shed
+    SHED_LOW_PRIORITY  + fresh prevotes from over-share peers shed
+                         (per-peer fairness: a firehose peer cannot
+                         starve the rest)
+    CRITICAL_ONLY      + every fresh prevote shed; only proposals,
+                         precommits, and certificates flow
+
+Never shed, at any level: proposals (irreplaceable — there is exactly
+one legitimate proposal per round), precommits (quorum-forming), and
+certificates / unknown message types (aggregates outrank raw votes —
+arXiv:1911.04698's shed policy). The first two levels are
+*behavior-neutral*: the Process dedups votes and the replica
+height-filters stale ones, so a run shedding only those classes commits
+a byte-identical chain to the unloaded run — the chaos overload family
+asserts exactly that. CRITICAL_ONLY trades prevote liveness for
+survival and is the transient panic level; safety is never at stake
+(shedding inputs is indistinguishable from message loss, which the
+protocol tolerates by design).
+
+De-escalation is hysteretic: the level steps down only after
+``hysteresis`` consecutive clean polls, so a queue oscillating around a
+threshold does not flap the gate.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+from hyperdrive_tpu.obs.recorder import NULL_BOUND
+
+__all__ = [
+    "ACCEPT",
+    "SHED_DUPLICATES",
+    "SHED_LOW_PRIORITY",
+    "CRITICAL_ONLY",
+    "LEVEL_NAMES",
+    "SHED_CLASSES",
+    "BackpressureController",
+    "AdmissionGate",
+]
+
+ACCEPT = 0
+SHED_DUPLICATES = 1
+SHED_LOW_PRIORITY = 2
+CRITICAL_ONLY = 3
+
+#: Level index -> stable wire/report name.
+LEVEL_NAMES = ("accept", "shed_duplicates", "shed_low_priority",
+               "critical_only")
+
+#: The closed shed-class vocabulary (ROBUSTNESS.md "Overload doctrine").
+#: ``duplicate`` / ``stale_height`` are behavior-neutral; ``low_priority``
+#: / ``panic`` trade prevote liveness for survival. There is deliberately
+#: no class for proposals, precommits, or certificates — they are never
+#: shed, and the soak asserts the counters for them stay absent.
+SHED_CLASSES = ("duplicate", "stale_height", "low_priority", "panic")
+
+#: Message-type tags for dedup keys (stable across runs, unlike id()).
+_TAG = {Propose: 0, Prevote: 1, Precommit: 2}
+
+
+class BackpressureController:
+    """Fuses pipeline pressure signals into one admission level.
+
+    Signals (each optional — unsupplied signals simply never escalate):
+
+    - **device-queue depth** — :class:`~hyperdrive_tpu.devsched.queue.
+      DeviceWorkQueue` pushes its depth on every submit and its drain
+      wall time on every drain once ``queue.controller`` is set (or
+      call :meth:`watch`).
+    - **drain latency** — seconds one coalesced drain took; a pipeline
+      that still drains fast can absorb a deep queue, so latency gates
+      the two upper levels rather than depth alone.
+    - **peer send-queue occupancy** — worst per-peer outbound backlog
+      as a fraction of capacity (TcpNode reports it on every shed-path
+      broadcast).
+
+    The level is the max over the per-signal levels, plus an optional
+    ``floor`` (the sim's deterministic overload profiles pin the floor
+    instead of modeling device time, keeping fixed-seed digests exact).
+    Escalation is immediate; de-escalation needs ``hysteresis``
+    consecutive polls that all map below the current level.
+    """
+
+    def __init__(
+        self,
+        queue=None,
+        *,
+        depth_duplicates: int = 8,
+        depth_low_priority: int = 64,
+        depth_critical: int = 256,
+        drain_latency_s: float = 0.25,
+        occupancy_low_priority: float = 0.5,
+        occupancy_critical: float = 0.9,
+        hysteresis: int = 3,
+        registry=None,
+        obs=None,
+        time_fn=None,
+        threadsafe: bool = False,
+    ):
+        self.depth_duplicates = int(depth_duplicates)
+        self.depth_low_priority = int(depth_low_priority)
+        self.depth_critical = int(depth_critical)
+        self.drain_latency_s = float(drain_latency_s)
+        self.occupancy_low_priority = float(occupancy_low_priority)
+        self.occupancy_critical = float(occupancy_critical)
+        self.hysteresis = max(1, int(hysteresis))
+        self.registry = registry
+        self.obs = obs if obs is not None else NULL_BOUND
+        #: Clock for drain-latency timing in the watched queue (the sim
+        #: passes its virtual clock; real deployments time.monotonic).
+        #: None keeps drain latency at 0.0 — depth and occupancy still
+        #: escalate, and fixed-seed runs stay wall-clock-free.
+        self.time_fn = time_fn
+        self._lock = threading.Lock() if threadsafe else None
+        #: Pinned minimum level (load profiles / tests); raw signals can
+        #: escalate above the floor but never de-escalate below it.
+        self.floor = ACCEPT
+        self.level = ACCEPT
+        #: Level transitions (escalations + de-escalations), for tests
+        #: and the overload report.
+        self.transitions = 0
+        self._depth = 0
+        self._drain_s = 0.0
+        self._occupancy = 0.0
+        self._calm = 0
+        if queue is not None:
+            self.watch(queue)
+
+    def watch(self, queue) -> None:
+        """Attach to a DeviceWorkQueue: its submit/drain paths push
+        depth and drain-latency signals here from then on."""
+        queue.controller = self
+
+    # ------------------------------------------------------------ signals
+
+    def note_depth(self, depth: int) -> None:
+        lock = self._lock
+        if lock is None:
+            self._depth = depth
+            self._update()
+        else:
+            with lock:
+                self._depth = depth
+                self._update()
+
+    def note_drain(self, resolved: int, latency_s: float) -> None:
+        lock = self._lock
+        if lock is None:
+            self._drain_s = latency_s
+            self._depth = 0
+            self._update()
+        else:
+            with lock:
+                self._drain_s = latency_s
+                self._depth = 0
+                self._update()
+
+    def note_peer_occupancy(self, fraction: float) -> None:
+        """Worst outbound peer-queue occupancy in [0, 1]."""
+        lock = self._lock
+        if lock is None:
+            self._occupancy = fraction
+            self._update()
+        else:
+            with lock:
+                self._occupancy = fraction
+                self._update()
+
+    def poll(self) -> int:
+        """Recompute (hysteresis advances on clean polls); returns the
+        current level."""
+        lock = self._lock
+        if lock is None:
+            self._update()
+        else:
+            with lock:
+                self._update()
+        return self.level
+
+    # ------------------------------------------------------------ fusion
+
+    def _raw_level(self) -> int:
+        level = self.floor
+        d = self._depth
+        if d >= self.depth_critical:
+            level = max(level, CRITICAL_ONLY)
+        elif d >= self.depth_low_priority:
+            level = max(level, SHED_LOW_PRIORITY)
+        elif d >= self.depth_duplicates:
+            level = max(level, SHED_DUPLICATES)
+        if self._drain_s >= self.drain_latency_s:
+            level = max(level, SHED_LOW_PRIORITY)
+        occ = self._occupancy
+        if occ >= self.occupancy_critical:
+            level = max(level, CRITICAL_ONLY)
+        elif occ >= self.occupancy_low_priority:
+            level = max(level, SHED_LOW_PRIORITY)
+        return level
+
+    def _update(self) -> None:
+        raw = self._raw_level()
+        if raw > self.level:
+            self._set(raw)
+            self._calm = 0
+        elif raw < self.level:
+            self._calm += 1
+            if self._calm >= self.hysteresis:
+                self._set(raw)
+                self._calm = 0
+        else:
+            self._calm = 0
+
+    def _set(self, level: int) -> None:
+        self.level = level
+        self.transitions += 1
+        if self.registry is not None:
+            self.registry.set_gauge("admission.level", level)
+            self.registry.count("admission.transitions")
+        if self.obs is not NULL_BOUND:
+            self.obs.emit("admission.level", -1, -1, LEVEL_NAMES[level])
+
+
+class AdmissionGate:
+    """Classify one message against the controller's level and decide
+    admit/shed. One gate per ingress point (a TcpNode, a replica);
+    gates share a controller, never dedup memory — duplicate detection
+    is a local property of what *this* ingress already saw.
+
+    ``height_fn`` supplies the consumer's current height so below-height
+    votes classify as stale (they would be dropped by the replica's
+    height filter anyway — shedding them earlier is behavior-neutral
+    and saves the decode/buffer work). ``peer`` attribution on
+    :meth:`admit` feeds per-peer fairness at SHED_LOW_PRIORITY; callers
+    without transport-level peer identity fall back to the sender.
+    """
+
+    def __init__(
+        self,
+        controller: BackpressureController,
+        *,
+        height_fn=None,
+        dedup_capacity: int = 65536,
+        fair_window: int = 1024,
+        fair_share: float = 0.5,
+        registry=None,
+        obs=None,
+        threadsafe: bool = False,
+    ):
+        self.controller = controller
+        self.height_fn = height_fn
+        self.dedup_capacity = int(dedup_capacity)
+        self.fair_window = max(1, int(fair_window))
+        self.fair_share = float(fair_share)
+        self.registry = registry
+        self.obs = obs if obs is not None else NULL_BOUND
+        self._lock = threading.Lock() if threadsafe else None
+        #: Insertion-ordered dedup memory: vote key -> None, FIFO-evicted
+        #: at ``dedup_capacity`` (a bounded bloom-like memory, exact
+        #: within the window).
+        self._mem: dict = {}
+        #: peer -> admitted count inside the current fairness window.
+        self._fair: dict = {}
+        self._fair_seen = 0
+        self.offered = 0
+        self.admitted = 0
+        #: shed-class name -> count. Only SHED_CLASSES names ever appear.
+        self.shed: dict = {}
+
+    # ------------------------------------------------------------- admit
+
+    def admit(self, msg, peer=None) -> bool:
+        lock = self._lock
+        if lock is None:
+            return self._admit(msg, peer)
+        with lock:
+            return self._admit(msg, peer)
+
+    def _admit(self, msg, peer) -> bool:
+        self.offered += 1
+        t = type(msg)
+        tag = _TAG.get(t)
+        # Never-shed invariant: proposals, and anything that is not one
+        # of the three vote types (certificates, resets, future message
+        # kinds), pass at every level. Aggregates outrank raw votes.
+        if tag is None or t is Propose:
+            self._admitted()
+            return True
+        level = self.controller.level
+        key = (tag, msg.sender, msg.height, msg.round, msg.value)
+        if level >= SHED_DUPLICATES:
+            if self.height_fn is not None and msg.height < self.height_fn():
+                return self._shed(msg, "stale_height")
+            if key in self._mem:
+                return self._shed(msg, "duplicate")
+        if t is Prevote:
+            if level >= CRITICAL_ONLY:
+                return self._shed(msg, "panic")
+            if level >= SHED_LOW_PRIORITY:
+                who = peer if peer is not None else msg.sender
+                budget = max(1, int(self.fair_share * self.fair_window))
+                if self._fair.get(who, 0) >= budget:
+                    return self._shed(msg, "low_priority")
+                self._fair_note(who)
+        self._remember(key)
+        self._admitted()
+        return True
+
+    # ---------------------------------------------------------- plumbing
+
+    def _remember(self, key) -> None:
+        mem = self._mem
+        if key not in mem:
+            mem[key] = None
+            if len(mem) > self.dedup_capacity:
+                mem.pop(next(iter(mem)))
+
+    def _fair_note(self, who) -> None:
+        self._fair_seen += 1
+        if self._fair_seen >= self.fair_window:
+            self._fair.clear()
+            self._fair_seen = 0
+        self._fair[who] = self._fair.get(who, 0) + 1
+
+    def _admitted(self) -> None:
+        self.admitted += 1
+        if self.registry is not None:
+            self.registry.count("admission.offered")
+            self.registry.count("admission.admitted")
+
+    def _shed(self, msg, cls: str) -> bool:
+        self.shed[cls] = self.shed.get(cls, 0) + 1
+        if self.registry is not None:
+            self.registry.count("admission.offered")
+            self.registry.count("admission.shed", label=cls)
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "admission.shed", msg.height, getattr(msg, "round", -1), cls
+            )
+        return False
+
+    def snapshot(self) -> dict:
+        """Counter view for soak assertions and the overload report."""
+        return {
+            "offered": self.offered,
+            "admitted": self.admitted,
+            "shed": dict(self.shed),
+            "level": self.controller.level,
+        }

@@ -52,6 +52,7 @@ class MessageQueue:
         "_heads",
         "_head_key",
         "obs",
+        "admission",
     )
 
     def __init__(self, max_capacity: int = DEFAULT_MAX_CAPACITY):
@@ -59,6 +60,11 @@ class MessageQueue:
         #: Flight-recorder handle (obs/recorder.py); the owning replica
         #: rebinds it. Only the overflow branch ever touches it.
         self.obs = NULL_BOUND
+        #: Optional AdmissionGate (load/backpressure.py). When set, every
+        #: insert consults it before buffering — under pressure the queue
+        #: sheds classified traffic instead of growing toward the far-
+        #: future capacity drop. None = admit everything (the default).
+        self.admission = None
         self._queues: dict[Signatory, list[Message]] = {}
         #: sender -> stable tiebreak index (queue-creation order).
         self._order: dict[Signatory, int] = {}
@@ -129,6 +135,8 @@ class MessageQueue:
         return o
 
     def _insert(self, msg: Message) -> None:
+        if self.admission is not None and not self.admission.admit(msg):
+            return
         q = self._queues.get(msg.sender)
         if q is None:
             q = self._queues[msg.sender] = []

@@ -3,7 +3,8 @@ benchdiff.
 
     record     run a short observed sim and save its event journal
     report     render the round-anatomy table from a saved journal
-               (``--tenants`` for per-origin device-launch latency)
+               (``--tenants`` for per-origin device-launch latency,
+               ``--overload`` for admission/shed posture)
     export     convert a saved journal to Perfetto/Chrome trace JSON
     metrics    run a short observed sim, print its metrics-registry
                snapshot (JSON; ``--prometheus FILE`` for exposition text)
@@ -26,7 +27,9 @@ import sys
 from hyperdrive_tpu.obs.recorder import load_journal
 from hyperdrive_tpu.obs.report import (
     anatomy,
+    overload_summary,
     phase_summary,
+    render_overload_table,
     render_table,
     render_tenant_table,
     tenant_summary,
@@ -64,6 +67,23 @@ def _cmd_record(ns):
 
 def _cmd_report(ns):
     journal = load_journal(ns.journal)
+    if ns.overload:
+        summary = overload_summary(journal["events"])
+        if ns.json:
+            print(json.dumps({"overload": summary}, indent=1))
+            return 0
+        if not (
+            summary["injected"]
+            or summary["shed_total"]
+            or summary["level_timeline"]
+            or summary["wire_shed"]
+            or summary["reconnects"]
+        ):
+            print("no load.*/admission.* events in journal window "
+                  "(record an overloaded run: Simulation(load=...))")
+            return 1
+        print(render_overload_table(summary))
+        return 0
     if ns.tenants:
         rows = tenant_summary(journal["events"])
         if ns.json:
@@ -195,6 +215,12 @@ def main(argv=None):
         "--tenants",
         action="store_true",
         help="per-origin device-launch latency summary instead",
+    )
+    rep.add_argument(
+        "--overload",
+        action="store_true",
+        help="overload/admission posture summary instead "
+             "(load.*, admission.*, wire.frame.* events)",
     )
     rep.set_defaults(fn=_cmd_report)
 

@@ -101,7 +101,15 @@ EVENT_KINDS = frozenset(
         "wire.frame.malformed",
         "wire.frame.oversize",
         "wire.frame.shed",
+        "wire.frame.stale",
         "transport.peer.dropped",
+        "transport.reconnect",
+        # Overload harness (load/): offered-load marks from the open-loop
+        # injector and the backpressure spine's admission decisions.
+        "load.offered",
+        "load.burst",
+        "admission.level",
+        "admission.shed",
         "chaos.partition",
         "chaos.heal",
         "chaos.crash",

@@ -22,6 +22,8 @@ __all__ = [
     "render_table",
     "tenant_summary",
     "render_tenant_table",
+    "overload_summary",
+    "render_overload_table",
 ]
 
 _TIMEOUT_FIRES = (
@@ -264,6 +266,122 @@ def render_tenant_table(rows):
         lines.append("  ".join(c.rjust(w) for c, w in zip(row, widths)))
         if i == 0:
             lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def overload_summary(events):
+    """Overload posture reconstructed from the journal alone.
+
+    Reads the ``load.*`` / ``admission.*`` events the open-loop harness
+    emits plus the transport's ``wire.frame.*`` / ``transport.*``
+    overload events, so a saved journal from an overloaded run is
+    diagnosable with no live registry: how much load was injected, what
+    the admission gates shed (by class), how the admission level moved
+    over the run (transition timeline + time spent at each level), and
+    what the wire path dropped on its own (per-peer backlog eviction,
+    stale-generation frames, reconnect storms).
+    """
+    out = {
+        "injected": 0,
+        "injection_points": 0,
+        "bursts": 0,
+        "shed": {},
+        "shed_total": 0,
+        "level_timeline": [],
+        "time_at_level": {},
+        "wire_shed": {},
+        "stale_frames": 0,
+        "reconnects": 0,
+        "reconnect_attempts": 0,
+    }
+    last_level = None  # (ts, name) of the level currently in force
+    t_last = None
+    for ev in events:
+        ts, kind, detail = ev[0], ev[4], ev[5]
+        t_last = ts
+        if kind == "load.offered":
+            out["injected"] += int(detail or 0)
+            out["injection_points"] += 1
+        elif kind == "load.burst":
+            out["bursts"] += 1
+        elif kind == "admission.shed":
+            cls = detail if isinstance(detail, str) else "?"
+            out["shed"][cls] = out["shed"].get(cls, 0) + 1
+            out["shed_total"] += 1
+        elif kind == "admission.level":
+            out["level_timeline"].append((ts, detail))
+            if last_level is not None:
+                t0, prev = last_level
+                out["time_at_level"][prev] = (
+                    out["time_at_level"].get(prev, 0.0) + (ts - t0)
+                )
+            last_level = (ts, detail)
+        elif kind == "wire.frame.shed":
+            cls = detail if isinstance(detail, str) else "backlog"
+            out["wire_shed"][cls] = out["wire_shed"].get(cls, 0) + 1
+        elif kind == "wire.frame.stale":
+            # The transport emits its cumulative per-node counter.
+            out["stale_frames"] = max(out["stale_frames"], int(detail or 0))
+        elif kind == "transport.reconnect":
+            out["reconnects"] += 1
+            out["reconnect_attempts"] += int(detail or 0)
+    if last_level is not None and t_last is not None:
+        t0, prev = last_level
+        out["time_at_level"][prev] = (
+            out["time_at_level"].get(prev, 0.0) + (t_last - t0)
+        )
+    return out
+
+
+def render_overload_table(summary):
+    """The overload summary as aligned text (the CLI's ``--overload``)."""
+    lines = [
+        f"injected {summary['injected']} "
+        f"over {summary['injection_points']} delivery points · "
+        f"amp-cap bursts {summary['bursts']}"
+    ]
+    shed = summary["shed"]
+    if shed:
+        total = summary["shed_total"]
+        rows = [["class", "shed", "share"]]
+        for cls in sorted(shed, key=shed.get, reverse=True):
+            rows.append([cls, str(shed[cls]), f"{shed[cls] / total:.0%}"])
+        widths = [max(len(r[i]) for r in rows) for i in range(3)]
+        for i, r in enumerate(rows):
+            lines.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+    else:
+        lines.append("admission shed nothing")
+    tl = summary["level_timeline"]
+    if tl:
+        lines.append(
+            "level timeline: "
+            + " -> ".join(f"{name}@{ts:.3f}" for ts, name in tl[:12])
+            + (f" (+{len(tl) - 12} more)" if len(tl) > 12 else "")
+        )
+        at = summary["time_at_level"]
+        lines.append(
+            "time at level: "
+            + " · ".join(f"{k} {v:.3f}s" for k, v in at.items())
+        )
+    wire = []
+    if summary["wire_shed"]:
+        wire.append(
+            "peer-queue shed "
+            + ", ".join(
+                f"{c}={n}" for c, n in sorted(summary["wire_shed"].items())
+            )
+        )
+    if summary["stale_frames"]:
+        wire.append(f"stale-generation frames {summary['stale_frames']}")
+    if summary["reconnects"]:
+        wire.append(
+            f"reconnects {summary['reconnects']} "
+            f"(total backoff attempts {summary['reconnect_attempts']})"
+        )
+    if wire:
+        lines.append("wire: " + " · ".join(wire))
     return "\n".join(lines)
 
 

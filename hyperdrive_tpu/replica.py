@@ -271,6 +271,12 @@ class Replica:
         self.retired: dict = {}
         #: Stale-generation votes rejected (epoch.stale_vote events).
         self.stale_votes = 0
+        #: Optional AdmissionGate (load/backpressure.py): consulted after
+        #: the height/retired filters in :meth:`_buffer_vote` and the
+        #: inlined :meth:`handle_burst` rule. Under pressure, classified
+        #: traffic (duplicates, over-share prevotes) sheds here before it
+        #: can buffer. None = admit everything.
+        self.admission = None
 
     # --------------------------------------------------------- observability
 
@@ -436,6 +442,7 @@ class Replica:
         cur = self.proc.current_height
         dh = self.did_handle_message
         retired = self.retired
+        adm = self.admission
         n_pv = n_pc = n_pp = 0
         for msg in msgs:
             t = type(msg)
@@ -455,6 +462,10 @@ class Replica:
                             dh()
                         continue
                 if h >= cur:
+                    if adm is not None and not adm.admit(msg):
+                        if dh is not None:
+                            dh()
+                        continue
                     if h == cur:
                         c = counts.get(msg.sender, 0)
                         if c < cap:
@@ -555,6 +566,8 @@ class Replica:
             if bad_from is not None and h >= bad_from:
                 self._note_stale(msg)
                 return
+        if self.admission is not None and not self.admission.admit(msg):
+            return
         if h == cur and self.opts.external_flush:
             c = self._lane_counts.get(msg.sender, 0)
             if c < self.opts.max_capacity:

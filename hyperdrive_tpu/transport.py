@@ -24,10 +24,10 @@ a framing error closes only that peer's connection).
 from __future__ import annotations
 
 import queue
+import random
 import socket
 import struct
 import threading
-import time
 
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.messages import (
@@ -43,6 +43,7 @@ __all__ = [
     "TcpBroadcaster",
     "TcpNode",
     "encode_frame",
+    "reconnect_schedule",
     "FlightRecorder",
     "replay_flight",
 ]
@@ -62,6 +63,32 @@ def encode_frame(msg) -> bytes:
     marshal_message(msg, w)
     payload = w.data()
     return _LEN.pack(len(payload)) + payload
+
+
+def reconnect_schedule(seed: int, key, *, base: float = 0.05,
+                       factor: float = 2.0, cap: float = 2.0,
+                       jitter: float = 0.5):
+    """Seeded exponential-backoff delays for one peer's dialer.
+
+    Yields connect-retry sleeps: ``base * factor**attempt`` capped at
+    ``cap``, then stretched by up to ``jitter`` (cap-before-jitter, the
+    :mod:`hyperdrive_tpu.timer` shaping convention — jitter widens the
+    spread instead of vanishing at the cap, so a mesh of nodes retrying
+    a rebooted peer never thundering-herds it). Deterministic per
+    ``(seed, key)``: the test suite asserts the exact schedule, and a
+    node re-creates the generator after each successful connect so
+    every outage replays the same bounded ramp.
+    """
+    # String seeding hashes through SHA-512 inside random.seed — stable
+    # across processes (tuple seeding is deprecated, and hash() of the
+    # host string is randomized per process).
+    rng = random.Random(f"reconnect:{seed}:{key!r}")
+    attempt = 0
+    while True:
+        delay = min(cap, base * (factor ** attempt))
+        yield delay * (1.0 + jitter * rng.random())
+        if delay < cap:
+            attempt += 1
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -86,7 +113,7 @@ class TcpNode:
     """
 
     def __init__(self, listen_port: int = 0, host: str = "127.0.0.1",
-                 obs=None):
+                 obs=None, admission=None, registry=None, seed: int = 0):
         from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
         self._host = host
@@ -95,6 +122,29 @@ class TcpNode:
         #: so callers must pass a handle bound to a threadsafe Recorder.
         self.obs = obs if obs is not None else NULL_BOUND
         self._obs_null = NULL_BOUND
+        #: Optional AdmissionGate (load/backpressure.py) applied to WIRE
+        #: ingress only: frames decoded off peer connections pass through
+        #: it before delivery, attributed to the sending peer for
+        #: fairness; a node's own broadcasts self-deliver ungated (a
+        #: replica never sheds its own votes). Build the gate with
+        #: ``threadsafe=True`` — read loops run one thread per peer.
+        self.admission = admission
+        #: Optional metrics Registry: shed/stale frames count here by
+        #: class so overload runs are diagnosable from exported metrics
+        #: alone (``wire.frame.shed`` labeled counter).
+        self.registry = registry
+        #: Seed for the per-peer reconnect backoff schedules.
+        self.seed = int(seed)
+        #: Wire-path epoch state (epochs.py key rotation): the current
+        #: table generation, verifiers to rotate on epoch switch, and
+        #: retired signatory -> first-stale-height bounds. Frames signed
+        #: under a retired generation are counted and dropped — never
+        #: fatal to the peer's connection (a laggard peer is lagging,
+        #: not hostile).
+        self.generation = 0
+        self.retired: dict = {}
+        self.stale_frames = 0
+        self._verifiers: list = []
         self._replicas: list = []
         #: peer key -> outbound frame queue, drained by a dedicated sender
         #: thread per peer — a dead or slow peer can never stall the
@@ -139,6 +189,34 @@ class TcpNode:
                 target=self._send_loop, args=(key, q), daemon=True
             )
         )
+
+    def register_wire_verifier(self, verifier) -> None:
+        """Attach a wire-path signature verifier (e.g.
+        :class:`~hyperdrive_tpu.ops.ed25519_wire.TpuWireVerifier`) whose
+        key table must follow this node's epoch switches."""
+        self._verifiers.append(verifier)
+
+    def rotate_epoch(self, generation: int, table=None,
+                     retired=None) -> None:
+        """Epoch handoff on the socket path: install the new pubkey
+        ``table`` (signatory -> key, or a verifier-native table) under
+        ``generation`` on every registered wire verifier, and extend the
+        retired-identity bounds so frames still signed under rotated-out
+        keys are counted (``wire.frame.stale``) and dropped rather than
+        failing verification mid-batch. Verifiers without
+        ``install_table`` (NullVerifier deployments) just follow the
+        generation number when they can."""
+        with self._lock:
+            self.generation = int(generation)
+            if retired:
+                self.retired.update(retired)
+        for v in self._verifiers:
+            if table is not None and hasattr(v, "install_table"):
+                v.install_table(table, generation)
+            elif hasattr(v, "set_generation"):
+                v.set_generation(generation)
+        if self.obs is not self._obs_null:
+            self.obs.emit("epoch.switch", -1, -1, generation)
 
     def start(self) -> None:
         for t in self._threads:
@@ -186,6 +264,10 @@ class TcpNode:
             t.start()
 
     def _read_loop(self, conn: socket.socket) -> None:
+        try:
+            peer = conn.getpeername()
+        except OSError:
+            peer = None
         with conn:
             while not self._stop.is_set():
                 try:
@@ -212,15 +294,37 @@ class TcpNode:
                     continue  # malformed envelope: drop the frame
                 if self._stop.is_set():
                     return
-                self._deliver(msg)
+                self._deliver(msg, peer=peer)
 
-    def _deliver(self, msg) -> None:
+    def _deliver(self, msg, peer=None, local: bool = False) -> None:
         # Timeouts are LOCAL, unauthenticated events (each replica's own
         # LinearTimer enqueues them directly); a Timeout arriving off the
         # wire is a forgery attempt — any peer could otherwise drive
         # honest replicas into premature round changes. Deliver only the
         # three signed consensus message types.
         t = type(msg)
+        if not local:
+            # Wire ingress only: a node's own broadcasts (local=True)
+            # bypass both checks — they are signed under the current
+            # generation by construction and must never shed.
+            if self.retired:
+                bad_from = self.retired.get(getattr(msg, "sender", None))
+                if bad_from is not None and msg.height >= bad_from:
+                    with self._lock:
+                        self.stale_frames += 1
+                        count = self.stale_frames
+                    if self.obs is not self._obs_null:
+                        self.obs.emit(
+                            "wire.frame.stale", msg.height,
+                            getattr(msg, "round", -1), count,
+                        )
+                    if self.registry is not None:
+                        self.registry.count("wire.frame.stale")
+                    return  # counted, never fatal to the connection
+            if self.admission is not None and not self.admission.admit(
+                msg, peer
+            ):
+                return
         for r in self._replicas:
             if t is Propose:
                 r.propose(msg, self._stop)
@@ -232,14 +336,22 @@ class TcpNode:
     # ------------------------------------------------------------- outbound
 
     def _send_loop(self, key, q: "queue.Queue") -> None:
-        """One peer's sender: connect (retrying with backoff — peers start
-        in any order and may crash), then drain the frame queue. A dead
-        peer costs nothing to anyone else: broadcasts just enqueue."""
+        """One peer's sender: connect (retrying on a seeded exponential
+        backoff with jitter — peers start in any order and may crash),
+        then drain the frame queue. A dead peer costs nothing to anyone
+        else: broadcasts just enqueue. The backoff schedule is
+        deterministic per ``(seed, peer)`` (:func:`reconnect_schedule`)
+        and resets after every successful connect, so a flapping peer
+        pays the bounded ramp each outage instead of spinning at the
+        old flat 100ms."""
         sock: socket.socket | None = None
+        sched = reconnect_schedule(self.seed, key)
+        attempts = 0
         while not self._stop.is_set():
-            frame = q.get()
-            if frame is None or self._stop.is_set():
+            item = q.get()
+            if item is None or self._stop.is_set():
                 break
+            frame = item[1]
             while not self._stop.is_set():
                 if sock is None:
                     try:
@@ -248,8 +360,20 @@ class TcpNode:
                             socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
                         )
                     except OSError:
-                        time.sleep(0.1)
+                        attempts += 1
+                        if self._stop.wait(next(sched)):
+                            break
                         continue
+                    if attempts:
+                        # Peer came (back) up after a retry ramp.
+                        if self.obs is not self._obs_null:
+                            self.obs.emit(
+                                "transport.reconnect", -1, -1, attempts
+                            )
+                        if self.registry is not None:
+                            self.registry.count("transport.reconnect")
+                        sched = reconnect_schedule(self.seed, key)
+                        attempts = 0
                 try:
                     sock.sendall(frame)
                     break
@@ -267,34 +391,74 @@ class TcpNode:
 
     def broadcast(self, msg) -> None:
         """Fan out to all: local replicas directly, remote peers via their
-        sender queues (never blocks on a slow or dead peer; a full queue
-        drops the oldest frame — see _PEER_QUEUE)."""
-        self._deliver(msg)
+        sender queues (never blocks on a slow or dead peer). A full peer
+        queue sheds priority-aware: under admission pressure (level >=
+        SHED_LOW_PRIORITY) a new *prevote* frame is itself dropped —
+        backlogged proposals and precommits are worth more than a fresh
+        prevote — otherwise the oldest frame is evicted, exactly the old
+        best-effort behavior. Every shed counts per peer
+        (``dropped_frames``), per class in the Registry
+        (``wire.frame.shed``), and emits the obs pair."""
+        self._deliver(msg, local=True)
         frame = encode_frame(msg)
+        # Frames queue with the class they would shed under: prevotes are
+        # the low-priority tier; everything else only ever sheds as
+        # best-effort backlog eviction.
+        cls = "low_priority" if type(msg) is Prevote else "backlog"
+        level = 0
+        ctrl = self.admission.controller if self.admission is not None \
+            else None
+        if ctrl is not None:
+            level = ctrl.level
+        worst = 0.0
         for key, q in self._peer_queues.items():
+            if level >= 2 and cls == "low_priority":
+                # SHED_LOW_PRIORITY or worse: a full queue drops the new
+                # prevote instead of evicting older (higher-value) frames.
+                try:
+                    q.put_nowait((cls, frame))
+                except queue.Full:
+                    self._count_shed(key, cls)
+                if ctrl is not None:
+                    occ = q.qsize() / _PEER_QUEUE
+                    if occ > worst:
+                        worst = occ
+                continue
             while True:
                 try:
-                    q.put_nowait(frame)
+                    q.put_nowait((cls, frame))
                     break
                 except queue.Full:
                     try:
-                        q.get_nowait()  # shed the oldest frame
+                        old = q.get_nowait()  # shed the oldest frame
                     except queue.Empty:
                         continue
-                    with self._lock:
-                        count = self.dropped_frames.get(key, 0) + 1
-                        self.dropped_frames[key] = count
-                    if count == 1:
-                        self._log.warning(
-                            "peer backlog overflow %s",
-                            _kv(peer=f"{key[0]}:{key[1]}",
-                                capacity=_PEER_QUEUE),
-                        )
-                    if self.obs is not self._obs_null:
-                        self.obs.emit("wire.frame.shed", -1, -1)
-                        self.obs.emit(
-                            "transport.peer.dropped", -1, -1, count
-                        )
+                    self._count_shed(
+                        key, old[0] if old is not None else "backlog"
+                    )
+            if ctrl is not None:
+                occ = q.qsize() / _PEER_QUEUE
+                if occ > worst:
+                    worst = occ
+        if ctrl is not None:
+            ctrl.note_peer_occupancy(worst)
+
+    def _count_shed(self, key, cls: str) -> None:
+        """Account one shed outbound frame: per-peer counter, labeled
+        Registry counter, WARNING on the peer's first drop, obs pair."""
+        with self._lock:
+            count = self.dropped_frames.get(key, 0) + 1
+            self.dropped_frames[key] = count
+        if count == 1:
+            self._log.warning(
+                "peer backlog overflow %s",
+                _kv(peer=f"{key[0]}:{key[1]}", capacity=_PEER_QUEUE),
+            )
+        if self.registry is not None:
+            self.registry.count("wire.frame.shed", label=cls)
+        if self.obs is not self._obs_null:
+            self.obs.emit("wire.frame.shed", -1, -1, cls)
+            self.obs.emit("transport.peer.dropped", -1, -1, count)
 
 
 class FlightRecorder:

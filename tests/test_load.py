@@ -1,0 +1,254 @@
+"""Open-loop overload harness: schedules, backpressure, admission.
+
+The load package (hyperdrive_tpu/load/) is the robustness PR's spine:
+seeded arrival schedules, the BackpressureController fusing pipeline
+signals into one admission level, the AdmissionGate's shed-class
+doctrine (ROBUSTNESS.md "Overload doctrine"), and the sim-side
+injector whose storms must never bend the committed chain.
+"""
+
+import dataclasses
+
+from hyperdrive_tpu.devsched import DeviceWorkQueue, NullVerifyLauncher
+from hyperdrive_tpu.load import (
+    ACCEPT,
+    CRITICAL_ONLY,
+    SHED_DUPLICATES,
+    SHED_LOW_PRIORITY,
+    AdmissionGate,
+    BackpressureController,
+    BurstSchedule,
+    LoadProfile,
+    PoissonSchedule,
+)
+from hyperdrive_tpu.load.generator import LoadRuntime
+from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+
+
+def _pv(sender=b"\x01", height=5, round_=0, value=b"\x07"):
+    return Prevote(
+        height=height, round=round_, value=value * 32, sender=sender * 32
+    )
+
+
+def _pinned(level):
+    ctrl = BackpressureController()
+    ctrl.floor = level
+    ctrl.poll()
+    return ctrl
+
+
+# ---------------------------------------------------------------- schedules
+
+
+def test_poisson_schedule_is_seeded_and_ascending():
+    a = PoissonSchedule(2000.0, seed=9).arrivals(0.5)
+    b = PoissonSchedule(2000.0, seed=9).arrivals(0.5)
+    c = PoissonSchedule(2000.0, seed=10).arrivals(0.5)
+    assert a == b and a != c
+    assert all(0.0 <= t < 0.5 for t in a)
+    assert a == sorted(a)
+    # Poisson at rate R over horizon H offers ~R*H arrivals.
+    assert 700 <= len(a) <= 1300
+
+
+def test_burst_schedule_clumps_arrivals():
+    sched = BurstSchedule(3200.0, burst=32, seed=4)
+    arrivals = sched.arrivals(0.25)
+    assert arrivals == BurstSchedule(3200.0, burst=32, seed=4).arrivals(0.25)
+    # Periodic spikes: every arrival shares its timestamp with its whole
+    # burst, so the set of distinct times is len/burst.
+    assert len(arrivals) % 32 == 0
+    assert len(set(arrivals)) == len(arrivals) // 32
+
+
+def test_load_runtime_caps_and_carries_excess():
+    rt = LoadRuntime(LoadProfile(rate=1000.0, seed=3, amp_cap=16))
+    # A big clock jump makes ~1000 arrivals due; each call hands out at
+    # most amp_cap and the rest stays due — offered load is never
+    # silently discarded.
+    first = rt.due(1.0)
+    assert first == 16
+    total = first
+    while True:
+        k = rt.due(1.0)
+        if not k:
+            break
+        assert k <= 16
+        total += k
+    assert total == rt.offered
+    assert 800 <= total <= 1200
+    # Past the window's stop nothing is due.
+    rt2 = LoadRuntime(LoadProfile(rate=1000.0, seed=3, stop=0.5))
+    assert rt2.due(0.75) == 0
+
+
+# --------------------------------------------------------------- controller
+
+
+def test_controller_escalates_on_depth_and_deescalates_with_hysteresis():
+    ctrl = BackpressureController(hysteresis=3)
+    assert ctrl.level == ACCEPT
+    ctrl.note_depth(8)
+    assert ctrl.level == SHED_DUPLICATES
+    ctrl.note_depth(300)
+    assert ctrl.level == CRITICAL_ONLY
+    # Pressure gone: the level holds for hysteresis-1 clean polls, then
+    # steps down (no flapping around a threshold).
+    ctrl.note_depth(0)
+    assert ctrl.level == CRITICAL_ONLY
+    ctrl.poll()
+    assert ctrl.level == CRITICAL_ONLY
+    ctrl.poll()
+    assert ctrl.level == ACCEPT
+    assert ctrl.transitions == 3
+
+
+def test_controller_floor_pins_level():
+    ctrl = _pinned(SHED_DUPLICATES)
+    assert ctrl.level == SHED_DUPLICATES
+    for _ in range(10):
+        ctrl.poll()
+    assert ctrl.level == SHED_DUPLICATES  # never de-escalates below floor
+    ctrl.note_peer_occupancy(0.95)
+    assert ctrl.level == CRITICAL_ONLY  # but raw signals escalate above
+
+
+def test_device_queue_feeds_controller_signals():
+    queue = DeviceWorkQueue(max_depth=64)
+    ctrl = BackpressureController(hysteresis=1)
+    ctrl.watch(queue)
+    launcher = NullVerifyLauncher()
+    for _ in range(8):
+        queue.submit(launcher, [b"x"])
+    assert ctrl.level == SHED_DUPLICATES
+    queue.drain()
+    ctrl.poll()
+    assert ctrl.level == ACCEPT  # drain resets depth; hysteresis=1
+
+
+# --------------------------------------------------------------------- gate
+
+
+def test_gate_sheds_duplicates_and_stale_heights():
+    gate = AdmissionGate(_pinned(SHED_DUPLICATES), height_fn=lambda: 5)
+    pv = _pv()
+    assert gate.admit(pv)
+    assert not gate.admit(pv)  # exact duplicate
+    assert not gate.admit(_pv(height=3))  # below the consumer's height
+    assert gate.admit(_pv(value=b"\x08"))  # fresh vote still flows
+    assert gate.shed == {"duplicate": 1, "stale_height": 1}
+
+
+def test_gate_never_sheds_proposals_or_unknown_types():
+    gate = AdmissionGate(_pinned(CRITICAL_ONLY), height_fn=lambda: 5)
+    pp = Propose(
+        height=5, round=0, valid_round=-1, value=b"\x07" * 32,
+        sender=b"\x01" * 32, payload=b"",
+    )
+    assert gate.admit(pp)
+    assert gate.admit(pp)  # even a duplicate proposal is never shed
+    assert gate.admit(object())  # certificates/unknown kinds outrank votes
+    pc = Precommit(
+        height=5, round=0, value=b"\x07" * 32, sender=b"\x01" * 32
+    )
+    assert gate.admit(pc)  # precommits are quorum-forming: never panic-shed
+    assert not gate.admit(_pv())  # fresh prevote sheds at CRITICAL_ONLY
+    assert gate.shed == {"panic": 1}
+
+
+def test_gate_per_peer_fairness_budget():
+    gate = AdmissionGate(
+        _pinned(SHED_LOW_PRIORITY), fair_window=8, fair_share=0.25
+    )
+    hog, meek = ("10.0.0.1", 1), ("10.0.0.2", 2)
+    admitted_hog = sum(
+        gate.admit(_pv(value=bytes([i])), peer=hog) for i in range(6)
+    )
+    assert admitted_hog == 2  # budget = fair_share * fair_window
+    assert gate.shed["low_priority"] == 4
+    # The budget is per peer: another peer's fresh votes still flow.
+    assert gate.admit(_pv(sender=b"\x02", value=bytes([99])), peer=meek)
+
+
+def test_gate_accounting_identity():
+    gate = AdmissionGate(_pinned(SHED_DUPLICATES), height_fn=lambda: 5)
+    pv = _pv()
+    for msg in (pv, pv, _pv(height=1), _pv(value=b"\x09")):
+        gate.admit(msg)
+    snap = gate.snapshot()
+    assert snap["offered"] == snap["admitted"] + sum(snap["shed"].values())
+
+
+# ---------------------------------------------------------------- sim storm
+
+
+def test_loaded_sim_commits_identical_chain():
+    from hyperdrive_tpu.harness.sim import Simulation
+
+    def run(load):
+        extra = {} if load is None else {"load": load}
+        return Simulation(
+            n=4, target_height=4, seed=17, timeout=1.0,
+            delivery_cost=1e-3, **extra,
+        )
+
+    base = run(None).run()
+    loaded_sim = run(LoadProfile(rate=4000.0, seed=17))
+    loaded = loaded_sim.run()
+    assert loaded.commit_digest() == base.commit_digest()
+    snap = loaded_sim.overload_snapshot()
+    assert snap["injected"] > 0
+    # Only vote duplicates at un-advanced heights are guaranteed prey
+    # (a burst landing on a proposal delivery is admitted by doctrine).
+    assert 0 < snap["injected_sheddable"] <= snap["injected"]
+    assert snap["shed"], "sheddable storm injected but nothing shed"
+    assert set(snap["shed"]) <= {"duplicate", "stale_height"}
+    assert snap["offered"] == snap["admitted"] + sum(snap["shed"].values())
+
+
+def test_overload_profile_family_is_behavior_neutral():
+    from hyperdrive_tpu.chaos.plan import FaultPlan
+
+    plan, profile = FaultPlan.overload(77, 4)
+    assert plan == FaultPlan.seeded(77, 4)
+    assert profile.pin and profile.floor <= SHED_DUPLICATES
+    # Same seed, same storm (the soak's reproducibility contract).
+    _, again = FaultPlan.overload(77, 4)
+    assert profile == again
+
+
+def test_profile_seeded_rejects_trajectory_changing_floor():
+    import pytest
+
+    with pytest.raises(ValueError):
+        LoadProfile(rate=0.0).validate()
+    with pytest.raises(ValueError):
+        LoadProfile(rate=100.0, burst=0).validate()
+    with pytest.raises(ValueError):
+        LoadProfile(rate=100.0, start=2.0, stop=1.0).validate()
+
+
+def test_escalating_profile_keeps_safety():
+    # pin=False couples the controller to the device queue; the chain
+    # may reshape (prevotes become sheddable) but never forks.
+    from hyperdrive_tpu.devsched import DeviceWorkQueue, QueueFlusher
+    from hyperdrive_tpu.harness.sim import Simulation
+    from hyperdrive_tpu.verifier import NullVerifier
+
+    queue = DeviceWorkQueue(max_depth=96)
+    sim = Simulation(
+        n=4, target_height=3, seed=29, timeout=1.0, delivery_cost=1e-3,
+        devsched=queue,
+        flusher_for=lambda i, validators: QueueFlusher(
+            NullVerifier(), queue
+        ),
+        load=dataclasses.replace(
+            LoadProfile(rate=6000.0, seed=29), pin=False
+        ),
+    )
+    res = sim.run()
+    res.assert_safety()
+    assert res.completed
+    snap = sim.overload_snapshot()
+    assert snap["offered"] == snap["admitted"] + sum(snap["shed"].values())

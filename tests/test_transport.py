@@ -430,3 +430,259 @@ def test_peer_backlog_overflow_counts_drops(caplog):
         assert f"127.0.0.1:{dead_port}" in overflow_logs[0].getMessage()
     finally:
         node.stop()
+
+
+def test_reconnect_schedule_is_deterministic_and_capped():
+    # ISSUE 11 satellite: the dialer's backoff is seeded per
+    # (seed, peer) — same pair, same exact ramp; different peer,
+    # different jitter. Cap-before-jitter: the base delay saturates at
+    # cap but the jittered spread never collapses to a fixed point.
+    from itertools import islice
+
+    from hyperdrive_tpu.transport import reconnect_schedule
+
+    key = ("127.0.0.1", 4242)
+    a = list(islice(reconnect_schedule(7, key), 8))
+    b = list(islice(reconnect_schedule(7, key), 8))
+    c = list(islice(reconnect_schedule(7, ("127.0.0.1", 4243)), 8))
+    assert a == b
+    assert a != c
+    base, factor, cap, jitter = 0.05, 2.0, 2.0, 0.5
+    for i, d in enumerate(a):
+        lo = min(cap, base * factor ** min(i, 6))
+        assert lo <= d <= lo * (1.0 + jitter)
+    # Saturated: every post-cap delay stays in [cap, cap*(1+jitter)].
+    assert all(cap <= d <= cap * (1.0 + jitter) for d in a[6:])
+
+
+def test_sender_reconnects_with_backoff_and_emits_event():
+    # Peer is down at first broadcast; the sender retries on the seeded
+    # ramp, and when the peer comes up the frame arrives and the node
+    # emits transport.reconnect with the attempt count.
+    import time
+
+    from hyperdrive_tpu.obs.recorder import Recorder
+
+    rec = Recorder(threadsafe=True)
+    node = TcpNode(obs=rec.scoped(-1), seed=3)
+    (port,) = _free_ports(1)
+    node.add_peer("127.0.0.1", port)
+    node.start()
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        pv = Prevote(
+            height=1, round=0, value=b"\x05" * 32, sender=b"\x01" * 32
+        )
+        node.broadcast(pv)  # peer still down: dialer enters the ramp
+        time.sleep(0.15)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(1)
+        srv.settimeout(10.0)
+        conn, _ = srv.accept()
+        conn.settimeout(10.0)
+        frame = encode_frame(pv)
+        got = b""
+        while len(got) < len(frame):
+            got += conn.recv(len(frame) - len(got))
+        assert got == frame
+        conn.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            attempts = [
+                e.detail for e in rec.snapshot()
+                if e.kind == "transport.reconnect"
+            ]
+            if attempts:
+                break
+            time.sleep(0.02)
+        assert attempts and attempts[0] >= 1
+    finally:
+        node.stop()
+        srv.close()
+
+
+def test_rotate_epoch_installs_tables_and_counts_stale_frames():
+    # ISSUE 11 satellite: epoch handoff on the socket path. rotate_epoch
+    # pushes the new table/generation to registered wire verifiers, and
+    # frames from retired signatories at/after their retirement height
+    # are counted (wire.frame.stale) and dropped — never fatal.
+    from hyperdrive_tpu.obs.recorder import Recorder
+
+    class FakeVerifier:
+        def __init__(self):
+            self.installed = None
+
+        def install_table(self, table, generation):
+            self.installed = (table, generation)
+
+    class Sink:
+        def __init__(self):
+            self.prevotes = []
+
+        def propose(self, msg, stop):
+            pass
+
+        def prevote(self, msg, stop):
+            self.prevotes.append(msg)
+
+        def precommit(self, msg, stop):
+            pass
+
+    rec = Recorder(threadsafe=True)
+    node = TcpNode(obs=rec.scoped(-1))
+    verifier = FakeVerifier()
+    sink = Sink()
+    node.register_wire_verifier(verifier)
+    node.add_replica(sink)
+    retired_key = b"\x0a" * 32
+    try:
+        node.rotate_epoch(2, table={b"\x0b" * 32: b"pk"},
+                          retired={retired_key: 5})
+        assert node.generation == 2
+        assert verifier.installed == ({b"\x0b" * 32: b"pk"}, 2)
+        # Retired sender at its first stale height: dropped, counted.
+        stale = Prevote(
+            height=5, round=0, value=b"\x07" * 32, sender=retired_key
+        )
+        node._deliver(stale, peer=("127.0.0.1", 9))
+        assert node.stale_frames == 1 and sink.prevotes == []
+        # The same identity BELOW the bound is still valid history.
+        old = Prevote(
+            height=4, round=0, value=b"\x07" * 32, sender=retired_key
+        )
+        node._deliver(old, peer=("127.0.0.1", 9))
+        assert len(sink.prevotes) == 1
+        kinds = [e.kind for e in rec.snapshot()]
+        assert kinds.count("epoch.switch") == 1
+        assert kinds.count("wire.frame.stale") == 1
+    finally:
+        node.stop()
+
+
+def test_wire_admission_gates_ingress_but_not_own_broadcasts():
+    # The admission gate applies to wire ingress only: a duplicated
+    # inbound prevote sheds, while the node's own broadcast of the same
+    # message always self-delivers.
+    from hyperdrive_tpu.load import AdmissionGate, BackpressureController
+    from hyperdrive_tpu.load.backpressure import SHED_DUPLICATES
+
+    class Sink:
+        def __init__(self):
+            self.prevotes = []
+
+        def propose(self, msg, stop):
+            pass
+
+        def prevote(self, msg, stop):
+            self.prevotes.append(msg)
+
+        def precommit(self, msg, stop):
+            pass
+
+    ctrl = BackpressureController(threadsafe=True)
+    ctrl.floor = SHED_DUPLICATES
+    ctrl.poll()
+    gate = AdmissionGate(ctrl, threadsafe=True)
+    node = TcpNode(admission=gate)
+    sink = Sink()
+    node.add_replica(sink)
+    try:
+        pv = Prevote(
+            height=1, round=0, value=b"\x05" * 32, sender=b"\x01" * 32
+        )
+        peer = ("127.0.0.1", 7)
+        node._deliver(pv, peer=peer)
+        node._deliver(pv, peer=peer)
+        assert len(sink.prevotes) == 1
+        assert gate.shed == {"duplicate": 1}
+        node.broadcast(pv)  # local=True path: never gated
+        assert len(sink.prevotes) == 2
+    finally:
+        node.stop()
+
+
+def test_backlog_overflow_sheds_new_prevotes_under_pressure():
+    # Priority-aware outbound shedding: at SHED_LOW_PRIORITY a full
+    # peer queue drops the NEW prevote frame (keeping the backlog's
+    # older, higher-value frames) and counts it by class in the
+    # Registry; without pressure the old evict-oldest behavior holds
+    # (test_peer_backlog_overflow_counts_drops).
+    from hyperdrive_tpu.load import AdmissionGate, BackpressureController
+    from hyperdrive_tpu.load.backpressure import SHED_LOW_PRIORITY
+    from hyperdrive_tpu.obs.metrics import Registry
+    from hyperdrive_tpu.transport import _PEER_QUEUE
+
+    registry = Registry()
+    ctrl = BackpressureController(threadsafe=True)
+    ctrl.floor = SHED_LOW_PRIORITY
+    ctrl.poll()
+    gate = AdmissionGate(ctrl, threadsafe=True)
+    node = TcpNode(admission=gate, registry=registry)
+    (dead_port,) = _free_ports(1)
+    try:
+        node.add_peer("127.0.0.1", dead_port)
+        pv = Prevote(
+            height=1, round=0, value=b"\x05" * 32, sender=b"\x01" * 32
+        )
+        for _ in range(_PEER_QUEUE + 4):
+            node.broadcast(pv)
+        key = ("127.0.0.1", dead_port)
+        assert node.dropped_frames == {key: 4}
+        shed = registry.counters["wire.frame.shed"]
+        assert shed["low_priority"].value == 4
+        # The queue still holds the OLDEST frames (nothing evicted).
+        assert node._peer_queues[key].qsize() == _PEER_QUEUE
+    finally:
+        node.stop()
+
+
+def test_chaos_proxy_bandwidth_throttle_pays_serialization_delay():
+    # The overload family's slow-peer fault: every frame through a
+    # throttled proxy pays size*8/bandwidth seconds, FIFO.
+    import threading
+    import time
+
+    from hyperdrive_tpu.chaos.proxy import ChaosProxy
+
+    srv = socket.socket()
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    target_port = srv.getsockname()[1]
+    received = []
+    done = threading.Event()
+
+    pv = Prevote(height=1, round=0, value=b"\x05" * 32, sender=b"\x01" * 32)
+    frame = encode_frame(pv)
+
+    def read_side():
+        conn, _ = srv.accept()
+        with conn:
+            got = b""
+            while len(got) < 3 * len(frame):
+                chunk = conn.recv(4096)
+                if not chunk:
+                    break
+                got += chunk
+            received.append(got)
+            done.set()
+
+    reader = threading.Thread(target=read_side, daemon=True)
+    reader.start()
+    bps = len(frame) * 8.0 * 20  # ~50 ms per frame
+    with ChaosProxy(
+        "127.0.0.1", target_port, bandwidth_bps=bps
+    ) as proxy:
+        t0 = time.monotonic()
+        with socket.create_connection(("127.0.0.1", proxy.port)) as s:
+            for _ in range(3):
+                s.sendall(frame)
+            assert done.wait(10.0)
+        elapsed = time.monotonic() - t0
+        assert received[0] == frame * 3
+        assert proxy.forwarded == 3
+        expected = 3 * len(frame) * 8.0 / bps
+        assert abs(proxy.throttled_s - expected) < 1e-9
+        assert elapsed >= expected * 0.9  # the sleep actually happened
+    srv.close()
