@@ -5,7 +5,7 @@ Usage::
     python -m hyperdrive_tpu.chaos soak [--scenarios N] [--seed S]
         [--n N_REPLICAS] [--target H] [--out DIR] [--replay-every K]
         [--pipelined-every K] [--certs-every K] [--churn-every K]
-        [--overload-every K] [--dump-ok DIR]
+        [--overload-every K] [--overlay-every K] [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -109,6 +109,30 @@ def _build_churn(scen_seed: int, n: int, target: int):
     return plan, sim
 
 
+def _build_overlay(scen_seed: int, n: int, target: int):
+    """An aggregation-overlay scenario: the full tree-slicing fault
+    family (partition cutting a level block, Byzantine contributors
+    withholding/garbling frames, an interior-node crash-restore) on top
+    of the overlay dissemination path. The monitor's overlay invariants
+    are armed: commit safety, no honest peer still demoted at run end,
+    and never-starve (exhausted windows must have engaged the ranked
+    fallback)."""
+    from hyperdrive_tpu.overlay import OverlayConfig
+
+    plan, faults = FaultPlan.overlay(scen_seed, n)
+    sim = Simulation(
+        n=n,
+        target_height=target,
+        seed=scen_seed,
+        timeout=1.0,
+        delivery_cost=1e-3,
+        chaos=plan,
+        observe=True,
+        overlay=OverlayConfig(faults=faults),
+    )
+    return plan, faults, sim
+
+
 def _dump_failure(out: str, scen_seed: int, sim, err) -> str:
     os.makedirs(out, exist_ok=True)
     base = os.path.join(out, f"chaos_seed_{scen_seed}")
@@ -124,6 +148,7 @@ def soak(args) -> int:
     rng = random.Random(args.seed)
     failures = 0
     churn_dumped = False
+    overlay_dumped = False
     for k in range(args.scenarios):
         scen_seed = args.seed + k * _SEED_STRIDE
         n = args.n if args.n else rng.choice([4, 7])
@@ -298,6 +323,86 @@ def soak(args) -> int:
                 zsim.record.dump(okbase)
                 churn_dumped = True
                 print(f"  dumped passing churn record: {okbase}")
+        if args.overlay_every and k % args.overlay_every == 0:
+            # Every Kth scenario additionally runs the aggregation-
+            # overlay fault family (ISSUE 12): tree-slicing partition +
+            # Byzantine contributors + interior crash on the overlay
+            # dissemination path, with the monitor's overlay invariants
+            # armed and a record-replay determinism self-check (overlay
+            # records hold plain per-message deliveries, so they replay
+            # with no overlay wiring at all). A second, fault-free pair
+            # checks DIGEST NEUTRALITY: the same seed through a clean
+            # overlay must commit the byte-identical chain the
+            # all-to-all baseline commits — aggregation changes the
+            # transport, never the agreed values.
+            on = args.n if args.n else 8
+            yplan, yfaults, ysim = _build_overlay(
+                scen_seed, on, args.target
+            )
+            ymon = InvariantMonitor(ysim)
+            try:
+                yresult = ysim.run(max_steps=args.max_steps)
+                ymon.check_final(yresult)
+                yreplayed = Simulation.replay(ysim.record)
+                if yreplayed.commits != yresult.commits:
+                    raise InvariantViolation(
+                        "replay",
+                        "overlay replay diverges from live run",
+                    )
+                from hyperdrive_tpu.overlay import OverlayConfig
+
+                bsim = Simulation(
+                    n=on, target_height=args.target, seed=scen_seed,
+                    timeout=1.0, delivery_cost=1e-3,
+                )
+                bresult = bsim.run(max_steps=args.max_steps)
+                # Clean overlay, no faults: Byzantine withholding can
+                # legitimately push a height into an extra round (the
+                # fallback costs virtual time), so chain equality is
+                # only an invariant of the aggregation mechanism
+                # itself, not of adversarial timing. The faulted leg
+                # above is held to the monitor's fork/digest checks.
+                vsim = Simulation(
+                    n=on, target_height=args.target, seed=scen_seed,
+                    timeout=1.0, delivery_cost=1e-3,
+                    overlay=OverlayConfig(),
+                )
+                vresult = vsim.run(max_steps=args.max_steps)
+                if (vresult.commit_digest(up_to=args.target)
+                        != bresult.commit_digest(up_to=args.target)):
+                    raise InvariantViolation(
+                        "overlay",
+                        "overlay chain diverges from all-to-all baseline",
+                    )
+            except (InvariantViolation, AssertionError) as err:
+                failures += 1
+                base = _dump_failure(args.out, scen_seed, ysim, err)
+                print(
+                    f"FAIL overlay seed={scen_seed} n={on} {err}\n"
+                    f"  dumped {base}.bin (+ journal, checkpoints)\n"
+                    f"  reproduce: python -m hyperdrive_tpu.chaos "
+                    f"replay {base}.bin",
+                    file=sys.stderr,
+                )
+                if not args.keep_going:
+                    return 1
+                continue
+            ysnap = ysim.overlay_snapshot()
+            print(
+                f"ok overlay seed={scen_seed} n={on} "
+                f"frames={ysnap['frames']} "
+                f"fallbacks={ysnap['fallback_engaged']} "
+                f"demoted={ysnap['scores']['demoted']} "
+                f"byz={ysnap['byzantine']} neutrality=ok"
+            )
+            if args.dump_ok and not overlay_dumped:
+                os.makedirs(args.dump_ok, exist_ok=True)
+                okbase = os.path.join(
+                    args.dump_ok, f"overlay_seed_{scen_seed}.bin"
+                )
+                ysim.record.dump(okbase)
+                overlay_dumped = True
+                print(f"  dumped passing overlay record: {okbase}")
     if failures:
         print(f"soak FAILED: {failures}/{args.scenarios}", file=sys.stderr)
         return 1
@@ -389,6 +494,15 @@ def main(argv=None) -> int:
         default=0,
         help="additionally run every Kth seed as an epoch-churn scenario "
         "(dynamic validator set + key rotation under chaos; 0 = off)",
+    )
+    p.add_argument(
+        "--overlay-every",
+        type=int,
+        default=0,
+        help="additionally run every Kth seed as an aggregation-overlay "
+        "scenario (tree-slicing partition + Byzantine contributors on "
+        "the overlay path, plus a digest-neutrality cross-check against "
+        "the all-to-all baseline; 0 = off)",
     )
     p.add_argument(
         "--dump-ok",

@@ -192,6 +192,7 @@ class InvariantMonitor:
                 f"run stalled below target; heights={result.heights}",
             )
         self._check_epochs()
+        self._check_overlay()
         return self
 
     def _check_epochs(self) -> None:
@@ -253,6 +254,54 @@ class InvariantMonitor:
             )
         except EpochChainError as exc:
             raise InvariantViolation("epoch-chain", str(exc)) from exc
+
+    def _check_overlay(self) -> None:
+        """Aggregation-overlay invariants (overlay runs only):
+
+        - **no honest peer permanently demoted** — contribution scoring
+          may transiently demote an honest peer caught behind a
+          partition or mid-restore (its frames look withheld from the
+          far side), and per-commit rehabilitation restores it once the
+          charges stop. PERMANENT means the commit floor advanced far
+          enough past the peer's last charge that rehabilitation must
+          have lifted it back over ``demote_at`` — and it didn't. A
+          still-demoted honest peer whose last charge was too recent
+          for the available runway is tolerated: the scenario ended,
+          not the recovery mechanism.
+        - **never-starve** — if any level window ever expired with
+          coverage still missing, the ranked direct-gossip fallback
+          must have engaged: timeouts without fallback means the
+          escalation ladder dead-ends and slow peers starve silently.
+        """
+        ov = getattr(self.sim, "_overlay", None)
+        if ov is None:
+            return
+        heal = ov.config.heal_rate
+        permanent = []
+        for p in ov.honest_demoted():
+            if p not in self.honest:
+                continue
+            deficit = ov.scores.demote_at - ov.scores.scores[p] + 1
+            runway = ov._floor - ov._last_charge_floor.get(p, ov._floor)
+            if heal and runway * heal < deficit:
+                continue
+            permanent.append(p)
+        if permanent:
+            raise InvariantViolation(
+                "overlay-demotion",
+                f"honest peers {permanent} permanently demoted (scores "
+                f"{[ov.scores.scores[p] for p in permanent]}, floor "
+                f"{ov._floor}, byzantine={sorted(ov._byz)}) — "
+                f"rehabilitation had the runway and did not recover them",
+            )
+        exhausted = getattr(ov, "windows_exhausted", 0)
+        if exhausted and not ov.fallback_engaged:
+            raise InvariantViolation(
+                "overlay-starvation",
+                f"{exhausted} level windows exhausted all "
+                f"{ov.config.max_waves} waves with coverage missing but "
+                "the ranked fallback never engaged",
+            )
 
     def _check_journal(self) -> None:
         """Cross-check the obs flight recorder against the chain: every

@@ -320,3 +320,85 @@ class FaultPlan:
         )
         plan.validate(n)
         return plan
+
+    @classmethod
+    def overlay(
+        cls,
+        seed: int,
+        n: int,
+        *,
+        est_virtual_time: float = 4.0,
+        crash: bool = True,
+    ) -> "tuple[FaultPlan, object]":
+        """The overlay fault family (ISSUE 12): Byzantine contributors
+        composed with faults aimed at the aggregation TREE rather than
+        at random replicas. Returns ``(plan, OverlayFaults)`` for
+        ``Simulation(chaos=plan, overlay=OverlayConfig(faults=...))``.
+
+        The tree-slicing partition is the novel piece: the epoch-0
+        topology is a pure function of (seed, genesis anchor, default
+        identities), so the plan reconstructs it here — before any sim
+        exists — and cuts the network along a level boundary, isolating
+        one full 2**level rank block. Inside the partition window every
+        member of that block loses its entire sibling half at the level
+        above, forcing wave escalation + withhold charging + ranked
+        fallback on one side and reciprocal-push starvation handling on
+        the other; the monitor then requires honest scores to recover
+        after heal.
+
+        Byzantine contributors (up to f//2, disjoint from the sliced
+        block so the two stressors compose rather than shadow each
+        other) withhold at a seeded level and garbage the rest of their
+        frames. Crash-restore rotates an interior (odd-rank, relay-heavy)
+        node mid-height, exercising tick disarm/re-arm."""
+        import hashlib
+
+        from hyperdrive_tpu.epochs import genesis_anchor
+        from hyperdrive_tpu.overlay import OverlayFaults, Topology
+
+        rng = random.Random((seed << 1) ^ 0x4F564C59)
+        f = n // 3
+        identities = [
+            hashlib.sha256(b"sim-replica-%d-%d" % (seed, i)).digest()
+            for i in range(n)
+        ]
+        topo = Topology(seed, genesis_anchor(seed), identities)
+        parts: tuple[Partition, ...] = ()
+        sliced: tuple = ()
+        if topo.levels >= 1 and f:
+            level = rng.randint(1, max(1, topo.levels - 1))
+            groups = topo.level_groups(level)
+            # Cut off one block, capped at f members so quorum survives.
+            block = list(rng.choice(groups))
+            if len(block) > f:
+                block = sorted(rng.sample(block, f))
+            sliced = tuple(block)
+            at = est_virtual_time * rng.uniform(0.2, 0.35)
+            heal = at + est_virtual_time * rng.uniform(0.25, 0.4)
+            parts = (Partition(at=at, heal=heal, groups=(sliced,)),)
+        byz_pool = [i for i in range(n) if i not in set(sliced)]
+        byz_count = min(max(1, f // 2), len(byz_pool)) if f else 0
+        byz = tuple(sorted(rng.sample(byz_pool, byz_count))) if byz_count else ()
+        faults = OverlayFaults(
+            byzantine=byz,
+            withhold_levels=(rng.randint(1, max(1, topo.levels)),),
+            garbage_rate=rng.uniform(0.2, 0.5),
+            stale_rate=rng.uniform(0.0, 0.4),
+        )
+        crashes: tuple[CrashRestart, ...] = ()
+        if crash and f:
+            candidates = [i for i in range(n) if i not in byz]
+            victim = max(
+                candidates, key=lambda i: (topo.rank[i] & 1, -i)
+            )
+            crashes = (
+                CrashRestart(
+                    replica=victim,
+                    crash_at_step=rng.randint(300, 900),
+                    restart_after_steps=rng.randint(300, 800),
+                ),
+            )
+        plan = cls(partitions=parts, crashes=crashes)
+        plan.validate(n)
+        faults.validate(n)
+        return plan, faults

@@ -68,6 +68,7 @@ __all__ = [
     "set_digest",
     "transition_digest",
     "default_signatory",
+    "genesis_anchor",
     "verify_epoch_chain",
     "marshal_epoch_proof",
     "unmarshal_epoch_proof",
@@ -177,6 +178,20 @@ def default_signatory(index: int, generation: int,
     return h.digest()
 
 
+def genesis_anchor(seed: int) -> bytes:
+    """The epoch-0 anchor — a pure function of the schedule seed.
+
+    Exposed at module level because consumers that key material off the
+    anchor chain (the aggregation overlay's topology, FaultPlan.overlay
+    computing tree-slicing partitions *before* a sim exists) need the
+    epoch-0 value without constructing a schedule. Must stay
+    byte-identical to the value ``EpochSchedule.__init__`` installs."""
+    return hashlib.sha256(
+        _EPOCH_TAG + b"anchor" + int(seed).to_bytes(8, "little")
+        + b"genesis"
+    ).digest()
+
+
 # ----------------------------------------------------------------- schedule
 
 
@@ -259,10 +274,7 @@ class EpochSchedule:
         self.rekey_per_epoch = int(rekey_per_epoch)
         self.signatory_fn = signatory_fn
         self._gens = [0] * len(self.stakes)
-        anchor0 = hashlib.sha256(
-            _EPOCH_TAG + b"anchor" + self.seed.to_bytes(8, "little")
-            + b"genesis"
-        ).digest()
+        anchor0 = genesis_anchor(self.seed)
         self._anchors: dict = {0: anchor0}
         members = elect_committee(
             self.stakes, self.committee_size, anchor0 + b"elect"
@@ -288,6 +300,21 @@ class EpochSchedule:
     def epoch_of(self, height: int) -> int:
         """The epoch height ``height`` belongs to (heights start at 1)."""
         return (int(height) - 1) // self.epoch_length
+
+    def anchor(self, epoch: int) -> bytes:
+        """The chained anchor digest for ``epoch``.
+
+        Only anchors already derived exist — epoch e's anchor is minted
+        by :meth:`advance` from the committed boundary value of epoch
+        e-1, so asking for a future epoch is a programming error, not a
+        lookup miss. The overlay keys its per-epoch tree off this value."""
+        a = self._anchors.get(int(epoch))
+        if a is None:
+            raise KeyError(
+                f"anchor for epoch {epoch} not derived yet "
+                f"(have epochs {sorted(self._anchors)})"
+            )
+        return a
 
     def is_boundary(self, height: int) -> bool:
         """True when committing ``height`` triggers the next election."""

@@ -44,6 +44,7 @@ from hyperdrive_tpu.messages import (
     unmarshal_message,
 )
 from hyperdrive_tpu.obs.recorder import NULL_BOUND as _OBS_NULL
+from hyperdrive_tpu.overlay.runtime import OverlayFrame, OverlayTick
 from hyperdrive_tpu.replica import (
     Replica,
     ReplicaOptions,
@@ -387,19 +388,28 @@ class SimulationResult:
             vals = {c[h] for c in maps if h in c}
             assert len(vals) <= 1, f"safety violation at height {h}: {vals}"
 
-    def commit_digest(self) -> str:
+    def commit_digest(self, up_to: int | None = None) -> str:
         """Canonical digest of the network's agreed chain: SHA-256 over
         the height-sorted (height, value) pairs of the merged commit
         maps (:meth:`assert_safety` certifies the merge is fork-free).
         Two runs that committed the same chain produce the same hex
         digest regardless of replica count, delivery schedule, or hash
-        seed — the regression handle for determinism tests."""
+        seed — the regression handle for determinism tests.
+
+        ``up_to`` bounds the digest to heights <= that value: two runs
+        to the same target can legitimately overshoot by different
+        amounts (whoever drains the final queue first commits one more
+        height before the driver stops), so cross-run equality checks
+        compare the chains up to the shared target, not the ragged
+        tail."""
         import hashlib
 
         self.assert_safety()
         merged: dict = {}
         for c in self.commits:
             merged.update(c)
+        if up_to is not None:
+            merged = {k: v for k, v in merged.items() if k <= up_to}
         h = hashlib.sha256()
         for height in sorted(merged):
             v = merged[height]
@@ -459,6 +469,7 @@ class Simulation:
         catchup_every: Optional[int] = None,
         catchup_lag: Optional[int] = None,
         load=None,
+        overlay=None,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -838,7 +849,11 @@ class Simulation:
             if burst:
                 if batch_verifier is None:
                     self.batch_verifier = HostVerifier()
-            elif verifier_for is None:
+            elif verifier_for is None and overlay is None:
+                # Overlay runs verify at the dissemination layer instead
+                # (once network-wide, batched per aggregation level);
+                # installing per-replica verifiers would re-verify every
+                # delivered constituent n times over.
                 verifier_for = lambda i: HostVerifier()  # noqa: E731
         else:
             self.signatories = signatories or [
@@ -1080,6 +1095,51 @@ class Simulation:
             self._chaos_restores: dict[int, int] = {}
             self._ckpt_capture = set(self._chaos_crashes)
 
+        #: Aggregation overlay (overlay/): votes disseminate along a
+        #: seeded binomial tree as partial-aggregate frames instead of
+        #: all-to-all fan-out. Constituent votes are still delivered and
+        #: recorded per message, so dumps replay through the ordinary
+        #: record-driven path with no overlay wiring at all.
+        self._overlay = None
+        self._overlay_coalesce = False
+        if overlay is not None:
+            if burst:
+                raise ValueError(
+                    "the overlay disseminates per delivery on the shared "
+                    "virtual clock; use lock-step mode (burst=False)"
+                )
+            if load is not None:
+                raise ValueError(
+                    "open-loop load injection bypasses the overlay's "
+                    "broadcast path; run overload and overlay scenarios "
+                    "separately"
+                )
+            if drop_rate or reorder:
+                raise ValueError(
+                    "the seeded drop/reorder adversary acts on the raw "
+                    "queue and would desynchronize frame bookkeeping; "
+                    "use chaos link faults with overlay instead"
+                )
+            if delivery_cost <= 0.0:
+                raise ValueError(
+                    "overlay level windows ride the virtual clock, and "
+                    "without delivery pacing a busy network never "
+                    "advances it — pass delivery_cost > 0"
+                )
+            if verifier_for is not None:
+                raise ValueError(
+                    "overlay runs verify once at the dissemination layer "
+                    "(replicas get verifier=None); per-replica "
+                    "verifier_for would re-verify every constituent"
+                )
+            if epochs is not None and (epochs.committee_size or n) != n:
+                raise ValueError(
+                    "overlay coverage masks index validator slots 1:1 "
+                    "with replicas; partial committees are not supported "
+                    "(committee_size must equal n)"
+                )
+            overlay.validate(n)
+
         byz_prop = byzantine_proposer or {}
         byz_val = byzantine_validator or {}
 
@@ -1106,6 +1166,47 @@ class Simulation:
             # old key's votes at heights below H.
             for r in self.replicas:
                 r.retired = self._retired
+        if overlay is not None:
+            from hyperdrive_tpu.overlay import OverlayRuntime
+
+            verifier = None
+            ov_sched = None
+            if sign:
+                from hyperdrive_tpu.verifier import HostVerifier
+
+                verifier = HostVerifier()
+                ov_sched = self._sched
+                if ov_sched is None:
+                    from hyperdrive_tpu.devsched.queue import DeviceWorkQueue
+
+                    ov_sched = self._sched = DeviceWorkQueue()
+            if self.epoch_schedule is not None:
+                anchor = self.epoch_schedule.anchor(0)
+            else:
+                from hyperdrive_tpu.epochs import genesis_anchor
+
+                anchor = genesis_anchor(seed)
+            self._overlay_coalesce = overlay.coalesce_ingest
+            self._overlay = OverlayRuntime(
+                overlay,
+                n=n,
+                seed=seed,
+                anchor=anchor,
+                identities=list(self._identity),
+                quorum=2 * self.f + 1,
+                delivery_cost=delivery_cost,
+                enqueue=lambda to, fr: self.queue.append((to, fr)),
+                schedule=self.clock.schedule,
+                now=lambda: self.clock.now,
+                deliver=self._overlay_deliver,
+                alive=self.alive,
+                order_pos=self._order_pos,
+                retired=self._retired,
+                verifier=verifier,
+                sched=ov_sched,
+                obs=self.obs if observe else None,
+                registry=self.registry,
+            )
         if self._load is not None and self._load.profile.admission:
             # The backpressure spine rides the loaded run: one shared
             # controller pinned at the profile's floor (pin=False also
@@ -1269,6 +1370,20 @@ class Simulation:
                 # zip+repeat builds the n delivery tuples in C.
                 if keypair is not None:
                     msg = keypair.sign_message(msg)
+                ov = self._overlay
+                if ov is not None:
+                    # Overlay dissemination: votes enter the aggregation
+                    # tree instead of fanning out n-wide. The sender's
+                    # own copy still rides the queue (recorded like any
+                    # delivery); proposals keep all-to-all fan-out —
+                    # there is exactly one per round, no aggregation to
+                    # win — verified once network-wide.
+                    if type(msg) is not Propose:
+                        self.queue.append((i, msg))
+                        ov.on_broadcast(i, msg)
+                        return
+                    if not ov.verify_propose(msg):
+                        return
                 self.queue.extend(zip(recipients, repeat(msg, self.n)))
 
         # The owned clock tags each scheduled timeout with its owner index so
@@ -1382,6 +1497,10 @@ class Simulation:
         self.commits[i][height] = value
         if self.payload_bytes:
             self._reconstruct_commit(i, height, value)
+        if self._overlay is not None:
+            # Slots below height-1 can no longer change any replica —
+            # catch-up resyncs laggards (no-retransmission doctrine).
+            self._overlay.note_commit(height)
         if height >= self.target_height:
             self._pending_replicas.discard(i)
         if (
@@ -1440,6 +1559,16 @@ class Simulation:
             self._order_pos[fresh] = idx
             self._retired[old] = height + 1
         self.epoch = tr.epoch
+        if self._overlay is not None:
+            # Churn re-keys tree positions: the next epoch's tree hangs
+            # off the boundary-chained anchor and the rotated identity
+            # set, so interior-node assignments are unpredictable before
+            # the boundary commits.
+            self._overlay.rekey(
+                self.epoch_schedule.anchor(tr.epoch),
+                list(self._identity),
+                tr.epoch,
+            )
         if self._obs_sim is not _OBS_NULL:
             self._obs_sim.emit(
                 "epoch.elect", height, -1,
@@ -1598,6 +1727,49 @@ class Simulation:
             out["transitions"] = self.load_controller.transitions
         return out
 
+    def overlay_snapshot(self) -> dict:
+        """The overlay runtime's accounting (frames by kind, verify rows,
+        scores/demotions, topology digest) — the overlay bench, the soak
+        CLI, and ``obs report --overlay`` all read this shape."""
+        if self._overlay is None:
+            raise ValueError("overlay_snapshot() on a run without overlay=")
+        return self._overlay.snapshot()
+
+    def _overlay_blocked(self, frame, to: int) -> bool:
+        """Chaos faults for overlay frames: partitions block on the
+        (contributor, receiver) pair exactly as _chaos_deliver blocks
+        vote senders; link faults apply their drop rate (duplication and
+        delay stay vote-only — frame bookkeeping is idempotent but the
+        clock cost of a ghost frame is not)."""
+        src = frame.src
+        for p in self._chaos_parts:
+            if p.engaged and p.blocks(src, to):
+                return True
+        lf = self._chaos_links.get((src, to))
+        if lf is not None and lf.drop and self._chaos_rng.random() < lf.drop:
+            return True
+        return False
+
+    def _overlay_deliver(self, to: int, votes) -> None:
+        """Constituent votes reaching replica ``to`` from one overlay
+        frame. Delivered per message and recorded as plain (to, vote)
+        tuples — replay is record-driven and never rebuilds the overlay
+        — or batched through handle_coalesced for unrecorded
+        mega-committee benches (OverlayConfig.coalesce_ingest)."""
+        if not self.alive[to] or not votes:
+            return
+        rec = self.record.messages if self._record_on else _DISCARD
+        r = self.replicas[to]
+        for v in votes:
+            rec.append((to, v))
+        if self._overlay_coalesce and len(votes) > 1:
+            r.handle_coalesced(votes)
+        else:
+            for v in votes:
+                r.handle(v)
+        if to in self._ckpt_capture:
+            self._ckpt_store.save(to, r.proc)
+
     def _run_delivery(self, max_steps: int) -> SimulationResult:
         """The delivery loop behind :meth:`run` (burst or lock-step)."""
         if self.burst:
@@ -1643,6 +1815,36 @@ class Simulation:
                 del self.queue[: self._qhead]
                 self._qhead = 0
             steps += 1
+
+            ov = self._overlay
+            if ov is not None:
+                t = type(msg)
+                if t is OverlayFrame:
+                    if self._chaos is not None:
+                        self._chaos_tick(steps)
+                        if self._overlay_blocked(msg, to):
+                            continue
+                    else:
+                        self._laggard_sweep(steps)
+                    if not self.alive[to]:
+                        continue
+                    # One delivery_cost per frame regardless of how many
+                    # constituent votes its mask carries — THE pricing
+                    # that makes commit latency count frames (O(n log n))
+                    # instead of votes (O(n^2)).
+                    self.clock.now += self.delivery_cost
+                    ov.on_frame(to, msg)
+                    continue
+                if t is OverlayTick:
+                    if self._chaos is not None:
+                        self._chaos_tick(steps)
+                    else:
+                        self._laggard_sweep(steps)
+                    # Ticks are local timers, not network messages: no
+                    # delivery cost, no liveness gate here (the runtime
+                    # disarms dead owners itself).
+                    ov.on_tick(to, msg)
+                    continue
 
             if self._chaos is not None:
                 self._chaos_tick(steps)
@@ -1904,7 +2106,11 @@ class Simulation:
             return
         min_h = min(alive_heights)
         self.clock.prune(
-            lambda ev: not isinstance(ev, Timeout) or ev.height >= min_h
+            lambda ev: (
+                ev.height >= min_h
+                if isinstance(ev, (Timeout, OverlayTick))
+                else True
+            )
         )
 
     # ------------------------------------------------------------ chaos
@@ -1957,16 +2163,25 @@ class Simulation:
                 m = self._chaos_monitor
                 if m is not None:
                     m.note_restore(victim, target)
-        # Laggard catch-up: a replica that loses a commit quorum to
-        # dropped votes falls off the network's height wavefront and —
-        # no retransmission — can never climb back by itself; the
-        # heal-time resync only rescues the partition case. Sweep
-        # periodically for any alive replica far enough behind the
-        # working height that its stream is unrecoverable, and jump it
-        # forward — the reference's application-driven catch-up
-        # (replica/replica.go:222-235) on a timer. Swept resyncs are
-        # recorded as RESYNC lifecycle ops like any other, so replay
-        # reproduces them without knowing the cadence.
+        self._laggard_sweep(steps)
+
+    def _laggard_sweep(self, steps: int) -> None:
+        """Laggard catch-up: a replica that loses a commit quorum falls
+        off the network's height wavefront and — no retransmission —
+        can never climb back by itself; the heal-time resync only
+        rescues the partition case. Sweep periodically for any alive
+        replica far enough behind the working height that its stream is
+        unrecoverable, and jump it forward — the reference's
+        application-driven catch-up (replica/replica.go:222-235) on a
+        timer. Swept resyncs are recorded as RESYNC lifecycle ops like
+        any other, so replay reproduces them without knowing the
+        cadence. Runs from _chaos_tick on chaos runs AND from the
+        overlay delivery path on chaos-free overlay runs: the overlay
+        prunes slots at the commit floor (its own no-retransmission
+        doctrine), so a replica that misses a quorum while the rest of
+        the network churns forward is stranded exactly like the
+        dropped-vote case — and in lock-step delivery its round timeout
+        can never fire while the busy majority keeps the queue full."""
         if steps % self._catchup_every == 0:
             net = self._net_height()
             if net > self._catchup_lag + 1:

@@ -42,7 +42,8 @@ from __future__ import annotations
 
 import threading
 
-from hyperdrive_tpu.messages import Precommit, Prevote, Propose
+from hyperdrive_tpu.load.frames import FRESH, classify_frame
+from hyperdrive_tpu.messages import Prevote
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
 __all__ = [
@@ -72,8 +73,10 @@ LEVEL_NAMES = ("accept", "shed_duplicates", "shed_low_priority",
 #: shed, and the soak asserts the counters for them stay absent.
 SHED_CLASSES = ("duplicate", "stale_height", "low_priority", "panic")
 
-#: Message-type tags for dedup keys (stable across runs, unlike id()).
-_TAG = {Propose: 0, Prevote: 1, Precommit: 2}
+# Classification (duplicate / stale detection and the dedup key shape)
+# is shared with the overlay contribution scorer through
+# load/frames.classify_frame — the two ingress paths must never drift
+# on what counts as a duplicate or a stale frame.
 
 
 class BackpressureController:
@@ -295,22 +298,23 @@ class AdmissionGate:
 
     def _admit(self, msg, peer) -> bool:
         self.offered += 1
-        t = type(msg)
-        tag = _TAG.get(t)
+        cls, key = classify_frame(
+            msg, seen=self._mem, height_fn=self.height_fn
+        )
         # Never-shed invariant: proposals, and anything that is not one
         # of the three vote types (certificates, resets, future message
-        # kinds), pass at every level. Aggregates outrank raw votes.
-        if tag is None or t is Propose:
+        # kinds), classify keyless and pass at every level. Aggregates
+        # outrank raw votes.
+        if key is None:
             self._admitted()
             return True
         level = self.controller.level
-        key = (tag, msg.sender, msg.height, msg.round, msg.value)
-        if level >= SHED_DUPLICATES:
-            if self.height_fn is not None and msg.height < self.height_fn():
-                return self._shed(msg, "stale_height")
-            if key in self._mem:
-                return self._shed(msg, "duplicate")
-        if t is Prevote:
+        if level >= SHED_DUPLICATES and cls is not FRESH:
+            # cls is the shed class verbatim: the classifier's closed
+            # vocabulary intersects SHED_CLASSES on exactly the two
+            # behavior-neutral classes the gate polices.
+            return self._shed(msg, cls)
+        if type(msg) is Prevote:
             if level >= CRITICAL_ONLY:
                 return self._shed(msg, "panic")
             if level >= SHED_LOW_PRIORITY:

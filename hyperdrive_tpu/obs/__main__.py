@@ -4,7 +4,8 @@ benchdiff.
     record     run a short observed sim and save its event journal
     report     render the round-anatomy table from a saved journal
                (``--tenants`` for per-origin device-launch latency,
-               ``--overload`` for admission/shed posture)
+               ``--overload`` for admission/shed posture,
+               ``--overlay`` for aggregation-overlay posture)
     export     convert a saved journal to Perfetto/Chrome trace JSON
     metrics    run a short observed sim, print its metrics-registry
                snapshot (JSON; ``--prometheus FILE`` for exposition text)
@@ -27,8 +28,10 @@ import sys
 from hyperdrive_tpu.obs.recorder import load_journal
 from hyperdrive_tpu.obs.report import (
     anatomy,
+    overlay_summary,
     overload_summary,
     phase_summary,
+    render_overlay_table,
     render_overload_table,
     render_table,
     render_tenant_table,
@@ -67,6 +70,22 @@ def _cmd_record(ns):
 
 def _cmd_report(ns):
     journal = load_journal(ns.journal)
+    if ns.overlay:
+        summary = overlay_summary(journal["events"])
+        if ns.json:
+            print(json.dumps({"overlay": summary}, indent=1))
+            return 0
+        if not (
+            summary["frames"]
+            or summary["level_timeouts"]
+            or summary["fallbacks"]
+            or sum(summary["charges"].values())
+        ):
+            print("no overlay.* events in journal window "
+                  "(record an overlay run: Simulation(overlay=...))")
+            return 1
+        print(render_overlay_table(summary))
+        return 0
     if ns.overload:
         summary = overload_summary(journal["events"])
         if ns.json:
@@ -221,6 +240,13 @@ def main(argv=None):
         action="store_true",
         help="overload/admission posture summary instead "
              "(load.*, admission.*, wire.frame.* events)",
+    )
+    rep.add_argument(
+        "--overlay",
+        action="store_true",
+        help="aggregation-overlay posture summary instead "
+             "(the closed overlay.* family: frames, charges, "
+             "escalations, demotions)",
     )
     rep.set_defaults(fn=_cmd_report)
 

@@ -119,6 +119,20 @@ EVENT_KINDS = frozenset(
         "epoch.switch",
         "epoch.proof",
         "epoch.stale_vote",
+        # Aggregation overlay (overlay/runtime.py): frame accounting,
+        # contribution-score verdicts, level-window escalation, and the
+        # never-starve fallback. Closed family — the --overlay report
+        # decoder and OBSERVABILITY.md enumerate exactly these.
+        "overlay.frame",
+        "overlay.invalid",
+        "overlay.stale",
+        "overlay.duplicate",
+        "overlay.withhold",
+        "overlay.level.timeout",
+        "overlay.fallback",
+        "overlay.demote",
+        "overlay.recover",
+        "overlay.rekey",
     }
 )
 

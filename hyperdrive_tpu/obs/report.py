@@ -24,6 +24,8 @@ __all__ = [
     "render_tenant_table",
     "overload_summary",
     "render_overload_table",
+    "overlay_summary",
+    "render_overlay_table",
 ]
 
 _TIMEOUT_FIRES = (
@@ -382,6 +384,147 @@ def render_overload_table(summary):
         )
     if wire:
         lines.append("wire: " + " · ".join(wire))
+    return "\n".join(lines)
+
+
+def overlay_summary(events):
+    """Aggregation-overlay posture from the journal alone.
+
+    Decodes the closed ``overlay.*`` family (obs/recorder.py) so a
+    saved journal from an overlay run answers the robustness questions
+    without a live runtime: how much coverage moved per tree level, who
+    got charged for what (the contribution-score verdicts), how often
+    level windows escalated or dead-ended into the ranked fallback, and
+    which peers finished demoted vs recovered.
+    """
+    out = {
+        "frames": 0,
+        "new_coverage": 0,
+        "frames_by_level": {},
+        "charges": {"invalid": 0, "stale": 0, "duplicate": 0,
+                    "withhold": 0},
+        "charged_peers": {},
+        "level_timeouts": 0,
+        "timeouts_by_level": {},
+        "fallbacks": 0,
+        "demotions": [],
+        "recoveries": [],
+        "still_demoted": [],
+        "rekeys": [],
+    }
+    _CHARGE_KINDS = {
+        "overlay.invalid": "invalid",
+        "overlay.stale": "stale",
+        "overlay.duplicate": "duplicate",
+        "overlay.withhold": "withhold",
+    }
+    demoted = set()
+    for ev in events:
+        replica, kind, detail = ev[1], ev[4], ev[5]
+        if kind == "overlay.frame":
+            out["frames"] += 1
+            lvl = new = None
+            for part in str(detail or "").split(":"):
+                if part.startswith("lvl="):
+                    lvl = int(part[4:])
+                elif part.startswith("new="):
+                    new = int(part[4:])
+            if lvl is not None:
+                out["frames_by_level"][lvl] = (
+                    out["frames_by_level"].get(lvl, 0) + 1
+                )
+            if new is not None:
+                out["new_coverage"] += new
+        elif kind in _CHARGE_KINDS:
+            cls = _CHARGE_KINDS[kind]
+            out["charges"][cls] += 1
+            peer = str(detail or "")
+            if peer.startswith("peer="):
+                key = f"{peer[5:]}:{cls}"
+                out["charged_peers"][key] = (
+                    out["charged_peers"].get(key, 0) + 1
+                )
+        elif kind == "overlay.level.timeout":
+            out["level_timeouts"] += 1
+            for part in str(detail or "").split(":"):
+                if part.startswith("lvl="):
+                    lvl = int(part[4:])
+                    out["timeouts_by_level"][lvl] = (
+                        out["timeouts_by_level"].get(lvl, 0) + 1
+                    )
+        elif kind == "overlay.fallback":
+            out["fallbacks"] += 1
+        elif kind == "overlay.demote":
+            out["demotions"].append((replica, str(detail or "")))
+            demoted.add(replica)
+        elif kind == "overlay.recover":
+            out["recoveries"].append((replica, str(detail or "")))
+            demoted.discard(replica)
+        elif kind == "overlay.rekey":
+            out["rekeys"].append(str(detail or ""))
+    out["still_demoted"] = sorted(demoted)
+    return out
+
+
+def render_overlay_table(summary):
+    """The overlay summary as aligned text (the CLI's ``--overlay``)."""
+    lines = [
+        f"frames {summary['frames']} carrying "
+        f"{summary['new_coverage']} new signer bits"
+    ]
+    by_level = summary["frames_by_level"]
+    if by_level:
+        tmo = summary["timeouts_by_level"]
+        rows = [["level", "frames", "timeouts"]]
+        for lvl in sorted(by_level):
+            rows.append(
+                [str(lvl), str(by_level[lvl]), str(tmo.get(lvl, 0))]
+            )
+        widths = [max(len(r[i]) for r in rows) for i in range(3)]
+        for i, r in enumerate(rows):
+            lines.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+    charges = summary["charges"]
+    total = sum(charges.values())
+    if total:
+        lines.append(
+            "charges: "
+            + " · ".join(
+                f"{cls}={n}" for cls, n in sorted(charges.items()) if n
+            )
+        )
+        per_peer = summary["charged_peers"]
+        if per_peer:
+            worst = sorted(
+                per_peer.items(), key=lambda kv: -kv[1]
+            )[:8]
+            lines.append(
+                "worst offenders: "
+                + ", ".join(
+                    f"peer {k.split(':')[0]} {k.split(':')[1]}x{n}"
+                    for k, n in worst
+                )
+            )
+    else:
+        lines.append("no contribution charges (clean overlay)")
+    lines.append(
+        f"level windows: {summary['level_timeouts']} escalations · "
+        f"{summary['fallbacks']} ranked fallbacks"
+    )
+    dem, rec = summary["demotions"], summary["recoveries"]
+    if dem or rec:
+        lines.append(
+            f"demotions {len(dem)} / recoveries {len(rec)} · "
+            f"still demoted at journal end: "
+            f"{summary['still_demoted'] or 'none'}"
+        )
+    if summary["rekeys"]:
+        lines.append(
+            "rekeys: " + " -> ".join(summary["rekeys"][:6])
+            + (f" (+{len(summary['rekeys']) - 6} more)"
+               if len(summary["rekeys"]) > 6 else "")
+        )
     return "\n".join(lines)
 
 

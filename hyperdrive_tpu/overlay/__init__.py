@@ -1,0 +1,42 @@
+"""Byzantine-resilient aggregation overlay (ISSUE 12 tentpole).
+
+A dissemination layer between :mod:`hyperdrive_tpu.replica` and the
+harness: votes travel a seeded binomial aggregation tree as partial-
+aggregate frames instead of all-to-all fan-out, with contribution
+scoring for Byzantine robustness. See ``ROBUSTNESS.md`` ("Aggregation
+doctrine") for the operational invariants and ``runtime.py`` for the
+determinism contract.
+
+Public surface:
+
+- :class:`OverlayConfig` — ``Simulation(overlay=OverlayConfig(...))``.
+- :class:`OverlayFaults` — Byzantine-contributor chaos knobs, composed
+  by ``FaultPlan.overlay``.
+- :class:`Topology` — the pure (seed, anchor, validator set) → tree
+  function; property-tested for cross-process identity.
+- :class:`ContributionScores` — the integer scoring/demotion table.
+- :class:`OverlayRuntime` / :class:`OverlayFrame` / :class:`OverlayTick`
+  — harness-facing internals (the sim's delivery loop intercepts frame
+  and tick objects by type).
+"""
+
+from hyperdrive_tpu.overlay.runtime import (
+    OverlayConfig,
+    OverlayFaults,
+    OverlayFrame,
+    OverlayRuntime,
+    OverlayTick,
+)
+from hyperdrive_tpu.overlay.score import CHARGE_WEIGHTS, ContributionScores
+from hyperdrive_tpu.overlay.topology import Topology
+
+__all__ = [
+    "OverlayConfig",
+    "OverlayFaults",
+    "OverlayFrame",
+    "OverlayRuntime",
+    "OverlayTick",
+    "Topology",
+    "ContributionScores",
+    "CHARGE_WEIGHTS",
+]

@@ -70,25 +70,38 @@ def reconnect_schedule(seed: int, key, *, base: float = 0.05,
                        jitter: float = 0.5):
     """Seeded exponential-backoff delays for one peer's dialer.
 
-    Yields connect-retry sleeps: ``base * factor**attempt`` capped at
-    ``cap``, then stretched by up to ``jitter`` (cap-before-jitter, the
+    Yields connect-retry sleeps: an exponential ramp from ``base``
+    (×``factor`` per failed attempt) HARD-CLAMPED at ``cap``, then
+    stretched by up to ``jitter`` (cap-before-jitter, the
     :mod:`hyperdrive_tpu.timer` shaping convention — jitter widens the
     spread instead of vanishing at the cap, so a mesh of nodes retrying
-    a rebooted peer never thundering-herds it). Deterministic per
-    ``(seed, key)``: the test suite asserts the exact schedule, and a
-    node re-creates the generator after each successful connect so
-    every outage replays the same bounded ramp.
+    a rebooted peer never thundering-herds it). Every yield is
+    therefore in ``[delay, delay * (1 + jitter)]`` with ``delay <=
+    cap`` — the ceiling is a spec'd bound, not an emergent one, and the
+    ramp is computed incrementally so a long outage never evaluates an
+    unbounded ``factor ** attempt``. Deterministic per ``(seed, key)``:
+    the test suite asserts the exact schedule, and a node re-creates
+    the generator after each successful connect so every outage
+    replays the same bounded ramp. The ceiling is configurable per
+    node (``TcpNode(backoff={"cap": ...})``).
     """
+    if base <= 0.0 or cap < base:
+        raise ValueError(
+            f"backoff needs 0 < base <= cap, got base={base} cap={cap}"
+        )
+    if factor < 1.0 or jitter < 0.0:
+        raise ValueError(
+            f"backoff needs factor >= 1 and jitter >= 0, got "
+            f"factor={factor} jitter={jitter}"
+        )
     # String seeding hashes through SHA-512 inside random.seed — stable
     # across processes (tuple seeding is deprecated, and hash() of the
     # host string is randomized per process).
     rng = random.Random(f"reconnect:{seed}:{key!r}")
-    attempt = 0
+    delay = base
     while True:
-        delay = min(cap, base * (factor ** attempt))
         yield delay * (1.0 + jitter * rng.random())
-        if delay < cap:
-            attempt += 1
+        delay = min(cap, delay * factor)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -113,10 +126,19 @@ class TcpNode:
     """
 
     def __init__(self, listen_port: int = 0, host: str = "127.0.0.1",
-                 obs=None, admission=None, registry=None, seed: int = 0):
+                 obs=None, admission=None, registry=None, seed: int = 0,
+                 backoff=None):
         from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
         self._host = host
+        #: Reconnect-backoff shaping overrides (``base`` / ``factor`` /
+        #: ``cap`` / ``jitter`` kwargs of :func:`reconnect_schedule`).
+        #: The cap is a per-node deployment knob: a LAN mesh wants a
+        #: tight ceiling (sub-second reconnects), a WAN deployment a
+        #: generous one. Validated eagerly — a bad shape fails at node
+        #: construction, not on the first outage.
+        self.backoff = dict(backoff or {})
+        next(reconnect_schedule(int(seed), None, **self.backoff))
         #: Flight-recorder handle for wire anomalies (oversize frames,
         #: malformed envelopes, shed backlog). The node is multithreaded,
         #: so callers must pass a handle bound to a threadsafe Recorder.
@@ -308,8 +330,13 @@ class TcpNode:
             # bypass both checks — they are signed under the current
             # generation by construction and must never shed.
             if self.retired:
-                bad_from = self.retired.get(getattr(msg, "sender", None))
-                if bad_from is not None and msg.height >= bad_from:
+                from hyperdrive_tpu.load.frames import (
+                    STALE_GENERATION,
+                    classify_frame,
+                )
+
+                cls, _ = classify_frame(msg, retired=self.retired)
+                if cls is STALE_GENERATION:
                     with self._lock:
                         self.stale_frames += 1
                         count = self.stale_frames
@@ -345,7 +372,7 @@ class TcpNode:
         pays the bounded ramp each outage instead of spinning at the
         old flat 100ms."""
         sock: socket.socket | None = None
-        sched = reconnect_schedule(self.seed, key)
+        sched = reconnect_schedule(self.seed, key, **self.backoff)
         attempts = 0
         while not self._stop.is_set():
             item = q.get()
@@ -372,7 +399,9 @@ class TcpNode:
                             )
                         if self.registry is not None:
                             self.registry.count("transport.reconnect")
-                        sched = reconnect_schedule(self.seed, key)
+                        sched = reconnect_schedule(
+                            self.seed, key, **self.backoff
+                        )
                         attempts = 0
                 try:
                     sock.sendall(frame)

@@ -1,0 +1,442 @@
+"""Byzantine-resilient aggregation overlay (ISSUE 12).
+
+The dissemination layer between replica and harness
+(hyperdrive_tpu/overlay/): seeded binomial aggregation tree, partial-
+aggregate frames scored by new-signer coverage, windowed level
+escalation with a ranked never-starve fallback, and device-batched
+partial verification. The contract under test, in rough order of
+importance:
+
+- the tree is a PURE function of (seed, epoch anchor, validator set) —
+  identical across instances, processes, and replay-from-dump;
+- the overlay changes the transport, never the agreed values: commit
+  digests are byte-identical to the all-to-all baseline, with and
+  without Byzantine contributors;
+- contribution scoring demotes misbehaving contributors and NEVER
+  leaves an honest peer demoted once faults heal (rehabilitation +
+  contribution credit);
+- replay needs no overlay wiring at all — records hold plain
+  per-message deliveries (frames/ticks are never recorded).
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from hyperdrive_tpu.chaos.monitor import InvariantMonitor
+from hyperdrive_tpu.chaos.plan import FaultPlan
+from hyperdrive_tpu.epochs import EpochConfig, genesis_anchor
+from hyperdrive_tpu.harness.sim import Simulation
+from hyperdrive_tpu.overlay import (
+    CHARGE_WEIGHTS,
+    ContributionScores,
+    OverlayConfig,
+    OverlayFaults,
+    Topology,
+)
+
+
+def _identities(seed, n):
+    import hashlib
+
+    return [
+        hashlib.sha256(b"sim-replica-%d-%d" % (seed, i)).digest()
+        for i in range(n)
+    ]
+
+
+# ---------------------------------------------------------------- topology
+
+
+def test_topology_is_pure_function_of_seed_anchor_and_set():
+    # Satellite: same (seed, anchor, validator set) -> same tree, down
+    # to the digest; any input differing -> a different permutation.
+    ids = _identities(3, 12)
+    a = Topology(3, genesis_anchor(3), ids)
+    b = Topology(3, genesis_anchor(3), list(ids))
+    assert a.digest() == b.digest()
+    assert a.rank == b.rank
+    assert Topology(4, genesis_anchor(3), ids).digest() != a.digest()
+    assert Topology(3, genesis_anchor(4), ids).digest() != a.digest()
+    assert (
+        Topology(3, genesis_anchor(3), ids[:-1]).digest() != a.digest()
+    )
+
+
+def test_topology_identical_across_processes():
+    # The digest must not depend on anything process-local (hash
+    # randomization, dict order, id()): recompute it in a fresh
+    # interpreter and compare byte-for-byte.
+    ids = _identities(7, 9)
+    local = Topology(7, genesis_anchor(7), ids).digest().hex()
+    code = (
+        "from hyperdrive_tpu.epochs import genesis_anchor\n"
+        "from hyperdrive_tpu.overlay import Topology\n"
+        "import hashlib\n"
+        "ids=[hashlib.sha256(b'sim-replica-%d-%d'%(7,i)).digest() "
+        "for i in range(9)]\n"
+        "print(Topology(7, genesis_anchor(7), ids).digest().hex())\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        check=True,
+    )
+    assert out.stdout.strip() == local
+
+
+def test_topology_structure_invariants():
+    # Ranks are a permutation of the padded space's first n entries;
+    # partner halves are disjoint sibling blocks; level_groups(l)
+    # tiles the rank space into 2**l-rank blocks.
+    for n in (1, 2, 5, 8, 13, 16):
+        t = Topology(11, genesis_anchor(11), _identities(11, n))
+        assert sorted(t.rank) == sorted(
+            set(t.rank)
+        ), "ranks must be distinct"
+        assert t.size >= n and t.size == 1 << max(0, t.levels)
+        for lvl in range(1, t.levels + 1):
+            groups = t.level_groups(lvl)
+            seen = set()
+            for g in groups:
+                assert not (seen & set(g))
+                seen |= set(g)
+            assert seen == set(range(n))
+
+
+def test_topology_contacts_prefix_stable():
+    # contacts(slot, level, k) is a lazily-extended seeded shuffle:
+    # asking for more contacts extends the list without reordering the
+    # prefix already issued (wave w's contacts never change when wave
+    # w+1 draws).
+    t = Topology(5, genesis_anchor(5), _identities(5, 16))
+    short = list(t.contacts(0, 3, 2))
+    longer = list(t.contacts(0, 3, 6))
+    assert longer[:2] == short
+
+
+# ----------------------------------------------------------------- scoring
+
+
+def test_scores_charge_demote_recover_cycle():
+    events = []
+    s = ContributionScores(
+        4,
+        on_demote=lambda p, sc, cls: events.append(("demote", p, cls)),
+        on_recover=lambda p, sc: events.append(("recover", p)),
+    )
+    for _ in range(2):
+        s.charge(1, "invalid")  # 6 each
+    assert s.is_demoted(1)
+    assert events[0] == ("demote", 1, "invalid")
+    # Demotion is advisory: peer 1 ranks last but is still present.
+    assert s.ranked()[-1] == 1
+    s.credit_coverage(1, 3)  # +6: -12 -> -6 > demote_at
+    assert not s.is_demoted(1)
+    assert ("recover", 1) in events
+    assert s.charges["invalid"] == 2
+
+
+def test_scores_clamp_at_floor_and_weights_match_vocabulary():
+    s = ContributionScores(2, floor=-10)
+    for _ in range(50):
+        s.charge(0, "invalid")
+    assert s.scores[0] == -10
+    assert set(CHARGE_WEIGHTS) == {
+        "invalid",
+        "stale_generation",
+        "duplicate",
+        "withheld",
+    }
+
+
+def test_scores_rehabilitate_pulls_toward_zero_and_recovers():
+    s = ContributionScores(3)
+    for _ in range(4):
+        s.charge(2, "invalid")  # -24, demoted
+    s.credit_coverage(0, 5)  # +10
+    assert s.is_demoted(2)
+    s.rehabilitate(10)
+    assert s.scores[2] == -14 and s.is_demoted(2)
+    s.rehabilitate(10)
+    assert s.scores[2] == -4 and not s.is_demoted(2)
+    # Positive scores decay toward zero too (windowed reputation), and
+    # zero is a fixed point.
+    assert s.scores[0] == 0
+    s.rehabilitate(10)
+    assert s.scores[0] == 0
+
+
+# -------------------------------------------------------------- validation
+
+
+def test_config_and_fault_validation_errors():
+    with pytest.raises(ValueError):
+        OverlayConfig(fanout=0).validate(8)
+    with pytest.raises(ValueError):
+        OverlayConfig(max_waves=0).validate(8)
+    with pytest.raises(ValueError):
+        OverlayConfig(level_window=0.0).validate(8)
+    with pytest.raises(ValueError):
+        OverlayConfig(heal_rate=-1).validate(8)
+    with pytest.raises(ValueError):
+        OverlayFaults(byzantine=(0, 1, 2)).validate(8)  # > f
+    with pytest.raises(ValueError):
+        OverlayFaults(byzantine=(9,)).validate(8)
+    with pytest.raises(ValueError):
+        OverlayFaults(garbage_rate=1.5).validate(8)
+    with pytest.raises(ValueError):
+        Simulation(
+            n=8, target_height=2, overlay=OverlayConfig(),
+            delivery_cost=0.0,
+        )
+    with pytest.raises(ValueError):
+        Simulation(
+            n=8,
+            target_height=2,
+            overlay=OverlayConfig(),
+            delivery_cost=1e-3,
+            drop_rate=0.1,
+        )
+    with pytest.raises(ValueError):
+        Simulation(
+            n=8, target_height=2, overlay=OverlayConfig(),
+            delivery_cost=1e-3, burst=True,
+        )
+
+
+# ----------------------------------------------------- digest neutrality
+
+
+@pytest.mark.parametrize("n", [4, 8, 16])
+def test_overlay_commits_identical_to_all_to_all(n):
+    # The tentpole's core safety claim: aggregation changes the
+    # transport, never the agreed values. Same seed, same chain,
+    # byte-for-byte, at every committee size.
+    base = Simulation(n=n, seed=23, target_height=4, delivery_cost=1e-3)
+    bres = base.run()
+    ov = Simulation(
+        n=n,
+        seed=23,
+        target_height=4,
+        delivery_cost=1e-3,
+        overlay=OverlayConfig(),
+    )
+    ores = ov.run()
+    assert bres.completed and ores.completed
+    assert ores.commit_digest(up_to=4) == bres.commit_digest(up_to=4)
+    snap = ov.overlay_snapshot()
+    assert snap["frames"] > 0
+    assert snap["scores"]["demoted"] == []
+
+
+def test_overlay_neutral_under_byzantine_contributors():
+    # Byzantine contributors garble/withhold partial aggregates; the
+    # chain must still byte-match the clean all-to-all baseline (the
+    # invalid rows are isolated and charged, never delivered).
+    base = Simulation(n=16, seed=31, target_height=4, delivery_cost=1e-3)
+    bres = base.run()
+    faults = OverlayFaults(
+        byzantine=(2, 9), withhold_levels=(1,), garbage_rate=0.5
+    )
+    ov = Simulation(
+        n=16,
+        seed=31,
+        target_height=4,
+        delivery_cost=1e-3,
+        overlay=OverlayConfig(faults=faults),
+    )
+    ores = ov.run()
+    assert ores.completed
+    assert ores.commit_digest(up_to=4) == bres.commit_digest(up_to=4)
+    snap = ov.overlay_snapshot()
+    assert snap["frames_garbage"] > 0
+    assert set(snap["scores"]["demoted"]) <= {2, 9}
+    assert snap["honest_demoted"] == []
+
+
+def test_overlay_replay_from_dump_needs_no_overlay_wiring():
+    # Records hold plain (to, vote) deliveries — frames and ticks are
+    # never recorded — so a dump replays with NO overlay kwargs and
+    # reproduces the exact commits. This is what makes overlay dumps
+    # debuggable by the standard chaos replay CLI.
+    sim = Simulation(
+        n=8,
+        seed=45,
+        target_height=4,
+        delivery_cost=1e-3,
+        overlay=OverlayConfig(
+            faults=OverlayFaults(byzantine=(5,), garbage_rate=0.4)
+        ),
+    )
+    res = sim.run()
+    assert res.completed
+    replayed = Simulation.replay(sim.record)
+    assert replayed.commits == res.commits
+
+
+def test_overlay_coalesced_ingest_differential():
+    # coalesce_ingest batches a frame's constituents through
+    # handle_coalesced instead of per-message handle; the chain must
+    # not move.
+    a = Simulation(
+        n=8,
+        seed=52,
+        target_height=4,
+        delivery_cost=1e-3,
+        overlay=OverlayConfig(),
+    )
+    ra = a.run()
+    b = Simulation(
+        n=8,
+        seed=52,
+        target_height=4,
+        delivery_cost=1e-3,
+        overlay=OverlayConfig(coalesce_ingest=True),
+    )
+    rb = b.run()
+    assert ra.completed and rb.completed
+    assert rb.commit_digest(up_to=4) == ra.commit_digest(up_to=4)
+
+
+def test_signed_overlay_verifies_each_vote_exactly_once():
+    # Verification dedup: the overlay device-verifies each vote ONCE
+    # network-wide (first forwarding frame pays it, batched per level
+    # through the DeviceWorkQueue) plus one row per propose — against
+    # n * (n-1) * votes for all-to-all host verification.
+    n, h = 8, 3
+    base = Simulation(
+        n=n, seed=61, target_height=h, delivery_cost=1e-3, sign=True
+    )
+    bres = base.run()
+    ov = Simulation(
+        n=n,
+        seed=61,
+        target_height=h,
+        delivery_cost=1e-3,
+        sign=True,
+        overlay=OverlayConfig(),
+    )
+    ores = ov.run()
+    assert bres.completed and ores.completed
+    assert ores.commit_digest(up_to=h) == bres.commit_digest(up_to=h)
+    snap = ov.overlay_snapshot()
+    # Exactly once per (vote in table) + once per propose; the precise
+    # count varies with round traffic, but the once-per-vote bound is
+    # what kills the O(n^2) verify bill.
+    assert 0 < snap["verify_rows"] <= 2 * n * (h + 1) + n
+
+
+# ------------------------------------------------------------ epochs/chaos
+
+
+def test_overlay_rekeys_at_epoch_boundaries():
+    # Churn re-keys tree positions: the topology digest must change at
+    # every boundary (new anchor + rotated set), and the chain must
+    # match the same epoch schedule run WITHOUT the overlay.
+    epochs = EpochConfig(epoch_length=2, committee_size=8,
+                         rekey_per_epoch=2)
+    base = Simulation(
+        n=8,
+        seed=77,
+        target_height=6,
+        delivery_cost=1e-3,
+        epochs=epochs,
+    )
+    bres = base.run()
+    ov = Simulation(
+        n=8,
+        seed=77,
+        target_height=6,
+        delivery_cost=1e-3,
+        epochs=epochs,
+        overlay=OverlayConfig(),
+    )
+    ores = ov.run()
+    assert bres.completed and ores.completed
+    assert ores.commit_digest(up_to=6) == bres.commit_digest(up_to=6)
+    snap = ov.overlay_snapshot()
+    assert snap["rekeys"] >= 2
+    assert ov.epoch >= 2
+
+
+def test_overlay_requires_full_committee_with_epochs():
+    with pytest.raises(ValueError):
+        Simulation(
+            n=8,
+            target_height=4,
+            delivery_cost=1e-3,
+            epochs=EpochConfig(epoch_length=2, committee_size=6),
+            overlay=OverlayConfig(),
+        )
+
+
+def test_fault_plan_overlay_family_is_deterministic():
+    p1, f1 = FaultPlan.overlay(9, 16)
+    p2, f2 = FaultPlan.overlay(9, 16)
+    assert p1 == p2 and f1 == f2
+    assert f1.byzantine and len(f1.byzantine) <= 16 // 3
+    # The tree-slicing partition isolates a level block disjoint from
+    # the Byzantine set (the two stressors compose, not shadow).
+    if p1.partitions:
+        assert not (set(p1.partitions[0].groups[0]) & set(f1.byzantine))
+
+
+def test_overlay_chaos_honest_peers_recover_after_heal():
+    # The acceptance run: tree-slicing partition + Byzantine
+    # contributors + interior crash, monitor armed. No honest peer may
+    # finish demoted (rehabilitation + contribution credit must refill
+    # the partition-window charges), never-starve must hold, and the
+    # record must replay without overlay wiring.
+    plan, faults = FaultPlan.overlay(19951, 8)
+    sim = Simulation(
+        n=8,
+        seed=19951,
+        target_height=8,
+        timeout=1.0,
+        delivery_cost=1e-3,
+        chaos=plan,
+        observe=True,
+        overlay=OverlayConfig(faults=faults),
+    )
+    monitor = InvariantMonitor(sim)
+    result = sim.run(max_steps=500_000)
+    monitor.check_final(result)  # includes _check_overlay
+    snap = sim.overlay_snapshot()
+    assert snap["honest_demoted"] == []
+    assert snap["scores"]["demotions"] > 0  # faults actually bit
+    replayed = Simulation.replay(sim.record)
+    assert replayed.commits == result.commits
+
+
+def test_overlay_report_decoder_round_trip(tmp_path):
+    # obs report --overlay: the journal alone must reconstruct frame
+    # flow, charges, escalations, and demotions (OBSERVABILITY.md).
+    from hyperdrive_tpu.obs.report import (
+        overlay_summary,
+        render_overlay_table,
+    )
+
+    sim = Simulation(
+        n=8,
+        seed=88,
+        target_height=3,
+        delivery_cost=1e-3,
+        observe=True,
+        overlay=OverlayConfig(
+            faults=OverlayFaults(byzantine=(3,), garbage_rate=0.6)
+        ),
+    )
+    res = sim.run()
+    assert res.completed
+    summary = overlay_summary(sim.obs.snapshot())
+    snap = sim.overlay_snapshot()
+    assert summary["frames"] > 0
+    assert summary["charges"]["invalid"] == (
+        snap["scores"]["charges"]["invalid"]
+    )
+    assert summary["still_demoted"] == snap["scores"]["demoted"]
+    text = render_overlay_table(summary)
+    assert "frames" in text and "level" in text

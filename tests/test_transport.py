@@ -455,6 +455,37 @@ def test_reconnect_schedule_is_deterministic_and_capped():
     assert all(cap <= d <= cap * (1.0 + jitter) for d in a[6:])
 
 
+def test_backoff_ceiling_is_configurable_and_validated_eagerly():
+    # The cap is a per-node spec'd bound: TcpNode(backoff={"cap": ...})
+    # reshapes every dialer schedule the node creates, and a malformed
+    # shaping dict fails at CONSTRUCTION (the probe draw in __init__),
+    # not on the reconnect path mid-outage.
+    from itertools import islice
+
+    from hyperdrive_tpu.transport import reconnect_schedule
+
+    key = ("127.0.0.1", 4242)
+    tight = list(islice(reconnect_schedule(7, key, cap=0.2), 10))
+    assert all(d <= 0.2 * 1.5 for d in tight)
+    # The ramp saturates: base 0.05 doubles to 0.2 in two steps, and
+    # every later delay draws from the clamped band.
+    assert all(0.2 <= d for d in tight[3:])
+
+    node = TcpNode(seed=7, backoff={"cap": 0.2, "jitter": 0.0})
+    assert node.backoff == {"cap": 0.2, "jitter": 0.0}
+    sched = reconnect_schedule(7, key, **node.backoff)
+    assert max(islice(sched, 16)) <= 0.2
+
+    with pytest.raises(ValueError):
+        TcpNode(seed=7, backoff={"cap": 0.01})  # cap < base
+    with pytest.raises(ValueError):
+        TcpNode(seed=7, backoff={"base": -1.0})
+    with pytest.raises(ValueError):
+        TcpNode(seed=7, backoff={"factor": 0.5})
+    with pytest.raises(ValueError):
+        TcpNode(seed=7, backoff={"jitter": -0.1})
+
+
 def test_sender_reconnects_with_backoff_and_emits_event():
     # Peer is down at first broadcast; the sender retries on the seeded
     # ramp, and when the peer comes up the frame arrives and the node
