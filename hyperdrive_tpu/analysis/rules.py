@@ -667,7 +667,8 @@ class MetricNameRule:
     call results, non-conforming literals — is flagged.
 
     Additionally, ``.emit`` literals under the *closed* event families
-    (``sched.launch.*``, ``verify.occupancy.*``, ``metrics.*``) must be
+    (``sched.launch.*``, ``verify.occupancy.*``, ``metrics.*``,
+    ``bls.*``) must be
     members of the recorder's EVENT_KINDS taxonomy: these families are
     machine-consumed (Perfetto device track, tenant report, registry
     snapshot), so a well-formed-but-unknown name there is a silent
@@ -685,7 +686,7 @@ class MetricNameRule:
     #: Event-name prefixes whose membership is closed: an ``.emit``
     #: literal under one of these must appear in EVENT_KINDS verbatim.
     _CLOSED_PREFIXES = ("sched.launch.", "verify.occupancy.", "metrics.",
-                        "load.", "admission.")
+                        "load.", "admission.", "bls.")
 
     def check(self, ctx):
         findings: list = []

@@ -14,26 +14,32 @@ Through Aggregated Signature Gossip"). The certificate chain is also the
 seam epoch-transition proofs hang off (ROADMAP item 4) and what a Handel
 overlay would gossip instead of vote sets (item 2).
 
-Trust model: the binding is an integrity commitment, not an aggregate
-signature — it proves the certificate's fields are exactly what the
-emitting replica committed after its verifier's batched launch accepted
-the 2f+1 precommits (the RLC transcript digest from
+Trust model — two tiers. The *binding* is an integrity commitment, not
+an aggregate signature: it proves the certificate's fields are exactly
+what the emitting replica committed after its verifier's batched launch
+accepted the 2f+1 precommits (the RLC transcript digest from
 ``TpuBatchVerifier.last_transcript`` rides inside it). Tampering with
-any field breaks the binding; substituting a whole forged certificate
-requires forging the emitting seam itself, which is the same trust a
-re-gossiped signature set places in the local verifier. A BLS-style
-self-verifying aggregate would drop that residual trust and slots into
-the same field.
+any field breaks the binding, but trusting it means trusting the
+emitting seam. The optional **BLS aggregate signature** (``agg_sig``,
+48 bytes compressed G1) drops that residual trust entirely: each
+counted signer's BLS partial over the canonical commit message
+(:func:`bls_commit_message`) is aggregated — on device via the
+:mod:`~hyperdrive_tpu.ops.g1` bitmask kernel, or on host — and a light
+client holding only the committee's public keys re-verifies the quorum
+with :func:`verify_bls_certificate`: one pairing product, zero
+transcript trust, zero vote-set gossip.
 
 Wire format (codec.py, canonical):
 
     u64 height | u32 round | bytes32 value_digest |
-    raw bitmap (u32 length prefix) | bytes32 transcript | bytes32 binding
+    raw bitmap (u32 length prefix) | bytes32 transcript | bytes32 binding |
+    raw agg_sig (empty or 48 B)
 
-Size is 112 bytes + n/8 for the signer bitmap: 144 B at n=256, 176 B at
-n=512, 240 B at n=1024 — flat against the ~64n bytes of the signature
-set it replaces (the "O(1) in validator count" claim of the paper trail;
-the bitmap is the only term that moves, at 1/512th the slope).
+Size is 116 bytes + n/8 for the signer bitmap (+48 when the BLS
+aggregate rides along): 148/196 B at n=256, 244/292 B at n=1024 — flat
+against the ~64n bytes of the signature set it replaces (the "O(1) in
+validator count" claim of the paper trail; the bitmap is the only term
+that moves, at 1/512th the slope).
 """
 
 from __future__ import annotations
@@ -50,11 +56,35 @@ __all__ = [
     "marshal_certificate",
     "unmarshal_certificate",
     "certificate_size",
+    "bls_commit_message",
+    "verify_bls_certificate",
 ]
 
 #: Domain separator for the binding hash (versioned: a format change must
-#: not collide with old bindings).
+#: not collide with old bindings). Certificates without a BLS aggregate
+#: keep the v1 tag and preimage byte-for-byte; the aggregate-carrying
+#: form commits to the extra field under its own tag.
 _BINDING_TAG = b"hd-qc-v1"
+_BINDING_TAG_BLS = b"hd-qc-v2-bls"
+
+#: Domain separator for the message BLS partials sign. Deliberately
+#: covers only (height, round, value_digest) — the consensus fact — so a
+#: light client can recompute it from the certificate alone.
+_BLS_MSG_TAG = b"hd-bls-commit-v1"
+
+
+def bls_commit_message(height: int, round: int, value_digest: bytes) -> bytes:
+    """The canonical byte string a committee member BLS-signs to endorse
+    one committed (height, round, value). Same-message across the
+    committee, which is what makes rogue-key-safe *same-message*
+    aggregation applicable (every signer is a whitelisted identity with
+    a deterministically derived key — no adversarial key registration)."""
+    return (
+        _BLS_MSG_TAG
+        + int(height).to_bytes(8, "little")
+        + int(round).to_bytes(4, "little")
+        + bytes(value_digest)
+    )
 
 
 @dataclass(frozen=True)
@@ -76,20 +106,30 @@ class QuorumCertificate:
     signers: bytes
     transcript: bytes
     binding: bytes
+    #: Compressed BLS12-381 G1 aggregate signature over
+    #: :func:`bls_commit_message` (48 bytes), or b"" on the
+    #: transcript-bound-only path.
+    agg_sig: bytes = b""
 
     def signer_count(self) -> int:
         return sum(bin(b).count("1") for b in self.signers)
 
 
-def _binding(height, round, value_digest, signers, transcript) -> bytes:
+def _binding(height, round, value_digest, signers, transcript,
+             agg_sig: bytes = b"") -> bytes:
     h = hashlib.sha256()
-    h.update(_BINDING_TAG)
+    if agg_sig:
+        h.update(_BINDING_TAG_BLS)
+    else:
+        h.update(_BINDING_TAG)
     h.update(int(height).to_bytes(8, "little"))
     h.update(int(round).to_bytes(4, "little"))
     h.update(value_digest)
     h.update(len(signers).to_bytes(2, "little"))
     h.update(signers)
     h.update(transcript)
+    if agg_sig:
+        h.update(agg_sig)
     return h.digest()
 
 
@@ -100,6 +140,7 @@ def marshal_certificate(cert: QuorumCertificate, w: Writer) -> None:
     w.raw(cert.signers)
     w.bytes32(cert.transcript)
     w.bytes32(cert.binding)
+    w.raw(cert.agg_sig)
 
 
 def unmarshal_certificate(r: Reader) -> QuorumCertificate:
@@ -111,6 +152,9 @@ def unmarshal_certificate(r: Reader) -> QuorumCertificate:
         raise SerdeError(f"signer bitmap too wide: {len(signers)} bytes")
     transcript = r.bytes32()
     binding = r.bytes32()
+    agg_sig = r.raw()
+    if len(agg_sig) not in (0, 48):
+        raise SerdeError(f"bad aggregate signature length: {len(agg_sig)}")
     return QuorumCertificate(
         height=height,
         round=rnd,
@@ -118,12 +162,14 @@ def unmarshal_certificate(r: Reader) -> QuorumCertificate:
         signers=signers,
         transcript=transcript,
         binding=binding,
+        agg_sig=agg_sig,
     )
 
 
-def certificate_size(n_validators: int) -> int:
+def certificate_size(n_validators: int, with_bls: bool = False) -> int:
     """Marshalled bytes for an n-validator certificate (the bench's
-    O(1)-in-n measurement helper)."""
+    O(1)-in-n measurement helper). ``with_bls`` adds the 48-byte
+    aggregate-signature field the BLS path carries."""
     w = Writer()
     marshal_certificate(
         QuorumCertificate(
@@ -133,10 +179,54 @@ def certificate_size(n_validators: int) -> int:
             signers=bytes(-(-n_validators // 8)),
             transcript=bytes(32),
             binding=bytes(32),
+            agg_sig=bytes(48) if with_bls else b"",
         ),
         w,
     )
     return len(w.data())
+
+
+def verify_bls_certificate(cert: QuorumCertificate, pubkeys,
+                           quorum: "int | None" = None) -> bool:
+    """Light-client verification: accept the certificate on the strength
+    of its BLS aggregate alone — no transcript, no binding, no trust in
+    the emitting replica.
+
+    ``pubkeys``: the committee's G2 public keys in whitelist order, as
+    96-byte compressed blobs or affine Fp2 pairs. The signer bitmap
+    selects which keys participate; ``quorum`` (default: reject nothing
+    on weight — pass 2f+1 to enforce) gates the signer count. One
+    pairing product regardless of committee size."""
+    from hyperdrive_tpu.crypto import bls
+
+    if len(cert.agg_sig) != 48 or len(cert.value_digest) != 32:
+        return False
+    if len(cert.signers) != -(-len(pubkeys) // 8):
+        return False
+    if quorum is not None and cert.signer_count() < quorum:
+        return False
+    try:
+        sig = bls.g1_decompress(cert.agg_sig)
+    except Exception:
+        return False
+    selected = []
+    for i, pk in enumerate(pubkeys):
+        if not cert.signers[i >> 3] >> (i & 7) & 1:
+            continue
+        if isinstance(pk, (bytes, bytearray)):
+            try:
+                pk = bls.g2_decompress(bytes(pk))
+            except Exception:
+                return False
+        selected.append(pk)
+    # Trailing bits past the committee width must be clear.
+    for i in range(len(pubkeys), 8 * len(cert.signers)):
+        if cert.signers[i >> 3] >> (i & 7) & 1:
+            return False
+    if not selected:
+        return False
+    msg = bls_commit_message(cert.height, cert.round, cert.value_digest)
+    return bls.verify_aggregate_same_message(selected, msg, sig)
 
 
 class Certifier:
@@ -153,17 +243,36 @@ class Certifier:
     """
 
     def __init__(self, signatories, f: int, transcript_source=None,
-                 obs=None):
+                 obs=None, bls_keyring=None, bls_aggregate_fn=None):
         self.signatories = list(signatories)
         self._pos = {s: i for i, s in enumerate(self.signatories)}
         self.f = int(f)
         self.transcript_source = transcript_source
         self.obs = obs if obs is not None else NULL_BOUND
+        #: Optional BLS committee keyring: signatory identity ->
+        #: :class:`~hyperdrive_tpu.crypto.bls.BlsKeyPair`. When set,
+        #: emitted certificates carry the 48-byte aggregate signature.
+        #: (Harness shortcut: partials that would ride on precommit
+        #: messages in a deployment are computed here from the shared
+        #: deterministic keyring — same bytes either way.)
+        self.bls_keyring = bls_keyring
+        #: Aggregation backend: callable(list of affine G1 partials) ->
+        #: affine G1 aggregate. Defaults to the host fold; the sim
+        #: injects the device bitmask-tree kernel here.
+        self._bls_aggregate_fn = bls_aggregate_fn
         #: height -> QuorumCertificate, in emission order.
         self.certs: dict = {}
         #: Verification outcomes (observability/tests).
         self.verified = 0
         self.rejected = 0
+
+    def bls_pubkeys(self):
+        """The committee's compressed G2 public keys in whitelist order
+        (what a light client needs for :func:`verify_bls_certificate`),
+        or None when no keyring is installed."""
+        if self.bls_keyring is None:
+            return None
+        return [self.bls_keyring[s].pk_bytes for s in self.signatories]
 
     # ------------------------------------------------------------- emission
 
@@ -186,6 +295,9 @@ class Certifier:
                 else bytes(32)
         value_digest = hashlib.sha256(value).digest()
         signers_b = bytes(bitmap)
+        agg_sig = self._bls_aggregate(
+            height, round, value_digest, signers_b
+        )
         cert = QuorumCertificate(
             height=int(height),
             round=int(round),
@@ -193,8 +305,9 @@ class Certifier:
             signers=signers_b,
             transcript=transcript,
             binding=_binding(
-                height, round, value_digest, signers_b, transcript
+                height, round, value_digest, signers_b, transcript, agg_sig
             ),
+            agg_sig=agg_sig,
         )
         self.certs[int(height)] = cert
         if self.obs is not NULL_BOUND:
@@ -203,6 +316,39 @@ class Certifier:
                 cert.value_digest.hex()[:16],
             )
         return cert
+
+    def _bls_aggregate(self, height, round, value_digest,
+                       signers_b: bytes) -> bytes:
+        """Aggregate the counted signers' BLS partials over the commit
+        message. Returns the 48-byte compressed aggregate, or b"" when
+        no keyring is installed or a counted signer has no key (an
+        aggregate that disagrees with the bitmap would be worse than
+        none)."""
+        if self.bls_keyring is None:
+            return b""
+        counted = []
+        for i, s in enumerate(self.signatories):
+            if signers_b[i >> 3] >> (i & 7) & 1:
+                kp = self.bls_keyring.get(s)
+                if kp is None:
+                    return b""
+                counted.append(kp)
+        if not counted:
+            return b""
+        from hyperdrive_tpu.crypto import bls
+
+        msg = bls_commit_message(height, round, value_digest)
+        partials = [kp.sign(msg) for kp in counted]
+        if self._bls_aggregate_fn is not None:
+            agg = self._bls_aggregate_fn(partials)
+        else:
+            agg = bls.aggregate_signatures(partials)
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "bls.cert.agg", int(height), len(partials),
+                "device" if self._bls_aggregate_fn is not None else "host",
+            )
+        return bls.g1_compress(agg)
 
     # ----------------------------------------------------------- re-verify
 
@@ -217,7 +363,7 @@ class Certifier:
             and cert.binding
             == _binding(
                 cert.height, cert.round, cert.value_digest, cert.signers,
-                cert.transcript,
+                cert.transcript, cert.agg_sig,
             )
         )
         if ok:
@@ -233,14 +379,26 @@ class Certifier:
 
     # ------------------------------------------------------------- rotation
 
-    def rotate(self, signatories, f: int) -> None:
+    def rotate(self, signatories, f: int, bls_keyring=None) -> None:
         """Epoch hot-swap (epochs.py): install the next committee's
         whitelist order and quorum threshold. Emitted certificates are
         kept — the chain stays continuous across the transition; only
-        bitmap indexing for NEW emissions follows the new order."""
+        bitmap indexing for NEW emissions follows the new order. When a
+        keyring is installed and none is supplied for the new committee,
+        keys are re-derived deterministically from the identities (the
+        same construction every component uses), so BLS emission
+        survives churn."""
         self.signatories = list(signatories)
         self._pos = {s: i for i, s in enumerate(self.signatories)}
         self.f = int(f)
+        if bls_keyring is not None:
+            self.bls_keyring = bls_keyring
+        elif self.bls_keyring is not None:
+            from hyperdrive_tpu.crypto import bls
+
+            for s in self.signatories:
+                if s not in self.bls_keyring:
+                    self.bls_keyring[s] = bls.bls_keypair_from_identity(s)
 
     # ------------------------------------------------------------- chaining
 

@@ -4,8 +4,9 @@ Usage::
 
     python -m hyperdrive_tpu.chaos soak [--scenarios N] [--seed S]
         [--n N_REPLICAS] [--target H] [--out DIR] [--replay-every K]
-        [--pipelined-every K] [--certs-every K] [--churn-every K]
-        [--overload-every K] [--overlay-every K] [--dump-ok DIR]
+        [--pipelined-every K] [--certs-every K] [--bls-certs-every K]
+        [--churn-every K] [--overload-every K] [--overlay-every K]
+        [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -20,8 +21,10 @@ self-check.
 
 Scenarios run unsigned (values are opaque digests; signature checking is
 orthogonal to fault handling), so the soak needs no accelerator and no
-jax import. HD_SANITIZE=1 in the environment arms the runtime sanitizer
-on every replica — CI runs the soak that way.
+jax import — the ``--bls-certs-every`` leg included, which exercises the
+BLS aggregate paths on the pure-Python host reference (:mod:`..crypto.bls`)
+rather than the device kernels. HD_SANITIZE=1 in the environment arms the
+runtime sanitizer on every replica — CI runs the soak that way.
 """
 
 from __future__ import annotations
@@ -41,11 +44,14 @@ _SEED_STRIDE = 9973
 
 
 def _build(scen_seed: int, n: int, target: int, pipelined: bool = False,
-           certificates: bool = False, load=None):
+           certificates: bool = False, bls_certificates: bool = False,
+           load=None):
     plan = FaultPlan.seeded(scen_seed, n)
     extra = {}
     if certificates:
         extra["certificates"] = True
+    if bls_certificates:
+        extra["bls_certificates"] = True
     if load is not None:
         extra["load"] = load
     if pipelined:
@@ -133,6 +139,92 @@ def _build_overlay(scen_seed: int, n: int, target: int):
     return plan, faults, sim
 
 
+def _bls_overlay_probe(scen_seed: int, args) -> int:
+    """The overlay leg of the BLS spot-check: the tree-slicing fault
+    family with real BLS partial aggregates riding every frame (host
+    fold — the soak stays jax-free), held to the armed monitor plus a
+    digest-neutrality cross-check, then a DETERMINISTIC merge-level
+    probe — replay a real frame with its aggregate corrupted and
+    require the runtime to charge the contributor and refuse the merge
+    before any coverage (or batch verify) happens. Returns the count of
+    organically-rejected Byzantine aggregates."""
+    from hyperdrive_tpu.overlay import OverlayConfig, OverlayFrame
+
+    on = args.n if args.n else 8
+    plan, faults = FaultPlan.overlay(scen_seed, on)
+    fsim = Simulation(
+        n=on, target_height=args.target, seed=scen_seed, timeout=1.0,
+        delivery_cost=1e-3, chaos=plan, observe=True,
+        overlay=OverlayConfig(faults=faults, bls_partials=True),
+    )
+    fmon = InvariantMonitor(fsim)
+    fresult = fsim.run(max_steps=args.max_steps)
+    fmon.check_final(fresult)
+    bsim = Simulation(
+        n=on, target_height=args.target, seed=scen_seed, timeout=1.0,
+        delivery_cost=1e-3,
+    )
+    bresult = bsim.run(max_steps=args.max_steps)
+    csim = Simulation(
+        n=on, target_height=args.target, seed=scen_seed, timeout=1.0,
+        delivery_cost=1e-3, overlay=OverlayConfig(bls_partials=True),
+    )
+    cresult = csim.run(max_steps=args.max_steps)
+    if (cresult.commit_digest(up_to=args.target)
+            != bresult.commit_digest(up_to=args.target)):
+        raise InvariantViolation(
+            "bls-overlay",
+            "BLS-partial overlay chain diverges from all-to-all baseline",
+        )
+    rt = fsim._overlay
+    src, slot, st, to = 0, None, None, None
+    for sl, s in rt._slots.items():
+        if not s.bls:
+            continue
+        r = next(
+            (i for i in range(on)
+             if (s.all_mask & ~s.cov[i]) and i != src), None,
+        )
+        if r is not None:
+            slot, st, to = sl, s, r
+            break
+        if slot is None:
+            # Fallback target if every slot fully propagated: the
+            # reject/charge half of the probe still runs; only the
+            # coverage-unchanged half becomes vacuous.
+            slot, st, to = sl, s, 1
+    if slot is None:
+        raise InvariantViolation(
+            "bls-overlay", "faulted run produced no BLS partials"
+        )
+    mask = st.all_mask
+    good = rt._bls_masked_sum(st, mask, 0, 0)
+    bad = bytes([good[0] ^ 0x01]) + good[1:]
+    rejects = rt.bls_partial_rejects
+    invalid = rt.scores.charges["invalid"]
+    cov = st.cov[to]
+    rt.on_frame(to, OverlayFrame(src, slot, 0, mask, agg=bad))
+    if rt.bls_partial_rejects != rejects + 1:
+        raise InvariantViolation(
+            "bls-overlay", "corrupted aggregate survived the merge check"
+        )
+    if rt.scores.charges["invalid"] != invalid + 1:
+        raise InvariantViolation(
+            "bls-overlay", "merge-level reject did not charge the sender"
+        )
+    if st.cov[to] != cov:
+        raise InvariantViolation(
+            "bls-overlay", "coverage merged despite a corrupted aggregate"
+        )
+    if mask & ~cov:
+        rt.on_frame(to, OverlayFrame(src, slot, 0, mask, agg=good))
+        if st.cov[to] == cov:
+            raise InvariantViolation(
+                "bls-overlay", "honest aggregate failed to merge after probe"
+            )
+    return rejects
+
+
 def _dump_failure(out: str, scen_seed: int, sim, err) -> str:
     os.makedirs(out, exist_ok=True)
     base = os.path.join(out, f"chaos_seed_{scen_seed}")
@@ -200,6 +292,78 @@ def soak(args) -> int:
                                 f"certificate failed O(1) re-verify at "
                                 f"height {ch}",
                             )
+            if args.bls_certs_every and k % args.bls_certs_every == 0:
+                # BLS-bound certificates (ISSUE 13): re-run the same
+                # plan with aggregate-signature minting on. The chain
+                # must stay digest-identical to the baseline (the
+                # aggregate changes the certificate, never the
+                # agreement), every surviving certificate must carry
+                # the 48-byte aggregate and re-verify its binding, and
+                # one sampled certificate per run must pass the full
+                # LIGHT-CLIENT pairing check — committee pubkeys only,
+                # zero transcript trust. A second, faulted overlay run
+                # rides along with real BLS partials on every frame: a
+                # deterministic merge-level probe corrupts a real
+                # frame's aggregate and the runtime must charge the
+                # contributor and refuse the merge BEFORE any batch
+                # verify.
+                from hyperdrive_tpu.certificates import (
+                    verify_bls_certificate,
+                )
+
+                _, bcsim = _build(
+                    scen_seed, n, args.target, bls_certificates=True
+                )
+                bcmon = InvariantMonitor(bcsim)
+                bcresult = bcsim.run(max_steps=args.max_steps)
+                bcmon.check_final(bcresult)
+                if bcresult.commit_digest() != result.commit_digest():
+                    raise InvariantViolation(
+                        "bls-certs",
+                        "BLS-certificate chain diverges from baseline",
+                    )
+                sampled = 0
+                for certifier in bcsim.certifiers:
+                    pks = certifier.bls_pubkeys()
+                    for ch, cert in certifier.certs.items():
+                        if len(cert.agg_sig) != 48:
+                            raise InvariantViolation(
+                                "bls-certs",
+                                f"certificate at height {ch} carries no "
+                                f"aggregate signature",
+                            )
+                        if not certifier.verify(cert):
+                            raise InvariantViolation(
+                                "bls-certs",
+                                f"BLS certificate failed binding "
+                                f"re-verify at height {ch}",
+                            )
+                    if certifier.certs and not sampled:
+                        # One pairing per run: the light-client path is
+                        # O(seconds) on the host reference, so the soak
+                        # samples the newest certificate rather than
+                        # paying n * heights pairings per scenario.
+                        ch = max(certifier.certs)
+                        if not verify_bls_certificate(
+                            certifier.certs[ch], pks,
+                            quorum=2 * ((n - 1) // 3) + 1,
+                        ):
+                            raise InvariantViolation(
+                                "bls-certs",
+                                f"light-client verify rejected the "
+                                f"certificate at height {ch}",
+                            )
+                        sampled += 1
+                if not sampled:
+                    raise InvariantViolation(
+                        "bls-certs", "run minted no BLS certificates"
+                    )
+                rejects = _bls_overlay_probe(scen_seed, args)
+                print(
+                    f"ok bls seed={scen_seed} n={n} "
+                    f"certs=48B-agg light-client=ok "
+                    f"overlay-rejects={rejects} merge-probe=ok"
+                )
             if args.pipelined_every and k % args.pipelined_every == 0:
                 # Re-run the same plan with settles pipelined through
                 # the shared device-work queue: the monitor must stay
@@ -479,6 +643,15 @@ def main(argv=None) -> int:
         default=4,
         help="re-run every Kth plan with quorum certificates enabled and "
         "cross-check chain digests + certificate integrity (0 = off)",
+    )
+    p.add_argument(
+        "--bls-certs-every",
+        type=int,
+        default=0,
+        help="re-run every Kth plan with BLS aggregate-signature "
+        "certificates (digest parity, binding re-verify, one sampled "
+        "light-client pairing check) plus a faulted BLS-partial overlay "
+        "run with a deterministic merge-level corruption probe (0 = off)",
     )
     p.add_argument(
         "--overload-every",

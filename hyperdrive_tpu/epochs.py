@@ -551,10 +551,12 @@ class EpochCertifier(Certifier):
     the proof chain across every transition it lived through."""
 
     def __init__(self, schedule: EpochSchedule, epoch: int = 0,
-                 transcript_source=None, obs=None):
+                 transcript_source=None, obs=None, bls_keyring=None,
+                 bls_aggregate_fn=None):
         super().__init__(
             schedule.signatories(epoch), schedule.f(epoch),
             transcript_source, obs,
+            bls_keyring=bls_keyring, bls_aggregate_fn=bls_aggregate_fn,
         )
         self.schedule = schedule
         self.epoch = int(epoch)

@@ -465,6 +465,7 @@ class Simulation:
         obs_capacity: int = 65536,
         chaos=None,
         certificates: bool = False,
+        bls_certificates=False,
         epochs=None,
         catchup_every: Optional[int] = None,
         catchup_lag: Optional[int] = None,
@@ -608,7 +609,17 @@ class Simulation:
         #: QuorumCertificate at each commit (transcript-bound to the
         #: settle layer's batch verifier when one is installed); chain
         #: digests land in SimulationResult.cert_digests.
-        self.certificates_on = bool(certificates)
+        self.certificates_on = bool(certificates) or bool(bls_certificates)
+        #: bls_certificates: False | True | "device". Truthy implies
+        #: certificates=True and installs the deterministic BLS committee
+        #: keyring on every certifier, so each commit's certificate
+        #: carries the 48-byte aggregate signature a light client can
+        #: check with zero transcript trust. "device" routes the G1
+        #: aggregation through the ops.g1 bitmask kernel (fixed launch
+        #: width = committee size rounded up to a power of two); True
+        #: keeps the host fold — digests are identical either way.
+        self.bls_certificates = bls_certificates
+        self._bls_keyring: "dict | None" = None
         self.certifiers: list = []
         self.dedup_verify = dedup_verify
         #: Small-window host routing for device-backed verifiers: a
@@ -1171,6 +1182,14 @@ class Simulation:
 
             verifier = None
             ov_sched = None
+            ov_bls_keyring = None
+            if getattr(overlay, "bls_partials", False):
+                ov_bls_keyring = self._bls_committee_keyring()
+                # Partial-aggregate merges batch through the device
+                # queue when one is wired (devsched=) or the run is
+                # signed; otherwise the host fold stands in so the
+                # jax-free chaos soak still arms the merge-level check.
+                ov_sched = self._sched
             if sign:
                 from hyperdrive_tpu.verifier import HostVerifier
 
@@ -1206,6 +1225,7 @@ class Simulation:
                 sched=ov_sched,
                 obs=self.obs if observe else None,
                 registry=self.registry,
+                bls_keyring=ov_bls_keyring,
             )
         if self._load is not None and self._load.profile.admission:
             # The backpressure spine rides the loaded run: one shared
@@ -1255,6 +1275,37 @@ class Simulation:
         return hashlib.sha256(
             b"value-%d-%d-%d" % (self.seed, height, round_)
         ).digest()
+
+    # -------------------------------------------------- BLS certificates
+
+    def _bls_committee_keyring(self) -> dict:
+        """The shared committee keyring (identity -> BlsKeyPair), derived
+        deterministically from signatory identities and built once — all
+        certifiers alias one dict, exactly like the Ed25519 KeyRing."""
+        if self._bls_keyring is None:
+            from hyperdrive_tpu.crypto import bls
+
+            ids = (
+                self.epoch_schedule.signatories(0)
+                if self.epoch_schedule is not None
+                else self.signatories
+            )
+            self._bls_keyring = {
+                s: bls.bls_keypair_from_identity(s) for s in ids
+            }
+        return self._bls_keyring
+
+    def _bls_device_aggregate(self, partials):
+        """Certifier aggregation backend on the device bitmask-tree
+        kernel. Launch width is the committee size rounded up to a power
+        of two, so every commit — whatever its quorum count — reuses the
+        same compiled kernel."""
+        from hyperdrive_tpu.ops import g1 as g1k
+
+        width = 1
+        while width < max(len(self.signatories), 1):
+            width *= 2
+        return g1k.aggregate_points(partials, width=width)
 
     # ---------------------------------------------------- payload (config 5)
 
@@ -1414,6 +1465,12 @@ class Simulation:
             transcript_source = lambda: getattr(  # noqa: E731
                 self.batch_verifier, "last_transcript", b""
             )
+            bls_keyring = None
+            bls_agg_fn = None
+            if self.bls_certificates:
+                bls_keyring = self._bls_committee_keyring()
+                if str(self.bls_certificates) == "device":
+                    bls_agg_fn = self._bls_device_aggregate
             if self.epoch_schedule is not None:
                 from hyperdrive_tpu.epochs import EpochCertifier
 
@@ -1421,6 +1478,8 @@ class Simulation:
                     self.epoch_schedule,
                     transcript_source=transcript_source,
                     obs=self.obs.scoped(i),
+                    bls_keyring=bls_keyring,
+                    bls_aggregate_fn=bls_agg_fn,
                 )
             else:
                 from hyperdrive_tpu.certificates import Certifier
@@ -1430,6 +1489,8 @@ class Simulation:
                     self.f,
                     transcript_source=transcript_source,
                     obs=self.obs.scoped(i),
+                    bls_keyring=bls_keyring,
+                    bls_aggregate_fn=bls_agg_fn,
                 )
             self.certifiers.append(certifier)
 

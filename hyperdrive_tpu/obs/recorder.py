@@ -133,6 +133,14 @@ EVENT_KINDS = frozenset(
         "overlay.demote",
         "overlay.recover",
         "overlay.rekey",
+        # BLS aggregate path (certificates.py, overlay/runtime.py):
+        # one mark per minted aggregate-signature certificate (detail
+        # carries partial count + host|device aggregation route) and
+        # one per merge-level partial-aggregate reject (the contributor
+        # charged before any batch verify). Closed family — the lint
+        # (HD005) and OBSERVABILITY.md enumerate exactly these.
+        "bls.cert.agg",
+        "bls.partial.reject",
     }
 )
 

@@ -4,6 +4,7 @@ Usage::
 
     python -m hyperdrive_tpu.ops msm-parity [--n N] [--windows W]
         [--seed S] [--rlc]
+    python -m hyperdrive_tpu.ops bls-parity [--n N] [--seed S]
 
 ``msm-parity`` drives :func:`hyperdrive_tpu.ops.msm.msm_kernel` against
 the host curve reference (``crypto/ed25519.py`` scalar_mult/point_add)
@@ -13,6 +14,13 @@ reference computes, or exit 1. ``--rlc`` adds the end-to-end leg: real
 signatures through ``TpuBatchVerifier(rlc=True)`` (whose rlc_kernel
 drives two MSMs) versus the per-signature ladder, including a forged
 lane to prove the culprit-isolation fallback masks identically.
+
+``bls-parity`` is the same differential discipline for the BLS12-381
+path (ISSUE 13): fp381 Montgomery products vs Python bigints, the
+curve-parameterized G1 Pippenger MSM and the masked aggregation tree vs
+the host reference in ``crypto/bls.py`` (identity rows, zero scalars,
+and masked-out lanes included), and one end-to-end k-of-k aggregate
+through the host pairing with a forged-message rejection.
 
 Shapes stay tiny (the fori-loop kernels compile once regardless of
 window count, so the compile bill is flat and the .jax_cache-warmed CI
@@ -150,6 +158,87 @@ def rlc_parity(args) -> int:
     return 0 if ok else 1
 
 
+def bls_parity(args) -> int:
+    """Differential smoke for the BLS12-381 device path: fp381 field
+    arithmetic vs Python ints, the curve-parameterized G1 MSM and the
+    masked aggregation tree vs the host reference (crypto/bls.py), and
+    one end-to-end aggregate certificate check through the pairing."""
+    import numpy as np
+
+    from hyperdrive_tpu.crypto import bls
+    from hyperdrive_tpu.ops import fp381 as fp
+    from hyperdrive_tpu.ops import g1 as g1k
+
+    rng = random.Random(args.seed)
+    n = args.n
+    rc = 0
+
+    # 1. Field: Montgomery mul against Python bigints, batched.
+    xs = [rng.randrange(bls.P) for _ in range(n)]
+    ys = [rng.randrange(bls.P) for _ in range(n)]
+    got = fp.from_mont(
+        fp.mul(np.stack([fp.to_mont(x) for x in xs]),
+               np.stack([fp.to_mont(y) for y in ys]))
+    )
+    want = [x * y % bls.P for x, y in zip(xs, ys)]
+    ok = list(got) == want
+    print(f"{'ok' if ok else 'FAIL'} fp381-mul: {n} random products "
+          f"{'match' if ok else 'MISMATCH'} Python ints")
+    rc |= 0 if ok else 1
+
+    # 2. Curve: Pippenger MSM over G1 vs serial host scalar-mults, with
+    # an identity point and a zero scalar in the mix.
+    scalars = [rng.randrange(bls.R_ORDER) for _ in range(n)]
+    scalars[1] = 0
+    points = [bls.g1_mul(bls.G1_GEN, rng.randrange(1, bls.R_ORDER))
+              for _ in range(n)]
+    points[0] = None  # identity row
+    px, py, pz = g1k.pack_points(points)
+    digits = g1k.recode_scalars(scalars)
+    got = g1k.unpack_points(*g1k.g1_msm_kernel(px, py, pz, digits))
+    if isinstance(got, list):  # kernel keeps a leading batch dim of 1
+        got = got[0]
+    want = None
+    for pt, s in zip(points, scalars):
+        want = bls.g1_add(want, bls.g1_mul(pt, s))
+    ok = got == want
+    print(f"{'ok' if ok else 'FAIL'} g1-msm: n={n} windows={g1k.G1_WINDOWS} "
+          f"{'matches host reference' if ok else f'{got} != {want}'}")
+    rc |= 0 if ok else 1
+
+    # 3. Aggregation tree: masked fixed-width sum vs the host fold.
+    mask = [rng.random() < 0.8 for _ in range(n)]
+    got = g1k.aggregate_points(
+        [p if m else None for p, m in zip(points, mask)]
+    )
+    want = bls.aggregate_signatures(
+        [p for p, m in zip(points, mask) if m and p is not None]
+    )
+    ok = got == want
+    print(f"{'ok' if ok else 'FAIL'} g1-aggregate: width={n} "
+          f"mask={sum(mask)}/{n} "
+          f"{'matches host fold' if ok else f'{got} != {want}'}")
+    rc |= 0 if ok else 1
+
+    # 4. End to end: sign one commit digest under k keys, aggregate on
+    # device, verify through the host pairing (the one O(pairing) step
+    # a light client pays per certificate).
+    k = min(n, 5)
+    kps = [bls.bls_keypair_from_identity(b"bls-parity-%d" % i)
+           for i in range(k)]
+    msg = b"bls-parity-commit"
+    agg = g1k.aggregate_points([kp.sign(msg) for kp in kps])
+    ok = bls.verify_aggregate_same_message([kp.pk for kp in kps], msg, agg)
+    forged = bls.verify_aggregate_same_message(
+        [kp.pk for kp in kps], b"bls-parity-forged", agg
+    )
+    ok = ok and not forged
+    print(f"{'ok' if ok else 'FAIL'} bls-e2e: {k}-of-{k} device aggregate "
+          f"{'verifies, forgery rejected' if ok else 'FAILED pairing check'}")
+    rc |= 0 if ok else 1
+    return rc
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m hyperdrive_tpu.ops")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -168,14 +257,25 @@ def main(argv=None) -> int:
         help="also run real signatures through the RLC-MSM verifier vs "
         "the per-signature ladder (adds the verify-kernel compile)",
     )
+    p.set_defaults(fn=msm_parity, banner="msm")
+
+    p = sub.add_parser(
+        "bls-parity",
+        help="BLS12-381 device path (fp381, G1 MSM, aggregation tree) "
+        "vs the host reference, plus one end-to-end pairing check",
+    )
+    p.add_argument("--n", type=int, default=16)
+    p.add_argument("--seed", type=int, default=7)
+    p.set_defaults(fn=bls_parity, banner="bls")
+
     args = ap.parse_args(argv)
-    rc = msm_parity(args)
-    if args.rlc:
+    rc = args.fn(args)
+    if args.banner == "msm" and args.rlc:
         rc = rlc_parity(args) or rc
     if rc == 0:
-        print("msm parity ok")
+        print(f"{args.banner} parity ok")
     else:
-        print("msm parity FAILED", file=sys.stderr)
+        print(f"{args.banner} parity FAILED", file=sys.stderr)
     return rc
 
 

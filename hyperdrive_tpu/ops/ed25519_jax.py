@@ -389,14 +389,20 @@ def rlc_kernel(ax, ay, at, rx, ry, m_nib, z_nib, c_nib):
     window absorbs the recode carry), instead of the per-lane table
     walk + tree-sum of the original Straus formulation.
     """
-    from hyperdrive_tpu.ops.msm import msm_kernel
+    from hyperdrive_tpu.ops.msm import (
+        ED25519_FULL_WINDOWS,
+        ED25519_HALF_WINDOWS,
+        msm_kernel,
+    )
 
     lanes = jnp.arange(16, dtype=jnp.int32)
 
     # Signed-window decomposition. Both scalars satisfy the < 2^253
-    # recode precondition: m and c are reduced mod L, z is 128-bit.
+    # recode precondition: m and c are reduced mod L, z is 128-bit. The
+    # window geometry is the planner's (64 full / 33 half), derived from
+    # the scalar bit widths rather than hardcoded.
     m_digits = _recode_signed(m_nib)  # [64, B]
-    z_digits = _recode_signed(z_nib)[:33]  # [33, B]
+    z_digits = _recode_signed(z_nib)[:ED25519_HALF_WINDOWS]  # [33, B]
 
     t_a = msm_kernel(ax, ay, at, m_digits)
     # -R: negate x and t of the affine point.
@@ -859,9 +865,16 @@ class TpuBatchVerifier:
 
                 self.last_transcript = _hl.sha256(binder).digest()
                 if self.obs is not _OBS_NULL_BOUND:
-                    from hyperdrive_tpu.ops.msm import msm_plan
+                    from hyperdrive_tpu.ops.msm import (
+                        ED25519_FULL_WINDOWS,
+                        ED25519_HALF_WINDOWS,
+                        msm_plan,
+                    )
 
-                    plan = msm_plan(arrays[0].shape[0], 64 + 33)
+                    plan = msm_plan(
+                        arrays[0].shape[0],
+                        ED25519_FULL_WINDOWS + ED25519_HALF_WINDOWS,
+                    )
                     occ = (
                         np.count_nonzero(m_nib) + np.count_nonzero(z_nib)
                     ) / max(m_nib.size + z_nib.size, 1)

@@ -34,6 +34,8 @@ import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
+from hyperdrive_tpu.ops import limbs as _limbs
+
 __all__ = [
     "N_LIMBS",
     "LIMB_BITS",
@@ -59,8 +61,8 @@ __all__ = [
 ]
 
 N_LIMBS = 20
-LIMB_BITS = 13
-LIMB_MASK = (1 << LIMB_BITS) - 1
+LIMB_BITS = _limbs.LIMB_BITS
+LIMB_MASK = _limbs.LIMB_MASK
 
 P_INT = 2**255 - 19
 #: 2^260 mod p — the fold factor for columns >= 20.
@@ -78,78 +80,28 @@ TOP_MASK = (1 << TOP_SHIFT) - 1
 SLACK_MAX = 9_400
 
 
-def _make_sub_bias() -> "np.ndarray":
-    """A multiple of p whose (redundant) limb decomposition dominates any
-    invariant-satisfying operand limb-wise, so ``a + bias - b`` has every
-    limb non-negative *before* carrying. Non-negative pre-carry limbs are
-    what lets subtraction normalize with a single vectorized carry pass
-    instead of a sequential borrow-propagating scan.
-
-    Construction: take the natural base-2^13 digits d_i of c*p and lend
-    2^13 from each limb i+1 to limb i (m_0 = d_0 + 2^13, m_i = d_i + 2^13
-    - 1 for 0 < i < 19, m_19 = d_19 - 1, where d_19 is the untruncated top
-    digit). Searching c finds digits big enough that every m_i >=
-    SLACK_MAX (the operand limb maximum)."""
-    for c in range(40, 4096):
-        v = c * P_INT
-        d = [(v >> (LIMB_BITS * i)) & LIMB_MASK for i in range(N_LIMBS - 1)]
-        d.append(v >> (LIMB_BITS * (N_LIMBS - 1)))
-        m = [d[0] + (1 << LIMB_BITS)]
-        m += [d[i] + (1 << LIMB_BITS) - 1 for i in range(1, N_LIMBS - 1)]
-        m.append(d[N_LIMBS - 1] - 1)
-        if all(SLACK_MAX <= mi < (1 << 16) for mi in m):
-            assert sum(mi << (LIMB_BITS * i) for i, mi in enumerate(m)) == v
-            return np.array(m, dtype=np.int32)
-    raise AssertionError("no subtraction bias found")
-
-
-_SUB_BIAS = _make_sub_bias()
+# The bias search, limb packing, and carry primitives are shared with
+# fp381 through :mod:`hyperdrive_tpu.ops.limbs`; this module pins the
+# GF(2^255-19) parameters (20 limbs, SLACK_MAX domination bound).
+_SUB_BIAS = _limbs.make_sub_bias(P_INT, N_LIMBS, SLACK_MAX)
 
 
 def _to_limbs_flat(vals) -> np.ndarray:
-    """[n] Python ints -> [n, 20] int32 limbs, vectorized through a byte
-    buffer + unpackbits (the per-int Python limb loop costs ~10us/value —
-    100ms for one Shamir launch's 11k shares — vs ~2ms here)."""
-    n = len(vals)
-    try:
-        buf = b"".join(v.to_bytes(33, "little") for v in vals)  # 264 bits
-    except OverflowError:
-        raise ValueError("value out of limb range") from None
-    u = np.frombuffer(buf, dtype=np.uint8).reshape(n, 33)
-    if (u[:, 32] >> 4).any():
-        raise ValueError("value out of limb range")
-    bits = np.unpackbits(u, axis=1, bitorder="little")[:, : N_LIMBS * LIMB_BITS]
-    weights = (1 << np.arange(LIMB_BITS, dtype=np.int32)).astype(np.int32)
-    return (
-        bits.reshape(n, N_LIMBS, LIMB_BITS).astype(np.int32) * weights
-    ).sum(axis=2, dtype=np.int32)
+    """[n] Python ints -> [n, 20] int32 limbs (vectorized; see
+    :func:`hyperdrive_tpu.ops.limbs.to_limbs_flat`)."""
+    return _limbs.to_limbs_flat(vals, N_LIMBS)
 
 
 def to_limbs(x) -> np.ndarray:
     """Python int(s) -> int32 limb array. Accepts a single int (-> shape
     [20]) or any nested sequence of ints (-> shape [..., 20]). Values must
     lie in [0, 2^260)."""
-    if isinstance(x, (int,)):
-        if not 0 <= x < 1 << 260:
-            raise ValueError("value out of limb range")
-        return np.array(
-            [(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(N_LIMBS)],
-            dtype=np.int32,
-        )
-    x = list(x)
-    if x and isinstance(x[0], int):
-        if any(v < 0 for v in x):
-            raise ValueError("value out of limb range")
-        return _to_limbs_flat(x)
-    return np.stack([to_limbs(v) for v in x])
+    return _limbs.to_limbs(x, N_LIMBS)
 
 
 def from_limbs(limbs) -> "int | list":
     """Inverse of :func:`to_limbs` (host-side; accepts device arrays)."""
-    a = np.asarray(limbs)
-    if a.ndim == 1:
-        return sum(int(a[i]) << (LIMB_BITS * i) for i in range(N_LIMBS))
-    return [from_limbs(row) for row in a]
+    return _limbs.from_limbs(limbs)
 
 
 ZERO = to_limbs(0)
@@ -164,35 +116,11 @@ def zeros_like_batch(batch_shape) -> jnp.ndarray:
 # ------------------------------------------------------------------ carries
 
 
-def _carry(x: jnp.ndarray) -> jnp.ndarray:
-    """One full sequential carry pass: limbs -> [0, 2^13), returning the
-    final carry out of the top limb. Works for signed inputs (arithmetic
-    shift = floor division).
+#: Sequential scan carry (shared; see :func:`limbs.carry_scan`).
+_carry = _limbs.carry_scan
 
-    Implemented as a lax.scan along the limb axis so the traced graph is
-    one step deep — an unrolled 39-step chain inside a scalar-mult loop
-    made XLA compile times explode.
-    """
-    xs = jnp.moveaxis(x, -1, 0)  # [K, ...batch]
-
-    def step(carry, col):
-        c = col + carry
-        return c >> LIMB_BITS, c & LIMB_MASK
-
-    carry, cols = lax.scan(step, jnp.zeros_like(xs[0]), xs)
-    return jnp.moveaxis(cols, 0, -1), carry
-
-
-def _fold_carry_out(x: jnp.ndarray, carry: jnp.ndarray, factor: int) -> jnp.ndarray:
-    """Fold a (small) carry that left the top limb back into limb 0 with
-    the given modular factor, then ripple the micro-carry."""
-    x = x.at[..., 0].add(carry * factor)
-    # One micro ripple is enough: carry*factor < 2^23 adds at most 2^10
-    # carry units into limb 1, which has headroom.
-    c = x[..., 0]
-    x = x.at[..., 0].set(c & LIMB_MASK)
-    x = x.at[..., 1].add(c >> LIMB_BITS)
-    return x
+#: Pseudo-Mersenne carry-out fold (shared; see :func:`limbs.fold_carry_out`).
+_fold_carry_out = _limbs.fold_carry_out
 
 
 def _fold_top(x: jnp.ndarray) -> jnp.ndarray:
@@ -229,12 +157,8 @@ def _normalize(x: jnp.ndarray) -> jnp.ndarray:
 # number of passes restores the invariant — no borrow can ripple.
 
 
-def _pass(x: jnp.ndarray):
-    """One vectorized carry pass. Returns (limbs, carry_out_of_top)."""
-    c = x >> LIMB_BITS
-    r = x & LIMB_MASK
-    shifted = jnp.concatenate([jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
-    return r + shifted, c[..., -1]
+#: One vectorized carry pass (shared; see :func:`limbs.carry_pass`).
+_pass = _limbs.carry_pass
 
 
 def _pass_fold(x: jnp.ndarray) -> jnp.ndarray:

@@ -1,0 +1,217 @@
+"""GF(p) arithmetic for the BLS12-381 base field on int32 limb vectors.
+
+Same device dialect as :mod:`.fe25519` — a field element is a vector of
+13-bit limbs in int32 (here **30 limbs**, capacity 390 bits), every
+function is shape-static and jit/vmap/shard_map-transparent — but the
+reduction strategy differs, because p_381 has no pseudo-Mersenne
+structure: 2^390 mod p is a full-width 381-bit constant, so the fe25519
+fold (multiply the carry-out by a small factor) never converges.
+
+Instead, values live in the **Montgomery domain** (x̄ = x·R mod p,
+R = 2^390) and multiplication is CIOS with the reduction interleaved
+into the schoolbook product (:class:`hyperdrive_tpu.ops.limbs.Montgomery`).
+Two consequences shape the API:
+
+- **Signed redundancy, no subtraction bias.** fe25519 needs a
+  limb-dominating multiple of p so subtraction stays non-negative
+  before its fold. Montgomery reduction is indifferent to sign
+  (arithmetic shifts are floor divisions; the quotient digit is
+  computed from a masked — hence canonical — low limb), so ``sub`` is a
+  plain limb subtraction plus one carry pass, and intermediate values
+  are signed with the invariant **|value| < 2^389.5** (top limb below
+  2^12.5, safely inside the CIOS bounds below). Each ``mul`` contracts
+  the magnitude back below |a·b|/R + p < 2^389.5·2/R·|b| ~ 2^388.6, so
+  chains of up to 8x-scaling add/sub between muls stay inside the
+  invariant — the G1 complete-addition formulas (:mod:`.g1`) peak at
+  8·Y^2 ~ 2^389.2.
+
+- **Domain conversion is host-side.** ``encode``/``decode`` are Python
+  int multiplies at pack/unpack time; the device never materializes
+  R^2. ``canonical`` drops to the standard domain on device via a
+  Montgomery multiply by 1 (x̄·1/R = x), which also squeezes the value
+  into [0, p] for the conditional subtract.
+
+Int32 safety (the bound walk the CIOS pass depends on): operand limbs
+after a pass have magnitude <= 2^13 + eps; each CIOS step adds one
+a_i*b_j product and one m*p_j product per column (<= 2 * 8193^2 ~=
+1.35e8) onto an accumulator limb whose steady state is <= 8192 +
+1.35e8/2^13 ~= 2.5e4 — columns stay < 1.4e8 << 2^31. The (n+1)-limb
+accumulator holds intermediate values < 2^13 * 2^389 = 2^402 < 2^403,
+its 403-bit capacity.
+
+The Python-int reference for every operation is the host crypto module
+(:mod:`hyperdrive_tpu.crypto.bls`); differential tests in
+``tests/test_bls.py`` enforce exact agreement.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from hyperdrive_tpu.ops import limbs as _limbs
+
+__all__ = [
+    "N_LIMBS",
+    "LIMB_BITS",
+    "LIMB_MASK",
+    "P_INT",
+    "MONT",
+    "to_mont",
+    "from_mont",
+    "to_limbs",
+    "from_limbs",
+    "zeros_like_batch",
+    "add",
+    "sub",
+    "neg",
+    "mul",
+    "sqr",
+    "mul_small",
+    "canonical",
+    "eq",
+    "is_zero",
+    "select",
+    "ZERO",
+    "ONE",
+]
+
+N_LIMBS = 30
+LIMB_BITS = _limbs.LIMB_BITS
+LIMB_MASK = _limbs.LIMB_MASK
+
+#: The BLS12-381 base field prime (381 bits).
+P_INT = int(
+    "1a0111ea397fe69a4b1ba7b6434bacd764774b84f38512bf6730d2a0f6b0f624"
+    "1eabfffeb153ffffb9feffffffffaaab",
+    16,
+)
+
+#: Montgomery context: R = 2^390, n0' = -p^{-1} mod 2^13, CIOS kernel.
+MONT = _limbs.make_montgomery(P_INT, N_LIMBS)
+
+
+def to_limbs(x) -> np.ndarray:
+    """Python int(s) in [0, 2^390) -> int32 limb array [..., 30]. Raw
+    limb packing — no domain conversion (see :func:`to_mont`)."""
+    return _limbs.to_limbs(x, N_LIMBS)
+
+
+def from_limbs(limbs) -> "int | list":
+    """Inverse of :func:`to_limbs` (host-side, signed-safe)."""
+    return _limbs.from_limbs(limbs)
+
+
+def to_mont(x) -> np.ndarray:
+    """Host pack: Python int(s) -> Montgomery-domain limb array. Accepts
+    a single int or any nested sequence (mirrors :func:`to_limbs`)."""
+    if isinstance(x, int):
+        return to_limbs(MONT.encode(x))
+    x = list(x)
+    if x and isinstance(x[0], int):
+        return to_limbs([MONT.encode(v) for v in x])
+    return np.stack([to_mont(v) for v in x])
+
+
+def from_mont(limbs) -> "int | list":
+    """Host unpack: Montgomery-domain limbs (any redundant signed
+    representation) -> canonical Python int(s) in [0, p)."""
+    v = from_limbs(limbs)
+    if isinstance(v, int):
+        return MONT.decode(v)
+
+    def walk(t):
+        return MONT.decode(t) if isinstance(t, int) else [walk(u) for u in t]
+
+    return walk(v)
+
+
+ZERO = to_limbs(0)
+#: 1 in the Montgomery domain (R mod p).
+ONE = to_mont(1)
+_ONE_STD = to_limbs(1)
+_P_LIMBS = to_limbs(P_INT)
+
+
+def zeros_like_batch(batch_shape) -> jnp.ndarray:
+    return jnp.zeros((*batch_shape, N_LIMBS), dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------- operators
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b (domain-agnostic). One carry pass; the top limb absorbs the
+    carry-out unmasked (value bound keeps it tiny)."""
+    return _limbs.carry_pass_keep_top(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b, signed — no bias needed (see module docstring)."""
+    return _limbs.carry_pass_keep_top(a - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _limbs.carry_pass_keep_top(-a)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product ā·b̄/R (= the Montgomery form of a·b)."""
+    return MONT.mul(a, b)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery squaring. CIOS gains nothing from symmetry (the
+    reduction interleave dominates), so this is :func:`mul`."""
+    return MONT.mul(a, a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small non-negative constant. Scalars act directly
+    in the Montgomery domain (k·x̄ = Montgomery form of k·x). k < 2^17
+    keeps limb products inside int32; two passes restore the limb
+    bound."""
+    if not 0 <= k < (1 << 17):
+        raise ValueError("constant too large for int32 limb products")
+    x = _limbs.carry_pass_keep_top(a * jnp.int32(k))
+    return _limbs.carry_pass_keep_top(x)
+
+
+# ------------------------------------------------------------- canonical
+
+
+def _cond_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    """Subtract p if x >= p (constant-time select; x in [0, p] after
+    :func:`canonical`'s squeeze, so one round suffices)."""
+    p = jnp.asarray(_P_LIMBS, dtype=jnp.int32)
+    t = x - p
+    t, borrow = _limbs.carry_scan(t)  # borrow < 0 iff x < p
+    keep = borrow < 0
+    return jnp.where(keep[..., None], x, t)
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery-domain x̄ -> the unique standard-domain representative
+    of x in [0, p). A Montgomery multiply by 1 computes x̄/R = x while
+    squeezing the value into [0, p] (|x̄|/R < 1 for invariant inputs, and
+    the quotient additions keep the result non-negative); a scan carry
+    then a single conditional subtract finish."""
+    one = jnp.asarray(_ONE_STD, dtype=jnp.int32)
+    std = MONT.mul(x, one)
+    std, _ = _limbs.carry_scan(std)
+    return _cond_sub_p(std)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field equality across redundant signed representations."""
+    return jnp.all(canonical(a) == canonical(b), axis=-1)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def select(mask: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise field-element select: mask ? a : b (mask shaped [...])."""
+    return jnp.where(mask[..., None], a, b)

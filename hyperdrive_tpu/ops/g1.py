@@ -1,0 +1,352 @@
+"""BLS12-381 G1 point arithmetic and aggregation kernels on TPU.
+
+The device side of the BLS aggregate-verification path: complete
+projective point arithmetic over :mod:`.fp381` limb vectors, plugged
+into the curve-parameterized Pippenger engine of :mod:`.msm`, plus the
+committee-bitmask aggregation kernel the quorum-certificate and overlay
+paths launch.
+
+Representation: a point batch is three [..., 30] int32 Montgomery-domain
+limb tensors (X, Y, Z) — **complete projective** coordinates with the
+Renes–Costello–Batina a = 0 formulas (b3 = 3*4 = 12). Complete formulas
+are the whole trick for SIMD consensus workloads: identity, doubling
+and generic addition all take the SAME branch-free instruction
+sequence, so identity-padded lanes, bitmask-deselected committee slots
+and bucket trash need no special cases anywhere in the kernel. The
+identity is (0 : 1 : 0) (Montgomery-encoded 1).
+
+Two kernels:
+
+- :func:`aggregate_kernel` — sigma = sum_{i in mask} P_i, the O(n)
+  half of BLS aggregate verification (aggregate signature or aggregate
+  public-key-shadow sums). A select against the identity plus a
+  halving tree of [n/2]-wide complete adds: log2(n) fixed-shape levels,
+  one launch per committee regardless of the bitmask.
+- :func:`g1_msm_kernel` — general scalar MSM over the shared Pippenger
+  engine (:func:`.msm.msm_engine` with :func:`g1_curve_ops`), used by
+  the parity CLI and anywhere weighted sums appear.
+
+Host-side pack/unpack helpers convert between the affine Python-int
+points of :mod:`hyperdrive_tpu.crypto.bls` and the device layout; the
+differential contract is exact agreement with that oracle
+(``tests/test_bls.py``).
+
+Value-bound note (the fp381 invariant walk): the formulas chain at most
+three adds or one mul_small(12) between Montgomery multiplies, so every
+mul operand stays below 2^388.2 against the CIOS accumulator's 2^403
+capacity — see the bound analysis in :mod:`.fp381`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hyperdrive_tpu.ops import fp381 as fp
+from hyperdrive_tpu.ops import msm
+
+__all__ = [
+    "padd",
+    "pdbl",
+    "identity_rows",
+    "g1_curve_ops",
+    "g1_msm_kernel",
+    "aggregate_kernel",
+    "make_aggregate_fn",
+    "make_batched_aggregate_fn",
+    "aggregate_points",
+    "G1SumLauncher",
+    "G1_WINDOWS",
+    "recode_scalars",
+    "pack_points",
+    "unpack_points",
+]
+
+#: b3 = 3 * b for y^2 = x^3 + 4.
+B3 = 12
+
+#: Signed 4-bit windows covering the 255-bit BLS12-381 scalar field
+#: (one extra bit of headroom for the recode carry).
+G1_WINDOWS = msm.windows_for_bits(256)  # 64
+
+
+# ----------------------------------------------------------- point formulas
+
+
+def padd(p, q):
+    """Complete projective addition (Renes–Costello–Batina, a = 0).
+    Branch-free: correct for identity, equal and opposite inputs alike."""
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    t0 = fp.mul(x1, x2)
+    t1 = fp.mul(y1, y2)
+    t2 = fp.mul(z1, z2)
+    t3 = fp.mul(fp.add(x1, y1), fp.add(x2, y2))
+    t3 = fp.sub(t3, fp.add(t0, t1))
+    t4 = fp.mul(fp.add(y1, z1), fp.add(y2, z2))
+    t4 = fp.sub(t4, fp.add(t1, t2))
+    x3 = fp.mul(fp.add(x1, z1), fp.add(x2, z2))
+    y3 = fp.sub(x3, fp.add(t0, t2))
+    t0 = fp.add(fp.add(t0, t0), t0)  # 3*X1X2
+    t2 = fp.mul_small(t2, B3)
+    z3 = fp.add(t1, t2)
+    t1 = fp.sub(t1, t2)
+    y3 = fp.mul_small(y3, B3)
+    x3 = fp.sub(fp.mul(t3, t1), fp.mul(t4, y3))
+    y3 = fp.add(fp.mul(t1, z3), fp.mul(y3, t0))
+    z3 = fp.add(fp.mul(z3, t4), fp.mul(t0, t3))
+    return (x3, y3, z3)
+
+
+def pdbl(p):
+    """Complete projective doubling (a = 0)."""
+    x, y, z = p
+    t0 = fp.mul(y, y)
+    z3 = fp.add(fp.add(fp.add(t0, t0), fp.add(t0, t0)), fp.add(fp.add(t0, t0), fp.add(t0, t0)))  # 8*Y^2
+    t1 = fp.mul(y, z)
+    t2 = fp.mul_small(fp.mul(z, z), B3)
+    x3 = fp.mul(t2, z3)
+    y3 = fp.add(t0, t2)
+    z3 = fp.mul(t1, z3)
+    t1 = fp.add(t2, t2)
+    t2 = fp.add(t1, t2)
+    t0 = fp.sub(t0, t2)
+    y3 = fp.add(x3, fp.mul(t0, y3))
+    x3 = fp.mul_small(fp.mul(t0, fp.mul(x, y)), 2)
+    return (x3, y3, z3)
+
+
+def identity_rows(n: int):
+    """n identity points (0 : 1 : 0), Montgomery domain: [n, 30] x3."""
+    zero = jnp.zeros((n, fp.N_LIMBS), dtype=jnp.int32)
+    one = jnp.broadcast_to(jnp.asarray(fp.ONE, dtype=jnp.int32), (n, fp.N_LIMBS))
+    return (zero, one, zero)
+
+
+# -------------------------------------------------------------- curve bundle
+
+
+def _g1_ops() -> msm.CurveOps:
+    def bucket_identity(G: int):
+        zero = jnp.zeros((G, msm.N_BUCKETS + 1, fp.N_LIMBS), dtype=jnp.int32)
+        one = jnp.broadcast_to(
+            jnp.asarray(fp.ONE, dtype=jnp.int32),
+            (G, msm.N_BUCKETS + 1, fp.N_LIMBS),
+        )
+        return (zero, one, zero)
+
+    def entry_select(sign, entry):
+        x, y, z = entry
+        return (x, fp.select(sign, fp.neg(y), y), z)
+
+    def window_shift(acc):
+        for _ in range(msm.WINDOW_BITS):
+            acc = pdbl(acc)
+        return acc
+
+    return msm.CurveOps(
+        n_limbs=fp.N_LIMBS,
+        acc_identity=identity_rows,
+        bucket_identity=bucket_identity,
+        entry_select=entry_select,
+        add_entry=padd,
+        add=padd,
+        window_shift=window_shift,
+    )
+
+
+_G1_OPS = None
+
+
+def g1_curve_ops() -> msm.CurveOps:
+    global _G1_OPS
+    if _G1_OPS is None:
+        _G1_OPS = _g1_ops()
+    return _G1_OPS
+
+
+def g1_msm_kernel(px, py, pz, digits):
+    """sum_i [s_i]P_i over projective G1 points via the shared Pippenger
+    engine. ``digits``: [W, N] signed 4-bit windows (see
+    :func:`recode_scalars`). Returns a projective point, [1, 30] x3.
+
+    Padding lanes are free (zero digits land in the trash bucket), and
+    identity *points* are also free — complete formulas again."""
+    return msm.msm_engine((px, py, pz), digits, g1_curve_ops())
+
+
+def aggregate_kernel(px, py, pz, mask):
+    """Committee-bitmask aggregation: sum of P_i where mask_i != 0.
+
+    Args (int32): px, py, pz [N, 30] projective Montgomery coords;
+    mask [N] (0/1). N need not be a power of two — odd tails fold in
+    with one extra width-1 add per level. Returns [1, 30] x3.
+
+    One fixed-shape launch per committee: deselected lanes become the
+    identity (free under complete addition), then a halving tree of
+    batched adds reduces log2(N) levels — the device replacement for
+    the host's O(N) serial Jacobian walk."""
+    m = mask != 0
+    ident = identity_rows(px.shape[0])
+    pt = (
+        fp.select(m, px, ident[0]),
+        fp.select(m, py, ident[1]),
+        fp.select(m, pz, ident[2]),
+    )
+    n = px.shape[0]
+    while n > 1:
+        h = n // 2
+        lo = tuple(c[:h] for c in pt)
+        hi = tuple(c[h : 2 * h] for c in pt)
+        merged = padd(lo, hi)
+        if n % 2:
+            tail = tuple(c[n - 1 : n] for c in pt)
+            merged = tuple(
+                jnp.concatenate([c[: h - 1], d], axis=0)
+                for c, d in zip(
+                    merged, padd(tuple(c[h - 1 : h] for c in merged), tail)
+                )
+            )
+        pt = merged
+        n = h
+    return pt
+
+
+@functools.lru_cache(maxsize=32)
+def make_aggregate_fn(jit: bool = True):
+    return jax.jit(aggregate_kernel) if jit else aggregate_kernel
+
+
+def aggregate_points(points, width: "int | None" = None):
+    """Host convenience around :func:`aggregate_kernel`: aggregate a
+    list of affine host points (``(x, y)`` tuples / None) on device and
+    return the affine sum (or None).
+
+    ``width`` pads the launch to a fixed lane count (identity rows) so
+    callers with a varying live set — a certifier seeing different
+    quorum sizes per commit — reuse ONE compiled kernel per committee
+    width instead of recompiling per count."""
+    n = len(points)
+    if width is None:
+        width = max(n, 1)
+    if n > width:
+        raise ValueError(f"{n} points exceed launch width {width}")
+    px, py, pz = pack_points(list(points) + [None] * (width - n))
+    mask = np.zeros(width, dtype=np.int32)
+    mask[:n] = 1
+    rx, ry, rz = make_aggregate_fn()(px, py, pz, mask)
+    return unpack_points(rx, ry, rz)[0]
+
+
+@functools.lru_cache(maxsize=8)
+def make_batched_aggregate_fn():
+    """jit(vmap(aggregate_kernel)): B independent masked sums in one
+    launch — [B, N, 30] x3 + [B, N] mask -> [B, 1, 30] x3."""
+    return jax.jit(jax.vmap(aggregate_kernel))
+
+
+class G1SumLauncher:
+    """DeviceWorkQueue launcher for masked G1 sums (the overlay's
+    per-level partial-aggregate merges and any other bitmask-weighted
+    point sums).
+
+    A payload is a list of affine host points; the drain stacks every
+    pending payload into ONE batched (vmapped) aggregation launch at a
+    fixed lane width — submitted with ``generation=level``, so one
+    aggregation level's merges coalesce into a single launch exactly
+    like the verify path's windows do. Results come back as affine host
+    points (None = identity)."""
+
+    kind = "bls.g1sum"
+
+    def __init__(self, width: int):
+        self.width = int(width)
+        #: Lifetime lane accounting (tests / obs report rows).
+        self.launched = 0
+        self.rows = 0
+
+    def launch(self, payloads: list) -> list:
+        width = self.width
+        stacks = []
+        masks = np.zeros((len(payloads), width), dtype=np.int32)
+        for b, pts in enumerate(payloads):
+            pts = list(pts)
+            if len(pts) > width:
+                raise ValueError(
+                    f"{len(pts)} points exceed launch width {width}"
+                )
+            masks[b, : len(pts)] = 1
+            stacks.append(pack_points(pts + [None] * (width - len(pts))))
+        px = np.stack([s[0] for s in stacks])
+        py = np.stack([s[1] for s in stacks])
+        pz = np.stack([s[2] for s in stacks])
+        rx, ry, rz = make_batched_aggregate_fn()(px, py, pz, masks)
+        self.launched += 1
+        self.rows += len(payloads)
+        return unpack_points(
+            np.asarray(rx)[:, 0], np.asarray(ry)[:, 0], np.asarray(rz)[:, 0]
+        )
+
+
+# ------------------------------------------------------------- host packing
+
+
+def recode_scalars(vals) -> np.ndarray:
+    """Python ints (< 2^255) -> [64, N] signed window digits in [-8, 8],
+    window 0 least significant (numpy mirror of the device recoder in
+    :mod:`.ed25519_jax`, host-side because BLS scalars originate on the
+    host)."""
+    vals = [int(v) for v in vals]
+    if any(v < 0 or v >= 1 << 255 for v in vals):
+        raise ValueError("scalar out of range")
+    nib = np.array(
+        [[(v >> (4 * i)) & 0xF for i in range(G1_WINDOWS)] for v in vals],
+        dtype=np.int32,
+    )  # [N, W]
+    digits = np.zeros((G1_WINDOWS, len(vals)), dtype=np.int32)
+    carry = np.zeros(len(vals), dtype=np.int32)
+    for i in range(G1_WINDOWS):
+        d = nib[:, i] + carry
+        carry = (d > 8).astype(np.int32)
+        digits[i] = d - 16 * carry
+    if carry.any():
+        raise ValueError("scalar recode overflow")
+    return digits
+
+
+def pack_points(points) -> tuple:
+    """Affine host points (list of (x, y) int tuples or None) -> device
+    projective Montgomery limb arrays ([N, 30] x3). None packs as the
+    identity."""
+    xs = [0 if p is None else p[0] for p in points]
+    ys = [1 if p is None else p[1] for p in points]
+    zs = [0 if p is None else 1 for p in points]
+    return (
+        np.asarray(fp.to_mont(xs)),
+        np.asarray(fp.to_mont(ys)),
+        np.asarray(fp.to_mont(zs)),
+    )
+
+
+def unpack_points(px, py, pz):
+    """Device projective points -> affine host points ((x, y) or None).
+    Accepts [N, 30] x3 (returns a list) or [30] x3 (returns one)."""
+    X = fp.from_mont(np.asarray(px))
+    Y = fp.from_mont(np.asarray(py))
+    Z = fp.from_mont(np.asarray(pz))
+    single = not isinstance(X, list)
+    if single:
+        X, Y, Z = [X], [Y], [Z]
+    out = []
+    p = fp.P_INT
+    for x, y, z in zip(X, Y, Z):
+        if z == 0:
+            out.append(None)
+            continue
+        zi = pow(z, -1, p)
+        out.append((x * zi % p, y * zi % p))
+    return out[0] if single else out

@@ -1,0 +1,316 @@
+"""Shared limb-arithmetic core for TPU-native big-field arithmetic.
+
+Both device fields — GF(2^255 - 19) (:mod:`.fe25519`) and the BLS12-381
+base field GF(p_381) (:mod:`.fp381`) — use the same representation: a
+field element is a vector of **13-bit limbs in int32**, value =
+sum(l_i * 2^(13 i)). 13 bits is the sweet spot for hardware with no
+64-bit integer multiply: a limb product fits in 26 bits, so a schoolbook
+column accumulating ~20-30 products stays inside int32.
+
+This module holds everything that is *not* specific to one modulus:
+
+- limb packing/unpacking between Python ints and int32 arrays, for any
+  limb count (:func:`to_limbs`, :func:`from_limbs`, vectorized
+  :func:`to_limbs_flat`);
+- the sequential scan carry (:func:`carry_scan`) and the vectorized
+  carry pass (:func:`carry_pass`), both signed-safe (arithmetic shift =
+  floor division);
+- the carry-out fold helper for pseudo-Mersenne moduli
+  (:func:`fold_carry_out`), parameterized by the fold factor
+  (2^260 = 608 mod 2^255-19 for fe25519);
+- the subtraction-bias search (:func:`make_sub_bias`), parameterized by
+  (modulus, limb count, slack bound);
+- a Montgomery-CIOS multiplier factory (:func:`make_montgomery`) for
+  moduli with no usable pseudo-Mersenne structure — BLS12-381's p has
+  no sparse form, so folding 2^390 back down never converges; fp381
+  instead keeps values in the Montgomery domain and interleaves the
+  reduction into the product (one 13-bit digit of the Montgomery
+  quotient per outer step, one vectorized carry pass per step to stay
+  inside int32).
+
+Everything here is shape-static and transparent to jit/vmap/shard_map.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "LIMB_BITS",
+    "LIMB_MASK",
+    "to_limbs",
+    "from_limbs",
+    "to_limbs_flat",
+    "carry_scan",
+    "carry_pass",
+    "carry_pass_keep_top",
+    "fold_carry_out",
+    "make_sub_bias",
+    "make_montgomery",
+]
+
+#: Limb radix shared by every device field: 13 bits in an int32 lane.
+LIMB_BITS = 13
+LIMB_MASK = (1 << LIMB_BITS) - 1
+
+
+# ----------------------------------------------------------------- packing
+
+
+def to_limbs_flat(vals, n_limbs: int) -> np.ndarray:
+    """[n] Python ints -> [n, n_limbs] int32 limbs, vectorized through a
+    byte buffer + unpackbits (the per-int Python limb loop costs
+    ~10us/value — 100ms for one Shamir launch's 11k shares — vs ~2ms
+    here). Values must lie in [0, 2^(13 * n_limbs))."""
+    n = len(vals)
+    total_bits = n_limbs * LIMB_BITS
+    nbytes = (total_bits + 7) // 8
+    try:
+        buf = b"".join(v.to_bytes(nbytes, "little") for v in vals)
+    except OverflowError:
+        raise ValueError("value out of limb range") from None
+    u = np.frombuffer(buf, dtype=np.uint8).reshape(n, nbytes)
+    spare = 8 * nbytes - total_bits
+    if spare and (u[:, -1] >> (8 - spare)).any():
+        raise ValueError("value out of limb range")
+    bits = np.unpackbits(u, axis=1, bitorder="little")[:, :total_bits]
+    weights = (1 << np.arange(LIMB_BITS, dtype=np.int32)).astype(np.int32)
+    return (
+        bits.reshape(n, n_limbs, LIMB_BITS).astype(np.int32) * weights
+    ).sum(axis=2, dtype=np.int32)
+
+
+def to_limbs(x, n_limbs: int) -> np.ndarray:
+    """Python int(s) -> int32 limb array. Accepts a single int (-> shape
+    [n_limbs]) or any nested sequence of ints (-> shape [..., n_limbs]).
+    Values must lie in [0, 2^(13 * n_limbs))."""
+    if isinstance(x, (int,)):
+        if not 0 <= x < 1 << (LIMB_BITS * n_limbs):
+            raise ValueError("value out of limb range")
+        return np.array(
+            [(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(n_limbs)],
+            dtype=np.int32,
+        )
+    x = list(x)
+    if x and isinstance(x[0], int):
+        if any(v < 0 for v in x):
+            raise ValueError("value out of limb range")
+        return to_limbs_flat(x, n_limbs)
+    return np.stack([to_limbs(v, n_limbs) for v in x])
+
+
+def from_limbs(limbs) -> "int | list":
+    """Inverse of :func:`to_limbs` (host-side; accepts device arrays).
+    Signed-safe: negative limbs contribute negatively, so redundant
+    signed representations round-trip to their exact integer value."""
+    a = np.asarray(limbs)
+    if a.ndim == 1:
+        return sum(int(a[i]) << (LIMB_BITS * i) for i in range(a.shape[0]))
+    return [from_limbs(row) for row in a]
+
+
+# ----------------------------------------------------------------- carries
+
+
+def carry_scan(x: jnp.ndarray):
+    """One full sequential carry pass: limbs -> [0, 2^13), returning
+    ``(limbs, carry_out_of_top)``. Works for signed inputs (arithmetic
+    shift = floor division), so it also serves as the borrow-propagating
+    comparison primitive (carry < 0 iff the value is negative).
+
+    Implemented as a lax.scan along the limb axis so the traced graph is
+    one step deep — an unrolled 39-step chain inside a scalar-mult loop
+    made XLA compile times explode."""
+    xs = jnp.moveaxis(x, -1, 0)  # [K, ...batch]
+
+    def step(carry, col):
+        c = col + carry
+        return c >> LIMB_BITS, c & LIMB_MASK
+
+    carry, cols = lax.scan(step, jnp.zeros_like(xs[0]), xs)
+    return jnp.moveaxis(cols, 0, -1), carry
+
+
+def carry_pass(x: jnp.ndarray):
+    """One vectorized carry pass: one shift/mask over the whole limb
+    axis, every limb's carry moved up one position in a single slice
+    shift. Returns ``(limbs, carry_out_of_top)``. Signed-safe (masked
+    residues are non-negative; carries are floor quotients)."""
+    c = x >> LIMB_BITS
+    r = x & LIMB_MASK
+    shifted = jnp.concatenate([jnp.zeros_like(c[..., :1]), c[..., :-1]], axis=-1)
+    return r + shifted, c[..., -1]
+
+
+def carry_pass_keep_top(x: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized carry pass for fields with *no* carry-out fold
+    (Montgomery representation): limbs below the top are masked to
+    [0, 2^13) with carries shifted up one position; the top limb stays
+    unmasked and absorbs the final carry. Callers guarantee the value
+    bound keeps the top limb far inside int32 (for fp381, |value| <
+    2^388 means |top| < 2^11 + carry)."""
+    c = x[..., :-1] >> LIMB_BITS
+    r = x[..., :-1] & LIMB_MASK
+    return jnp.concatenate(
+        [r[..., :1], r[..., 1:] + c[..., :-1], x[..., -1:] + c[..., -1:]],
+        axis=-1,
+    )
+
+
+def fold_carry_out(x: jnp.ndarray, carry: jnp.ndarray, factor: int) -> jnp.ndarray:
+    """Fold a (small) carry that left the top limb back into limb 0 with
+    the given pseudo-Mersenne factor, then ripple the micro-carry. Only
+    meaningful for moduli where 2^(13 * n_limbs) reduces to a small
+    constant (608 for 2^255 - 19); fp381 has no such factor and uses
+    :func:`make_montgomery` instead."""
+    x = x.at[..., 0].add(carry * factor)
+    # One micro ripple is enough: carry*factor < 2^23 adds at most 2^10
+    # carry units into limb 1, which has headroom.
+    c = x[..., 0]
+    x = x.at[..., 0].set(c & LIMB_MASK)
+    x = x.at[..., 1].add(c >> LIMB_BITS)
+    return x
+
+
+# ---------------------------------------------------------------- sub bias
+
+
+def make_sub_bias(p_int: int, n_limbs: int, slack_max: int) -> np.ndarray:
+    """A multiple of ``p_int`` whose (redundant) limb decomposition
+    dominates any invariant-satisfying operand limb-wise, so
+    ``a + bias - b`` has every limb non-negative *before* carrying.
+    Non-negative pre-carry limbs are what lets subtraction normalize
+    with a single vectorized carry pass instead of a sequential
+    borrow-propagating scan.
+
+    Construction: take the natural base-2^13 digits d_i of c*p and lend
+    2^13 from each limb i+1 to limb i (m_0 = d_0 + 2^13, m_i = d_i +
+    2^13 - 1 for interior limbs, m_top = d_top - 1, where d_top is the
+    untruncated top digit). Searching c finds digits big enough that
+    every m_i >= slack_max (the operand limb maximum)."""
+    for c in range(40, 4096):
+        v = c * p_int
+        d = [(v >> (LIMB_BITS * i)) & LIMB_MASK for i in range(n_limbs - 1)]
+        d.append(v >> (LIMB_BITS * (n_limbs - 1)))
+        m = [d[0] + (1 << LIMB_BITS)]
+        m += [d[i] + (1 << LIMB_BITS) - 1 for i in range(1, n_limbs - 1)]
+        m.append(d[n_limbs - 1] - 1)
+        if all(slack_max <= mi < (1 << 16) for mi in m):
+            assert sum(mi << (LIMB_BITS * i) for i, mi in enumerate(m)) == v
+            return np.array(m, dtype=np.int32)
+    raise AssertionError("no subtraction bias found")
+
+
+# -------------------------------------------------------------- Montgomery
+
+
+class Montgomery:
+    """Montgomery-CIOS multiplication over 13-bit int32 limbs for a
+    modulus with no pseudo-Mersenne structure.
+
+    R = 2^(13 n). Values live in the Montgomery domain (x̄ = x*R mod p);
+    :meth:`mul` computes ā*b̄/R = (a*b)*R — the domain is closed under
+    products. Conversion in/out happens host-side via :meth:`encode` /
+    :meth:`decode` (the device never needs R^2: packing is a host int
+    multiply).
+
+    The CIOS loop interleaves reduction into the product: per outer step
+    i it accumulates a_i * b and m_i * p into a running (n+1)-limb
+    accumulator t, where m_i = (t_0 * n0') mod 2^13 zeroes t's low limb
+    (n0' = -p^{-1} mod 2^13), then divides by 2^13 via a one-limb shift.
+    One vectorized carry pass per step keeps every column inside int32:
+
+    - operand limbs (signed) have magnitude <= ~2^13.01 after a pass, so
+      the per-column step adds |a_i*b_j| + m*p_j <= 2*8193^2 ~= 1.35e8;
+    - the accumulator limb steady state is |t_j| <= 8192 + 1.35e8/2^13
+      ~= 2.5e4, keeping columns < 1.4e8 << 2^31.
+
+    Signed operands are handled for free (arithmetic shifts are floor
+    divisions; m is computed from the masked low limb, which is a
+    correct residue for negative t_0 too), which is what lets the field
+    layer above skip subtraction biases entirely: sub is a plain limb
+    subtraction + carry pass, and every value carries a signed magnitude
+    bound |v| < 2^(13 n - 2) that :meth:`mul` contracts back below
+    |ab|/R + p per product.
+    """
+
+    def __init__(self, p_int: int, n_limbs: int):
+        base = 1 << LIMB_BITS
+        if p_int % 2 == 0:
+            raise ValueError("Montgomery requires an odd modulus")
+        if p_int >= 1 << (LIMB_BITS * n_limbs):
+            raise ValueError("modulus exceeds limb capacity")
+        self.p_int = p_int
+        self.n_limbs = n_limbs
+        self.r_int = 1 << (LIMB_BITS * n_limbs)
+        self.r_mod_p = self.r_int % p_int
+        self.r_inv = pow(self.r_int, -1, p_int)
+        self.n0p = (-pow(p_int, -1, base)) % base
+        self.p_limbs = to_limbs(p_int, n_limbs)
+
+    # -- host-side domain conversion
+
+    def encode(self, x: int) -> int:
+        """Standard -> Montgomery domain (host int)."""
+        return (x % self.p_int) * self.r_mod_p % self.p_int
+
+    def decode(self, x: int) -> int:
+        """Montgomery -> standard domain (host int). Accepts the signed
+        redundant values :func:`from_limbs` produces."""
+        return x * self.r_inv % self.p_int
+
+    # -- device kernel
+
+    def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        """CIOS product ā*b̄/R on [..., n_limbs] int32 arrays. Operand
+        contract: |value| < 2^(13 n - 2) with limb magnitudes <= ~2^13.2
+        (what :func:`carry_pass` outputs). Output value is bounded by
+        |a*b|/R + p with limbs <= ~2^13.01 after the two closing passes."""
+        n = self.n_limbs
+        p = jnp.asarray(self.p_limbs, dtype=jnp.int32)
+        batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+        t0 = jnp.zeros((*batch, n + 1), dtype=jnp.int32)
+
+        # The outer CIOS loop runs as a fori_loop rather than a Python
+        # unroll: one traced step instead of n keeps the XLA graph ~n
+        # times smaller, which is what makes the point-arithmetic
+        # kernels stacked on top (12+ muls per G1 add, dozens of adds
+        # per launch) compile in seconds instead of tens of minutes.
+        def step(i, t):
+            a_i = lax.dynamic_slice_in_dim(a, i, 1, axis=-1)
+            t = t.at[..., :n].add(a_i * b)
+            m = ((t[..., 0] & LIMB_MASK) * self.n0p) & LIMB_MASK
+            t = t.at[..., :n].add(m[..., None] * p)
+            # t_0 is now a multiple of 2^13: shift one limb down, exact.
+            carry0 = t[..., 0] >> LIMB_BITS
+            t = jnp.concatenate(
+                [t[..., 1:], jnp.zeros_like(t[..., :1])], axis=-1
+            )
+            t = t.at[..., 0].add(carry0)
+            # One vectorized pass bounds the next step's columns. The
+            # top slot (virtual limb n) accumulates the pass carry; it
+            # is consumed by the next shift-down.
+            c = t[..., :n] >> LIMB_BITS
+            r = t[..., :n] & LIMB_MASK
+            return jnp.concatenate(
+                [r[..., :1], r[..., 1:] + c[..., :-1], t[..., n:] + c[..., -1:]],
+                axis=-1,
+            )
+
+        t = lax.fori_loop(0, n, step, t0)
+        out = t[..., :n]
+        # |result| < |ab|/R + p < 2^(13 n - 5): the top slot is exactly
+        # zero once limbs settle, and the value bound keeps the top limb
+        # tiny, so the closing passes leave it unmasked (no fold exists
+        # to absorb a carry-out).
+        out = carry_pass_keep_top(out)
+        out = carry_pass_keep_top(out)
+        return out
+
+
+def make_montgomery(p_int: int, n_limbs: int) -> Montgomery:
+    """Build the Montgomery context for (modulus, limb count)."""
+    return Montgomery(p_int, n_limbs)

@@ -2,7 +2,8 @@
 
 Computes Q = sum_i [s_i]P_i for a whole batch of points in ONE kernel —
 the reduction engine behind the RLC batch-verify fast path
-(:func:`hyperdrive_tpu.ops.ed25519_jax.rlc_kernel`): instead of walking a
+(:func:`hyperdrive_tpu.ops.ed25519_jax.rlc_kernel`) and the BLS
+aggregate path (:mod:`hyperdrive_tpu.ops.g1`): instead of walking a
 shared Straus ladder whose per-window tree-sum concatenates break XLA
 fusion, the batch is bucketed the classic Pippenger way and every stage
 is a fixed-shape batched point operation.
@@ -15,7 +16,7 @@ Shape of the algorithm (c = 4-bit signed windows, digits in [-8, 8]):
 2. **Bucket accumulation**: lanes are folded into G independent groups
    of g lanes; each group owns 8 buckets (|digit| = 1..8, digit 0 and
    padding fall into a write-only trash slot) and serially folds its g
-   lanes in — every fold is one [G]-wide niels addition plus a one-hot
+   lanes in — every fold is one [G]-wide point addition plus a one-hot
    select/blend, so all groups advance in lock step on the vector units
    and no gather/scatter ever materializes (gathers scatter badly on
    TPU; a [G, 9] one-hot contraction rides the MXU/VPU like the
@@ -28,37 +29,71 @@ Shape of the algorithm (c = 4-bit signed windows, digits in [-8, 8]):
    per-window sums fold high-to-low through the standard 4-doublings
    Horner accumulator.
 
-Cost per lane per window is ~7 field muls (one niels add) plus the
+The planner and engine are **curve-parameterized**: all bucket/group/
+window geometry lives here, while the point representation and its
+add/double/select arithmetic arrive as a :class:`CurveOps` bundle. Two
+instantiations exist — ed25519 (niels entries over extended accumulators
+on the :mod:`.fe25519` layout; built here, used by the RLC kernel) and
+BLS12-381 G1 (complete projective points over :mod:`.fp381`; built in
+:mod:`.g1`). Window counts are derived with :func:`windows_for_bits`
+instead of the historic hardcoded 64/33 split.
+
+Cost per lane per window is ~7 field muls (one mixed add) plus the
 amortized group combine (72/g muls), against the per-signature ladder's
 4 doublings + 2 table adds — the op-count collapse the EdDSA batch-
 verification literature banks on (PAPERS.md: "Performance of EdDSA and
 BLS Signatures in Committee-Based Consensus").
 
-Points are affine extended (z = 1, t = x*y) int32 limb tensors from the
-:mod:`~hyperdrive_tpu.ops.fe25519` layout; the kernel is backend-neutral
-XLA (same dialect as verify_kernel) and is exercised on CPU and TPU
-alike. See /opt guides' Pallas notes for why the inner loop avoids
-data-dependent addressing entirely.
+The kernel is backend-neutral XLA (same dialect as verify_kernel) and is
+exercised on CPU and TPU alike. See /opt guides' Pallas notes for why
+the inner loop avoids data-dependent addressing entirely.
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
 
 import jax.numpy as jnp
 from jax import lax
 
 from hyperdrive_tpu.ops import fe25519 as fe
-from hyperdrive_tpu.ops.ed25519_jax import (
-    _add_ext,
-    _dbl,
-    _identity_rows,
-    _madd,
-)
 
-__all__ = ["msm_kernel", "plan_groups", "msm_plan"]
+__all__ = [
+    "msm_kernel",
+    "msm_engine",
+    "plan_groups",
+    "msm_plan",
+    "windows_for_bits",
+    "CurveOps",
+    "WINDOW_BITS",
+    "ED25519_FULL_WINDOWS",
+    "ED25519_HALF_WINDOWS",
+]
+
+#: Signed window width in bits; digits live in [-8, 8].
+WINDOW_BITS = 4
 
 #: Signed 4-bit windows: |digit| <= 8, bucket values 1..8 plus the
 #: write-only trash slot at index 0 (digit 0 / padding lanes land there).
-N_BUCKETS = 8
+N_BUCKETS = 1 << (WINDOW_BITS - 1)
+
+
+def windows_for_bits(bits: int, window_bits: int = WINDOW_BITS) -> int:
+    """Window count covering a ``bits``-wide scalar with signed digits.
+
+    Signed recoding needs the top digit's carry headroom, so callers
+    quote the scalar bound's bit width (e.g. 253 for clamped ed25519
+    scalars, 255 for the BLS12-381 group order, 129 for half-width RLC
+    coefficients including their carry bit)."""
+    return -(-bits // window_bits)
+
+
+#: The ed25519 RLC geometry, formerly hardcoded as 64/33: full-width
+#: scalars are < 2^253 (recode precondition), half-width Fiat-Shamir
+#: coefficients are < 2^128 plus one carry bit.
+ED25519_FULL_WINDOWS = windows_for_bits(253)  # 64
+ED25519_HALF_WINDOWS = windows_for_bits(129)  # 33
 
 
 def plan_groups(n: int) -> tuple[int, int]:
@@ -79,14 +114,16 @@ def plan_groups(n: int) -> tuple[int, int]:
     return G, g
 
 
-def msm_plan(n: int, windows: int) -> dict:
-    """Static launch geometry for observability (`verify.msm.*` events)
-    and benchmarks: window count, bucket occupancy denominator, and the
-    reduction depth (combine-tree levels + bucket suffix chain)."""
+def msm_plan(n: int, windows: int, curve: str = "ed25519") -> dict:
+    """Static launch geometry for observability (`verify.msm.*` /
+    `bls.aggregate.*` events) and benchmarks: window count, bucket
+    occupancy denominator, and the reduction depth (combine-tree levels
+    + bucket suffix chain)."""
     G, g = plan_groups(n)
     depth = (G - 1).bit_length() + (N_BUCKETS - 1)
     padded = G * g
     return {
+        "curve": curve,
         "windows": windows,
         "groups": G,
         "group_size": g,
@@ -100,6 +137,89 @@ def msm_plan(n: int, windows: int) -> dict:
     }
 
 
+# ------------------------------------------------------------- curve bundle
+
+
+@dataclass(frozen=True)
+class CurveOps:
+    """The arithmetic a curve plugs into the Pippenger engine.
+
+    Accumulators and entries are tuples of [..., n_limbs] int32 arrays;
+    the engine never inspects their arity, so mixed representations
+    (ed25519: niels entries into extended accumulators) cost nothing.
+
+    Attributes:
+      n_limbs:        limbs per field element (20 for fe25519, 30 for
+                      fp381)
+      acc_identity:   batch-prefix -> identity accumulator tuple
+      bucket_identity: G -> [G, N_BUCKETS+1, L] identity bucket tuple
+      entry_select:   (sign_mask, entry_tuple) -> entry or its negation
+      add_entry:      (acc_tuple, entry_tuple) -> acc_tuple  (mixed add)
+      add:            (acc_tuple, acc_tuple) -> acc_tuple    (full add)
+      window_shift:   acc_tuple -> acc_tuple  (WINDOW_BITS doublings)
+    """
+
+    n_limbs: int
+    acc_identity: Callable
+    bucket_identity: Callable
+    entry_select: Callable
+    add_entry: Callable
+    add: Callable
+    window_shift: Callable
+
+
+def _ed25519_ops() -> CurveOps:
+    from hyperdrive_tpu.ops.ed25519_jax import (
+        _add_ext,
+        _dbl,
+        _identity_rows,
+        _madd,
+    )
+
+    def bucket_identity(G: int):
+        zero = jnp.zeros((G, N_BUCKETS + 1, fe.N_LIMBS), dtype=jnp.int32)
+        one = jnp.broadcast_to(
+            jnp.asarray(fe.ONE, dtype=jnp.int32),
+            (G, N_BUCKETS + 1, fe.N_LIMBS),
+        )
+        return (zero, one, one, zero)
+
+    def entry_select(sign, entry):
+        # Negate a niels point: swap the (y+x, y-x) pair, negate 2d*t.
+        yp, ym, t2 = entry
+        return (
+            fe.select(sign, ym, yp),
+            fe.select(sign, yp, ym),
+            fe.select(sign, fe.neg(t2), t2),
+        )
+
+    def window_shift(acc):
+        acc3 = acc[:3]
+        for _ in range(3):
+            acc3 = _dbl(acc3, need_t=False)
+        return _dbl(acc3, need_t=True)
+
+    return CurveOps(
+        n_limbs=fe.N_LIMBS,
+        acc_identity=_identity_rows,
+        bucket_identity=bucket_identity,
+        entry_select=entry_select,
+        add_entry=lambda acc, entry: _madd(acc, entry, need_t=True),
+        add=lambda a, b: _add_ext(a, b, need_t=True),
+        window_shift=window_shift,
+    )
+
+
+_ED25519_OPS = None
+
+
+def ed25519_curve_ops() -> CurveOps:
+    global _ED25519_OPS
+    if _ED25519_OPS is None:
+        _ED25519_OPS = _ed25519_ops()
+    return _ED25519_OPS
+
+
 def _niels_affine(px, py, pt):
     """Affine point batch -> niels components (y+x, y-x, 2d*t)."""
     from hyperdrive_tpu.ops.ed25519_jax import _K2D_LIMBS
@@ -108,20 +228,16 @@ def _niels_affine(px, py, pt):
     return (fe.add(py, px), fe.sub(py, px), fe.mul(pt, k2d))
 
 
-def _accumulate_window(digits_w, niels_r, G: int, g: int):
+# ------------------------------------------------------------------ engine
+
+
+def _accumulate_window(digits_w, entries_r, G: int, g: int, ops: CurveOps):
     """One window's bucket accumulation: fold g lanes into each of G
     groups' 9-slot bucket arrays (slot 0 = trash). ``digits_w``: [G, g]
-    signed; ``niels_r``: niels components reshaped [G, g, 20]. Returns
-    extended bucket components, each [G, 9, 20]."""
-    yp_r, ym_r, t2_r = niels_r
+    signed; ``entries_r``: entry components reshaped [G, g, L]. Returns
+    accumulator-representation buckets, each component [G, 9, L]."""
     lanes9 = jnp.arange(N_BUCKETS + 1, dtype=jnp.int32)
-
-    zero = jnp.zeros((G, N_BUCKETS + 1, fe.N_LIMBS), dtype=jnp.int32)
-    one = jnp.broadcast_to(
-        jnp.asarray(fe.ONE, dtype=jnp.int32),
-        (G, N_BUCKETS + 1, fe.N_LIMBS),
-    )
-    buckets = (zero, one, one, zero)
+    buckets = ops.bucket_identity(G)
 
     def lane_step(j, buckets):
         d = lax.dynamic_slice_in_dim(digits_w, j, 1, axis=1)[:, 0]  # [G]
@@ -131,17 +247,14 @@ def _accumulate_window(digits_w, niels_r, G: int, g: int):
         cur = tuple(
             jnp.einsum("gv,gvl->gl", oh, comp) for comp in buckets
         )
-        # This lane's niels entry, negated when the digit is (swap the
-        # y+-x pair, negate the 2d*t component — as _select_signed).
-        yp = lax.dynamic_slice_in_dim(yp_r, j, 1, axis=1)[:, 0]
-        ym = lax.dynamic_slice_in_dim(ym_r, j, 1, axis=1)[:, 0]
-        t2 = lax.dynamic_slice_in_dim(t2_r, j, 1, axis=1)[:, 0]
-        entry = (
-            fe.select(sign, ym, yp),
-            fe.select(sign, yp, ym),
-            fe.select(sign, fe.neg(t2), t2),
+        entry = ops.entry_select(
+            sign,
+            tuple(
+                lax.dynamic_slice_in_dim(c, j, 1, axis=1)[:, 0]
+                for c in entries_r
+            ),
         )
-        new = _madd(cur, entry, need_t=True)  # [G, 20] x4
+        new = ops.add_entry(cur, entry)  # [G, L] per component
         # Write back: blend the updated bucket into its slot only.
         mask = oh[:, :, None] == 1
         return tuple(
@@ -152,39 +265,79 @@ def _accumulate_window(digits_w, niels_r, G: int, g: int):
     return lax.fori_loop(0, g, lane_step, buckets)
 
 
-def _combine_groups(buckets, G: int):
-    """Halving tree over the group axis: [G, 9, 20] components -> [8, 20]
+def _combine_groups(buckets, G: int, ops: CurveOps):
+    """Halving tree over the group axis: [G, 9, L] components -> [8, L]
     (the trash slot is dropped before the first level)."""
-    comps = tuple(comp[:, 1:] for comp in buckets)  # [G, 8, 20]
+    comps = tuple(comp[:, 1:] for comp in buckets)  # [G, 8, L]
     m = G
     while m > 1:
         h = m // 2
-        comps = _add_ext(
+        comps = ops.add(
             tuple(c[:h] for c in comps),
             tuple(c[h:m] for c in comps),
-            need_t=True,
         )
         m = h
-    return tuple(c[0] for c in comps)  # [8, 20] x4
+    return tuple(c[0] for c in comps)  # [8, L] per component
 
 
-def _bucket_reduce(buckets8):
+def _bucket_reduce(buckets8, ops: CurveOps):
     """sum_v v*S_v via suffix sums: runtot = S_8 + ... + S_v accumulates
     into the window sum with 2*(buckets-1) width-1 additions."""
+
     def slot(v):
-        return tuple(c[v - 1 : v] for c in buckets8)  # [1, 20] x4
+        return tuple(c[v - 1 : v] for c in buckets8)  # [1, L] each
 
     runtot = slot(N_BUCKETS)
     wsum = runtot
     for v in range(N_BUCKETS - 1, 0, -1):
-        runtot = _add_ext(runtot, slot(v), need_t=True)
-        wsum = _add_ext(wsum, runtot, need_t=True)
+        runtot = ops.add(runtot, slot(v))
+        wsum = ops.add(wsum, runtot)
     return wsum
 
 
+def msm_engine(entries, digits, ops: CurveOps):
+    """sum_i [s_i]P_i for any curve: the geometry/bucketing engine.
+
+    Args:
+      entries: tuple of [N, L] int32 entry components (curve-specific
+               representation; see :class:`CurveOps`)
+      digits:  [W, N] signed window digits in [-WINDOW_BITS^2/2 ..], via
+               the caller's recoder; window 0 least significant
+      ops:     the curve's arithmetic bundle
+    Returns: the sum in the curve's accumulator representation, batch 1.
+
+    Padding lanes are free: a zero digit routes its (arbitrary) point to
+    the trash bucket, so callers pad with anything shape-compatible.
+    """
+    n = entries[0].shape[0]
+    windows = digits.shape[0]
+    G, g = plan_groups(n)
+    pad = G * g - n
+
+    if pad:
+        zrow = jnp.zeros((pad, ops.n_limbs), dtype=jnp.int32)
+        entries = tuple(jnp.concatenate([c, zrow]) for c in entries)
+        digits = jnp.concatenate(
+            [digits, jnp.zeros((windows, pad), dtype=digits.dtype)], axis=1
+        )
+    entries_r = tuple(c.reshape(G, g, ops.n_limbs) for c in entries)
+    digits_r = digits.reshape(windows, G, g)
+
+    def window_body(i, acc):
+        w = windows - 1 - i
+        # Horner shift: one window = WINDOW_BITS doublings.
+        acc = ops.window_shift(acc)
+        dw = lax.dynamic_slice_in_dim(digits_r, w, 1, axis=0)[0]  # [G, g]
+        buckets = _accumulate_window(dw, entries_r, G, g, ops)
+        wsum = _bucket_reduce(_combine_groups(buckets, G, ops), ops)
+        return ops.add(acc, wsum)
+
+    return lax.fori_loop(0, windows, window_body, ops.acc_identity(1))
+
+
 def msm_kernel(px, py, pt, digits):
-    """sum_i [s_i]P_i over affine extended points, scalars pre-decomposed
-    to signed 4-bit windows.
+    """sum_i [s_i]P_i over affine extended ed25519 points, scalars
+    pre-decomposed to signed 4-bit windows.
 
     Args (all int32):
       px, py, pt: [N, 20] affine extended coords (z = 1, t = x*y mod p)
@@ -192,35 +345,5 @@ def msm_kernel(px, py, pt, digits):
                   significant (the caller recodes nibbles; see
                   ``_recode_signed``)
     Returns: the sum as an extended projective point, [1, 20] x4.
-
-    Padding lanes are free: a zero digit routes its (arbitrary) point to
-    the trash bucket, so callers pad with anything shape-compatible.
     """
-    n = px.shape[0]
-    windows = digits.shape[0]
-    G, g = plan_groups(n)
-    pad = G * g - n
-
-    niels = _niels_affine(px, py, pt)
-    if pad:
-        zrow = jnp.zeros((pad, fe.N_LIMBS), dtype=jnp.int32)
-        niels = tuple(jnp.concatenate([c, zrow]) for c in niels)
-        digits = jnp.concatenate(
-            [digits, jnp.zeros((windows, pad), dtype=digits.dtype)], axis=1
-        )
-    niels_r = tuple(c.reshape(G, g, fe.N_LIMBS) for c in niels)
-    digits_r = digits.reshape(windows, G, g)
-
-    def window_body(i, acc):
-        w = windows - 1 - i
-        # Horner shift: one 4-bit window = four doublings (T on the last).
-        acc3 = acc[:3]
-        for _ in range(3):
-            acc3 = _dbl(acc3, need_t=False)
-        acc = _dbl(acc3, need_t=True)
-        dw = lax.dynamic_slice_in_dim(digits_r, w, 1, axis=0)[0]  # [G, g]
-        buckets = _accumulate_window(dw, niels_r, G, g)
-        wsum = _bucket_reduce(_combine_groups(buckets, G))
-        return _add_ext(acc, wsum, need_t=True)
-
-    return lax.fori_loop(0, windows, window_body, _identity_rows(1))
+    return msm_engine(_niels_affine(px, py, pt), digits, ed25519_curve_ops())

@@ -142,6 +142,15 @@ class OverlayConfig:
     #: time-based forgiveness the hole can exceed what contribution
     #: credit alone can refill before the run ends.
     heal_rate: int = 6
+    #: Frames carry real BLS partial aggregates: each signer's G1
+    #: partial over its vote digest enters the global table alongside
+    #: the vote, every frame's mask is accompanied by the 48-byte
+    #: compressed sum of the covered partials, and the receiver
+    #: recomputes that sum (batched through the device queue's G1-sum
+    #: launcher, generation=level) BEFORE merging coverage — a garbled
+    #: partial aggregate charges its contributor at the merge level,
+    #: without ever reaching the signature batch-verify.
+    bls_partials: bool = False
 
     def validate(self, n: int) -> None:
         if self.fanout < 1 or self.fallback_fanout < 1:
@@ -159,13 +168,15 @@ class OverlayConfig:
 class OverlayFrame:
     """One partial-aggregate message: contributor ``src``'s coverage of
     ``slot`` as a signer bitmask, plus any out-of-table ``extras``
-    (only Byzantine injection produces those). Never recorded."""
+    (only Byzantine injection produces those). ``agg`` is the 48-byte
+    compressed BLS partial aggregate over the mask's covered partials
+    (``bls_partials`` runs; None otherwise). Never recorded."""
 
     __slots__ = ("src", "slot", "level", "mask", "extras", "reciprocal",
-                 "fallback")
+                 "fallback", "agg")
 
     def __init__(self, src, slot, level, mask, extras=(),
-                 reciprocal=False, fallback=False):
+                 reciprocal=False, fallback=False, agg=None):
         self.src = src
         self.slot = slot
         self.level = level
@@ -173,6 +184,7 @@ class OverlayFrame:
         self.extras = extras
         self.reciprocal = reciprocal
         self.fallback = fallback
+        self.agg = agg
 
     @property
     def height(self):
@@ -195,10 +207,11 @@ class _SlotState:
 
     __slots__ = ("votes", "all_mask", "verified", "cov", "t0", "tick_idx",
                  "armed", "done", "fb_pos", "waves", "dcount", "heard",
-                 "charged", "recip", "frames_seen")
+                 "charged", "recip", "frames_seen", "bls")
 
     def __init__(self, n: int, levels: int):
         self.votes: dict = {}          # signer slot -> verified-or-own vote
+        self.bls: dict = {}            # signer slot -> BLS partial (G1 affine)
         self.all_mask = 0              # union of table bits
         self.verified = 0              # bits verified once network-wide
         self.cov = [0] * n             # per-node coverage bitmask
@@ -253,6 +266,7 @@ class OverlayRuntime:
         sched=None,       # DeviceWorkQueue (required when verifier is set)
         obs=None,
         registry=None,
+        bls_keyring=None,  # identity -> BlsKeyPair (bls_partials runs)
     ):
         config.validate(n)
         self.config = config
@@ -279,6 +293,21 @@ class OverlayRuntime:
             raise ValueError("overlay verification requires a device queue")
         self._obs = obs
         self._reg = registry
+        #: BLS partial-aggregate plumbing (config.bls_partials): the
+        #: shared committee keyring signs each own-vote's digest into
+        #: the global table; masked partial sums ride every frame. With
+        #: a device queue the sums run through the G1SumLauncher
+        #: (generation=level, so one level's merges coalesce); without
+        #: one they fold on host — byte-identical aggregates either way.
+        self._bls_keyring = bls_keyring
+        self._bls_launcher = None
+        if bls_keyring is not None and sched is not None:
+            from hyperdrive_tpu.ops.g1 import G1SumLauncher
+
+            width = 1
+            while width < max(n, 1):
+                width *= 2
+            self._bls_launcher = G1SumLauncher(width)
         self._byz_rng = random.Random((self.seed << 1) ^ _BYZ_SALT)
         self._faults = config.faults
         self._byz = frozenset(self._faults.byzantine) if self._faults else frozenset()
@@ -311,6 +340,8 @@ class OverlayRuntime:
         self.fallback_engaged = 0
         self.windows_exhausted = 0
         self.rekeys = 0
+        self.bls_partials_attached = 0
+        self.bls_partial_rejects = 0
 
     # -------------------------------------------------------------- events
 
@@ -380,6 +411,16 @@ class OverlayRuntime:
             st.votes[idx] = vote
             bit = 1 << idx
             st.all_mask |= bit
+            if self._bls_keyring is not None:
+                # The signer's BLS partial enters the global table with
+                # the vote (in a deployment it rides the vote message).
+                # Bits added through the Byzantine extras path never
+                # gain a partial, so per bit the has-partial status is
+                # fixed at insertion — sender and receiver of any frame
+                # always sum the identical subset.
+                kp = self._bls_keyring.get(vote.sender)
+                if kp is not None:
+                    st.bls[idx] = kp.sign(vote.digest())
             # NOT marked verified: the signer trusts its own vote (its
             # replica ingests it directly), but the first frame carrying
             # it to anyone else pays the one network-wide device
@@ -423,6 +464,24 @@ class OverlayRuntime:
         phantom = frame.mask & ~st.all_mask
         if phantom:
             self._charge(src, "invalid", slot, to)
+        # Merge-level BLS check: recompute the masked partial sum and
+        # compare against the frame's aggregate BEFORE any coverage is
+        # merged or any signature batch-verified. A garbled partial
+        # aggregate charges the CONTRIBUTOR here and drops the frame —
+        # the poisoned aggregate never propagates and never costs a
+        # verify launch.
+        if self._bls_keyring is not None and frame.mask:
+            expect = self._bls_masked_sum(
+                st, frame.mask & st.all_mask, frame.level, to
+            )
+            if frame.agg != expect:
+                self.bls_partial_rejects += 1
+                self._count("overlay.bls.reject")
+                if self._obs is not None:
+                    self._obs.emit("bls.partial.reject", to, slot[1],
+                                   slot[2], f"src={src}:lvl={frame.level}")
+                self._charge(src, "invalid", slot, to)
+                return
         new = frame.mask & st.all_mask & ~st.cov[to]
         if new:
             pending = new & ~st.verified
@@ -565,8 +624,13 @@ class OverlayRuntime:
         mask = st.cov[node]
         if not mask:
             return
+        agg = None
+        if self._bls_keyring is not None:
+            agg = self._bls_masked_sum(st, mask, level, node)
+            self.bls_partials_attached += 1
         frame = OverlayFrame(node, slot, level, mask,
-                             reciprocal=reciprocal, fallback=fallback)
+                             reciprocal=reciprocal, fallback=fallback,
+                             agg=agg)
         self.frames_sent += 1
         self._count("overlay.frames")
         if reciprocal:
@@ -579,7 +643,24 @@ class OverlayRuntime:
 
     def _send_garbage(self, node: int, peer: int, slot, level: int) -> None:
         """A Byzantine partial aggregate: zero real coverage, fabricated
-        votes that the device verify mask will reject row-by-row."""
+        votes that the device verify mask will reject row-by-row — or,
+        on BLS runs, a frame claiming the contributor's REAL coverage
+        under a corrupted partial aggregate, which the receiver's
+        merge-level sum check must catch before any verify launch."""
+        st = self._slots.get(slot)
+        if (self._bls_keyring is not None and st is not None
+                and st.cov[node] and self._byz_rng.random() < 0.5):
+            mask = st.cov[node]
+            good = self._bls_masked_sum(st, mask, level, node)
+            bad = (bytes([good[0] ^ 0x01]) + good[1:]) if good \
+                else b"\xff" * 48
+            frame = OverlayFrame(node, slot, level, mask, agg=bad)
+            self.frames_sent += 1
+            self.frames_garbage += 1
+            self._count("overlay.frames")
+            self._count("overlay.frames.garbage")
+            self._enqueue(peer, frame)
+            return
         self._garbage_ctr += 1
         cls = _VOTE_CLS[slot[0]]
         stale = None
@@ -677,6 +758,29 @@ class OverlayRuntime:
 
     # ---------------------------------------------------------- verification
 
+    def _bls_masked_sum(self, st: _SlotState, mask: int, level: int,
+                        origin: int) -> bytes:
+        """Compressed G1 sum of the table partials covered by ``mask``
+        (bits without a partial — extras-path insertions — are excluded
+        on both the sending and receiving side, so the subset is always
+        identical). Device-batched through the queue when a launcher is
+        installed; host fold otherwise."""
+        pts = [st.bls[i] for i in _bits(mask) if i in st.bls]
+        from hyperdrive_tpu.crypto import bls
+
+        if not pts:
+            return b""
+        if self._bls_launcher is not None:
+            fut = self._sched.submit(
+                self._bls_launcher, pts,
+                generation=level, origin=origin, rows=len(pts),
+            )
+            self._sched.drain()
+            agg = fut.result()
+        else:
+            agg = bls.aggregate_signatures(pts)
+        return bls.g1_compress(agg)
+
     def _verify_mask(self, st: _SlotState, pending: int, level: int,
                      origin: int) -> int:
         idxs = list(_bits(pending))
@@ -742,6 +846,9 @@ class OverlayRuntime:
             "fallback_engaged": self.fallback_engaged,
             "windows_exhausted": self.windows_exhausted,
             "rekeys": self.rekeys,
+            "bls_partials": self._bls_keyring is not None,
+            "bls_partials_attached": self.bls_partials_attached,
+            "bls_partial_rejects": self.bls_partial_rejects,
             "live_slots": len(self._slots),
             "scores": self.scores.snapshot(),
             "honest_demoted": self.honest_demoted(),

@@ -1,7 +1,7 @@
 """HD005 fixture: closed-family emit literals must be in EVENT_KINDS.
 
 Well-formed lowercase dotted names that sit under the closed event
-families (sched.launch.*, verify.occupancy.*, metrics.*) but are not
+families (sched.launch.*, verify.occupancy.*, metrics.*, bls.*) but are not
 members of the recorder taxonomy are silent forks — the grep-based
 journal test only audits files it covers, the lint covers the rest.
 """
@@ -21,10 +21,15 @@ class Pipeline:
     def bad_unknown_metrics(self):
         self.recorder.emit("metrics.flush", -1, -1, -1, 0)  # BAD: fork
 
+    def bad_unknown_bls(self, h):
+        self.obs.emit("bls.cert.minted", -1, h, -1, 0)  # BAD: fork
+
     def good_taxonomy_members(self, lid, pct):
         self.obs.emit("sched.launch.begin", -2, -1, -1, lid)
         self.obs.emit("verify.occupancy.pct", -1, -1, -1, pct)
         self.obs.emit("metrics.snapshot", -1, -1, -1, 0)
+        self.obs.emit("bls.cert.agg", -1, -1, -1, 0)
+        self.obs.emit("bls.partial.reject", -1, -1, -1, 0)
 
     def good_open_family(self):
         # Families outside the closed prefixes stay grep-audited only:

@@ -1,0 +1,256 @@
+"""BLS12-381 device path: fp381 field arithmetic and the G1 kernels
+against the pure-Python host reference, plus the aggregate-signature
+protocol layer.
+
+The discipline mirrors tests/test_msm.py: every device result is pinned
+to the serial host arithmetic (crypto/bls.py) on random inputs, with
+the degenerate cases the complete RCB16 formulas must absorb branch-
+free — P+P, P+(-P), P+O, O+O, identity rows, zero scalars, masked-out
+lanes — exercised explicitly. The compressed generator doubles as a
+conformance anchor: it must land on the standard ZCash-format encoding
+of the BLS12-381 G1 generator, so the field, curve constants, and
+compression agree with every other implementation of the curve
+(PARITY.md "BLS aggregation").
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from hyperdrive_tpu.crypto import bls
+from hyperdrive_tpu.ops import fp381 as fp
+from hyperdrive_tpu.ops import g1 as g1k
+
+_N = 8
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(0xB15)
+
+
+@pytest.fixture(scope="module")
+def points(rng):
+    return [bls.g1_mul(bls.G1_GEN, rng.randrange(1, bls.R_ORDER))
+            for _ in range(_N)]
+
+
+def _host_masked_sum(points, mask):
+    acc = None
+    for p, m in zip(points, mask):
+        if m and p is not None:
+            acc = p if acc is None else bls.g1_add(acc, p)
+    return acc
+
+
+# --------------------------------------------------------------- field
+
+
+def test_fp381_matches_python_ints(rng):
+    xs = [rng.randrange(fp.P_INT) for _ in range(_N)]
+    ys = [rng.randrange(fp.P_INT) for _ in range(_N)]
+    a = np.stack([fp.to_mont(x) for x in xs])
+    b = np.stack([fp.to_mont(y) for y in ys])
+    assert fp.from_mont(fp.mul(a, b)) == [
+        x * y % fp.P_INT for x, y in zip(xs, ys)
+    ]
+    assert fp.from_mont(fp.sqr(a)) == [x * x % fp.P_INT for x in xs]
+    # canonical() leaves the Montgomery domain (x-bar/R), so unpack the
+    # result with from_limbs, not from_mont.
+    assert fp.from_limbs(fp.canonical(fp.add(a, b))) == [
+        (x + y) % fp.P_INT for x, y in zip(xs, ys)
+    ]
+    assert fp.from_limbs(fp.canonical(fp.sub(a, b))) == [
+        (x - y) % fp.P_INT for x, y in zip(xs, ys)
+    ]
+    assert fp.from_limbs(fp.canonical(fp.neg(a))) == [
+        (-x) % fp.P_INT for x in xs
+    ]
+    assert fp.from_mont(fp.mul_small(a, 12)) == [
+        12 * x % fp.P_INT for x in xs
+    ]
+
+
+def test_fp381_mont_roundtrip_edges():
+    for v in (0, 1, 2, fp.P_INT - 1, fp.P_INT - 2, (fp.P_INT - 1) // 2):
+        assert fp.from_mont(fp.to_mont(v)) == v
+        assert fp.from_limbs(fp.to_limbs(v)) == v
+
+
+def test_fp381_mul_chain_stays_in_invariant(rng):
+    # The G1 formulas feed sums of up to 8 field elements back into mul
+    # (pdbl's 8*Y^2 term); a chain of scaled adds between muls must not
+    # overflow the signed-redundancy envelope.
+    x = rng.randrange(fp.P_INT)
+    a = fp.to_mont(x)
+    acc = a
+    for _ in range(3):  # 8x growth per round via three doublings
+        acc = fp.add(acc, acc)
+    assert fp.from_mont(fp.mul(acc, a)) == 8 * x * x % fp.P_INT
+
+
+# --------------------------------------------------------------- curve
+
+
+def test_generator_compresses_to_standard_encoding():
+    # The ZCash-format compressed G1 generator — agreeing with this
+    # 48-byte string means the field prime, curve constants, Montgomery
+    # encode/decode, and compression all match the published curve.
+    assert bls.g1_compress(bls.G1_GEN).hex() == (
+        "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+        "6c55e83ff97a1aeffb3af00adb22c6bb"
+    )
+    assert bls.g1_compress(None)[0] == 0xC0  # infinity flag
+
+
+def test_padd_matches_host_pairwise(points):
+    import jax
+
+    px = g1k.pack_points(points)
+    qx = g1k.pack_points(points[1:] + points[:1])
+    got = g1k.unpack_points(*jax.jit(g1k.padd)(px, qx))
+    for i in range(_N):
+        assert got[i] == bls.g1_add(points[i], points[(i + 1) % _N])
+
+
+def test_padd_complete_formula_edges(points):
+    import jax
+
+    p = g1k.pack_points(points)
+    neg = g1k.pack_points([bls.g1_neg(q) for q in points])
+    ident = g1k.pack_points([None] * _N)
+    padd = jax.jit(g1k.padd)
+    # P + P must fall into the doubling case with the same instructions
+    got = g1k.unpack_points(*padd(p, p))
+    assert got == [bls.g1_double(q) for q in points]
+    # P + (-P) = O
+    assert all(q is None for q in g1k.unpack_points(*padd(p, neg)))
+    # P + O = P, O + O = O
+    assert g1k.unpack_points(*padd(p, ident)) == points
+    assert all(q is None for q in g1k.unpack_points(*padd(ident, ident)))
+
+
+def test_pdbl_matches_host(points):
+    import jax
+
+    pdbl = jax.jit(g1k.pdbl)
+    got = g1k.unpack_points(*pdbl(g1k.pack_points(points)))
+    assert got == [bls.g1_double(q) for q in points]
+    ident = g1k.pack_points([None] * _N)
+    assert all(q is None for q in g1k.unpack_points(*pdbl(ident)))
+
+
+def test_recode_scalars_digits_reconstruct(rng):
+    ks = [rng.randrange(bls.R_ORDER) for _ in range(4)] + [0, 1]
+    digits = g1k.recode_scalars(ks)
+    assert digits.shape == (g1k.G1_WINDOWS, len(ks))
+    assert int(abs(digits).max()) <= 8
+    for j, k in enumerate(ks):
+        assert sum(
+            int(digits[w, j]) << (4 * w) for w in range(g1k.G1_WINDOWS)
+        ) == k
+
+
+def test_recode_scalars_rejects_oversize():
+    with pytest.raises(ValueError):
+        g1k.recode_scalars([1 << 255])
+
+
+@pytest.mark.slow  # the CI bls-parity smoke runs this exact differential
+def test_g1_msm_matches_host(rng, points):
+    import jax
+
+    ks = [rng.randrange(bls.R_ORDER) for _ in range(_N)]
+    ks[0] = 0
+    px, py, pz = g1k.pack_points(points)
+    kern = jax.jit(g1k.g1_msm_kernel)
+    got = g1k.unpack_points(*kern(px, py, pz, g1k.recode_scalars(ks)))[0]
+    acc = None
+    for p, k in zip(points, ks):
+        acc = bls.g1_add(acc, bls.g1_mul(p, k))
+    assert got == acc
+    # all-zero scalars -> identity
+    zero = g1k.recode_scalars([0] * _N)
+    assert g1k.unpack_points(*kern(px, py, pz, zero))[0] is None
+
+
+@pytest.mark.parametrize("n", [1, 5, 8])
+def test_aggregate_tree_matches_host_fold(rng, points, n):
+    sub = points[:n]
+    mask = [rng.randrange(2) for _ in range(n)]
+    got = g1k.aggregate_points(
+        [p if m else None for p, m in zip(sub, mask)]
+    )
+    assert got == _host_masked_sum(sub, mask)
+
+
+def test_aggregate_tree_all_masked_out_is_identity(points):
+    assert g1k.aggregate_points([None] * 5) is None
+
+
+def test_aggregate_pads_to_fixed_width(points):
+    # width > len(points): identity padding must not change the sum
+    got = g1k.aggregate_points(points[:3], width=8)
+    assert got == _host_masked_sum(points[:3], [1, 1, 1])
+
+
+def test_g1sum_launcher_batches_one_launch(points):
+    from hyperdrive_tpu.devsched.queue import DeviceWorkQueue
+
+    queue = DeviceWorkQueue()
+    launcher = g1k.G1SumLauncher(width=8)
+    futs = [
+        queue.submit(launcher, points[i : i + 4], generation=0,
+                     rows=4)
+        for i in range(0, _N, 4)
+    ]
+    queue.drain()
+    got = [f.result() for f in futs]
+    assert got == [
+        _host_masked_sum(points[i : i + 4], [1] * 4)
+        for i in range(0, _N, 4)
+    ]
+    assert launcher.launched == 1  # both payloads coalesced into one
+
+
+# ------------------------------------------------------------ protocol
+
+
+def test_sign_aggregate_verify_and_forgery(points):
+    kps = [bls.bls_keypair_from_identity(b"bls-%d" % i) for i in range(3)]
+    msg = b"hd-bls-commit"
+    agg = bls.aggregate_signatures([kp.sign(msg) for kp in kps])
+    pks = [kp.pk for kp in kps]
+    assert bls.verify_aggregate_same_message(pks, msg, agg)
+    assert not bls.verify_aggregate_same_message(pks, b"forged", agg)
+
+
+def test_device_aggregate_equals_host_aggregate():
+    kps = [bls.bls_keypair_from_identity(b"agg-%d" % i) for i in range(5)]
+    sigs = [kp.sign(b"m") for kp in kps]
+    host = bls.g1_compress(bls.aggregate_signatures(sigs))
+    dev = bls.g1_compress(g1k.aggregate_points(sigs))
+    assert host == dev
+
+
+def test_pinned_self_generated_vectors():
+    # Frozen outputs of this repo's own keygen/sign path: any change to
+    # the HKDF keygen, hash-to-curve, or compression is a wire break
+    # for every stored certificate and must show up here first.
+    kp = bls.bls_keypair_from_identity(b"hd-bls-test-vector")
+    assert kp.pk_bytes.hex() == (
+        "b725489b6c05dfba5b0c10621913bb19637f12524da91b1a25f47af5beea8b8e"
+        "7a8a15c47e88011a74b87475f0ff5a700355255a31f99eddd2b7fca74c490eaf"
+        "eebde28317f903f45ddc8accca0d363a5cc6cc6dde41b1bcefabc48a55fa6f8d"
+    )
+    sig = kp.sign(b"hd-bls-test-message")
+    assert bls.g1_compress(sig).hex() == (
+        "931b8317b8c284f1450455c4d9ac1f173d09884622265fc89370510b22a8d5c9"
+        "4210a8423d57d2465727a8d98c250a65"
+    )
+
+
+def test_compress_decompress_round_trip(points):
+    for p in points + [None]:
+        assert bls.g1_decompress(bls.g1_compress(p)) == p

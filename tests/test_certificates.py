@@ -264,3 +264,157 @@ def test_cert_obs_events_emitted():
         e.detail for e in rec.snapshot() if e.kind == "cert.verify"
     ]
     assert outcomes == ["ok", "reject"]
+
+
+# -------------------------------------------------------------------- BLS
+
+
+@pytest.fixture(scope="module")
+def bls_ids():
+    return [bytes([i]) * 32 for i in range(7)]
+
+
+@pytest.fixture(scope="module")
+def bls_keyring(bls_ids):
+    from hyperdrive_tpu.crypto import bls
+
+    return {s: bls.bls_keypair_from_identity(s) for s in bls_ids}
+
+
+def _bls_certifier(bls_ids, bls_keyring, **kw):
+    return Certifier(
+        bls_ids, 2, transcript_source=lambda: b"\x5a" * 32,
+        bls_keyring=bls_keyring, **kw,
+    )
+
+
+def test_bls_certificate_mints_aggregate_and_verifies(bls_ids, bls_keyring):
+    from hyperdrive_tpu.certificates import verify_bls_certificate
+
+    c = _bls_certifier(bls_ids, bls_keyring)
+    cert = c.observe_commit(3, 1, b"block-three", bls_ids[:5])
+    assert len(cert.agg_sig) == 48
+    assert c.verify(cert)
+    # The light client holds only the committee pubkeys — no transcript,
+    # no verifier state, no trust in the minting replica.
+    assert verify_bls_certificate(cert, c.bls_pubkeys(), quorum=5)
+
+
+def test_bls_certificate_tamper_rejects(bls_ids, bls_keyring):
+    from hyperdrive_tpu.certificates import verify_bls_certificate
+
+    c = _bls_certifier(bls_ids, bls_keyring)
+    cert = c.observe_commit(3, 1, b"block-three", bls_ids[:5])
+    pks = c.bls_pubkeys()
+    flipped = QuorumCertificate(
+        cert.height, cert.round,
+        bytes([cert.value_digest[0] ^ 1]) + cert.value_digest[1:],
+        cert.signers, cert.transcript, cert.binding, cert.agg_sig,
+    )
+    assert not verify_bls_certificate(flipped, pks)
+    # An extra bitmap bit claims a signer whose partial is not in the
+    # aggregate: pairing mismatch.
+    bm = bytearray(cert.signers)
+    bm[0] ^= 0b0100000
+    extra = QuorumCertificate(
+        cert.height, cert.round, cert.value_digest, bytes(bm),
+        cert.transcript, cert.binding, cert.agg_sig,
+    )
+    assert not verify_bls_certificate(extra, pks)
+    # Quorum gate: the same certificate under a stricter threshold.
+    assert not verify_bls_certificate(cert, pks, quorum=6)
+
+
+def test_bls_certificate_wire_roundtrip_and_size(bls_ids, bls_keyring):
+    c = _bls_certifier(bls_ids, bls_keyring)
+    cert = c.observe_commit(3, 1, b"block-three", bls_ids[:5])
+    w = Writer()
+    marshal_certificate(cert, w)
+    assert unmarshal_certificate(Reader(w.data())) == cert
+    # 48 bytes of signature material on top of the plain certificate,
+    # at every committee width.
+    for n in (256, 1024, 4096):
+        assert (certificate_size(n, with_bls=True)
+                == certificate_size(n) + 48)
+
+
+def test_bls_certificate_bad_agg_sig_length_rejects(bls_ids, bls_keyring):
+    c = _bls_certifier(bls_ids, bls_keyring)
+    cert = c.observe_commit(3, 1, b"block-three", bls_ids[:5])
+    w = Writer()
+    marshal_certificate(
+        QuorumCertificate(
+            cert.height, cert.round, cert.value_digest, cert.signers,
+            cert.transcript, cert.binding, cert.agg_sig + b"\x00",
+        ),
+        w,
+    )
+    with pytest.raises(SerdeError):
+        unmarshal_certificate(Reader(w.data()))
+
+
+def test_bls_binding_is_v1_compatible_without_keyring(bls_ids):
+    # No keyring -> empty agg_sig and the EXACT v1 binding preimage, so
+    # pre-BLS verifiers and stored certificates stay byte-compatible.
+    plain = Certifier(bls_ids, 2, transcript_source=lambda: b"\x5a" * 32)
+    cert = plain.observe_commit(3, 1, b"block-three", bls_ids[:5])
+    assert cert.agg_sig == b""
+    assert plain.verify(cert)
+    w = Writer()
+    marshal_certificate(cert, w)
+    assert unmarshal_certificate(Reader(w.data())) == cert
+
+
+def test_bls_device_aggregation_matches_host(bls_ids, bls_keyring):
+    from hyperdrive_tpu.certificates import verify_bls_certificate
+    from hyperdrive_tpu.ops import g1 as g1k
+
+    host = _bls_certifier(bls_ids, bls_keyring)
+    dev = _bls_certifier(
+        bls_ids, bls_keyring,
+        bls_aggregate_fn=lambda pts: g1k.aggregate_points(pts, width=8),
+    )
+    hcert = host.observe_commit(3, 1, b"block-three", bls_ids[:5])
+    dcert = dev.observe_commit(3, 1, b"block-three", bls_ids[:5])
+    assert dcert == hcert  # byte-identical, aggregation route invisible
+    assert verify_bls_certificate(dcert, dev.bls_pubkeys(), quorum=5)
+
+
+def test_bls_rotate_rederives_churned_keys(bls_ids, bls_keyring):
+    from hyperdrive_tpu.certificates import verify_bls_certificate
+
+    c = _bls_certifier(bls_ids, bls_keyring)
+    new_ids = bls_ids[2:] + [bytes([99]) * 32]
+    c.rotate(new_ids, f=2)
+    cert = c.observe_commit(4, 0, b"block-four", new_ids[:5])
+    assert len(cert.agg_sig) == 48
+    assert verify_bls_certificate(cert, c.bls_pubkeys(), quorum=5)
+
+
+def test_bls_cert_obs_event_emitted(bls_ids, bls_keyring):
+    from hyperdrive_tpu.obs.recorder import EVENT_KINDS, Recorder
+
+    rec = Recorder(capacity=64)
+    c = Certifier(
+        bls_ids, 2, transcript_source=lambda: b"\x5a" * 32,
+        bls_keyring=bls_keyring, obs=rec.scoped(0),
+    )
+    c.observe_commit(3, 1, b"block-three", bls_ids[:5])
+    kinds = [e.kind for e in rec.snapshot()]
+    assert kinds.count("bls.cert.agg") == 1
+    assert "bls.cert.agg" in EVENT_KINDS
+
+
+def test_sim_bls_certificates_digest_neutral():
+    base = Simulation(n=4, target_height=3, seed=5, timeout=1.0)
+    bres = base.run(max_steps=100_000)
+    sim = Simulation(
+        n=4, target_height=3, seed=5, timeout=1.0, bls_certificates=True
+    )
+    sres = sim.run(max_steps=100_000)
+    assert sres.commit_digest() == bres.commit_digest()
+    assert all(
+        len(cert.agg_sig) == 48
+        for c in sim.certifiers for cert in c.certs.values()
+    )
+    assert any(c.certs for c in sim.certifiers)

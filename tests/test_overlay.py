@@ -440,3 +440,78 @@ def test_overlay_report_decoder_round_trip(tmp_path):
     assert summary["still_demoted"] == snap["scores"]["demoted"]
     text = render_overlay_table(summary)
     assert "frames" in text and "level" in text
+
+
+# ------------------------------------------------------------ BLS partials
+
+
+def test_overlay_bls_partials_digest_neutral():
+    # Real BLS partial aggregates on every frame (host fold) must not
+    # bend the agreed chain, and a clean run never fires the merge check.
+    base = Simulation(n=4, target_height=3, seed=11, timeout=1.0,
+                      delivery_cost=1e-3)
+    bres = base.run(max_steps=200_000)
+    sim = Simulation(n=4, target_height=3, seed=11, timeout=1.0,
+                     delivery_cost=1e-3,
+                     overlay=OverlayConfig(bls_partials=True))
+    sres = sim.run(max_steps=200_000)
+    assert (sres.commit_digest(up_to=3) == bres.commit_digest(up_to=3))
+    snap = sim.overlay_snapshot()
+    assert snap["bls_partials"] is True
+    assert snap["bls_partials_attached"] > 0
+    assert snap["bls_partial_rejects"] == 0
+
+
+def test_overlay_bls_corrupted_aggregate_charged_at_merge():
+    # Byzantine garblers on a BLS run send frames claiming their REAL
+    # coverage under a corrupted aggregate: every one must be caught by
+    # the receiver's recomputed masked sum — before any coverage merge
+    # or batch verify — and charged to the contributor. A deterministic
+    # probe then replays a real frame with one flipped aggregate byte.
+    from hyperdrive_tpu.overlay import OverlayFrame
+
+    plan, faults = FaultPlan.overlay(11, 8)
+    sim = Simulation(n=8, target_height=3, seed=11, timeout=1.0,
+                     delivery_cost=1e-3, chaos=plan, observe=True,
+                     overlay=OverlayConfig(faults=faults,
+                                           bls_partials=True))
+    mon = InvariantMonitor(sim)
+    res = sim.run(max_steps=200_000)
+    mon.check_final(res)
+    rt = sim._overlay
+    assert rt.bls_partial_rejects > 0  # organic garbled-agg detections
+    slot, st = next((sl, s) for sl, s in rt._slots.items() if s.bls)
+    mask = st.all_mask
+    good = rt._bls_masked_sum(st, mask, 0, 0)
+    bad = bytes([good[0] ^ 0x01]) + good[1:]
+    to = next((i for i in range(1, 8) if mask & ~st.cov[i]), 1)
+    cov, rejects = st.cov[to], rt.bls_partial_rejects
+    invalid = rt.scores.charges["invalid"]
+    rt.on_frame(to, OverlayFrame(0, slot, 0, mask, agg=bad))
+    assert rt.bls_partial_rejects == rejects + 1
+    assert rt.scores.charges["invalid"] == invalid + 1
+    assert st.cov[to] == cov  # nothing merged from the poisoned frame
+    if mask & ~cov:
+        rt.on_frame(to, OverlayFrame(0, slot, 0, mask, agg=good))
+        assert st.cov[to] != cov  # the honest retry merges fine
+
+
+@pytest.mark.slow  # compiles the vmapped G1 aggregation kernel
+def test_overlay_bls_device_launcher_matches_host_fold():
+    # Same seed, same faults-free overlay: partial-aggregate merges
+    # batched through the DeviceWorkQueue's G1SumLauncher must commit
+    # the identical chain the host fold commits, and actually launch.
+    from hyperdrive_tpu.devsched.queue import DeviceWorkQueue
+
+    host = Simulation(n=4, target_height=2, seed=11, timeout=1.0,
+                      delivery_cost=1e-3,
+                      overlay=OverlayConfig(bls_partials=True))
+    hres = host.run(max_steps=200_000)
+    queue = DeviceWorkQueue()
+    dev = Simulation(n=4, target_height=2, seed=11, timeout=1.0,
+                     delivery_cost=1e-3, devsched=queue,
+                     overlay=OverlayConfig(bls_partials=True))
+    dres = dev.run(max_steps=200_000)
+    assert dres.commit_digest() == hres.commit_digest()
+    assert dev._overlay._bls_launcher is not None
+    assert dev._overlay._bls_launcher.launched > 0
