@@ -1,0 +1,300 @@
+"""Multi-tenant continuous-batching bench: ONE shared verify service
+against M per-tenant queues.
+
+Produces the BENCH_r11 artifact (the serving evidence for ROADMAP item
+2: M shard-consensus instances funneling verify windows into one
+continuously-batching :class:`ShardVerifyService`):
+
+- **shared-vs-dedicated speedup** (gated) — at each M, the same
+  M-tenant workload (full committee precommit windows, real Ed25519,
+  the device batch verifier) runs twice: through ONE shared service
+  (every wave coalesces all M windows into one launch) and through M
+  dedicated per-tenant services (M launches per wave — the per-launch
+  dispatch+pad bill paid M times). Aggregate votes/s ratio per M;
+  the artifact refuses to save if sharing loses at M >= 4.
+
+- **fairness p99 speedup** (gated) — a firehose tenant saturates the
+  shared queue with wide windows while a small victim tenant commits
+  alongside; the victim's p99 commit latency under the
+  DeficitRoundRobin drain policy vs the FIFO drain. DRR caps rows per
+  launch, so the victim rides small launches instead of waiting on the
+  firehose's coalesced slab — the ratio is the fairness win, and the
+  DRR leg must also hold the starvation bound it promises.
+
+- **digest neutrality** (ride-along assert) — at every M, each
+  tenant's shared-service commit digest is byte-identical to its
+  dedicated-queue run: continuous batching changes scheduling, never
+  results.
+
+Wall-clock seconds ride along informationally; the gated series are
+paired ratios on the same machine, so they are runner-portable.
+
+Usage::
+
+    python benches/multitenant_bench.py [-o BENCH_r11.json] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+)
+
+from hyperdrive_tpu.devsched import DeficitRoundRobin  # noqa: E402
+from hyperdrive_tpu.parallel.service import (  # noqa: E402
+    ShardVerifyService,
+    TenantShard,
+)
+
+SEED = 37
+#: Rows per tenant window (full committee width). Pads to the device
+#: verifier's 64-lane bucket, so dedicated queues pay the whole bucket
+#: per tenant while the shared service fills it across tenants.
+VALIDATORS = 16
+#: Same M series in quick and full mode — the gated series must be
+#: shape-identical to the committed artifact on any runner; quick mode
+#: trims heights, never the series.
+M_SERIES = (1, 2, 4, 8, 16)
+FULL_HEIGHTS = 4
+QUICK_HEIGHTS = 2
+FAIRNESS_REPS = 3
+
+#: Fairness leg shape: the firehose's window alone overflows the DRR
+#: row budget (progress guarantee gives it solo launches), the victim's
+#: fits many times over.
+FIRE_VALIDATORS = 48
+VICTIM_VALIDATORS = 4
+VICTIM_HEIGHTS = 10
+DRR_KW = dict(capacity_rows=16, quantum_rows=4, starve_after=3)
+
+
+def _verifier():
+    from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+    return TpuBatchVerifier()
+
+
+def _drive_waves(services, tenants, heights: int) -> float:
+    """Lock-step waves: every tenant submits one window, then every
+    service drains once. With one shared service that is one coalesced
+    launch per wave; with per-tenant services it is M launches. Returns
+    the wall seconds across all waves."""
+    t0 = time.perf_counter()
+    for _ in range(heights):
+        for t in tenants:
+            t.pump(max_inflight=1)
+        for svc in services:
+            svc.drain()
+    return time.perf_counter() - t0
+
+
+def _run_m(m: int, heights: int, verifier) -> dict:
+    # Shared leg: one service, one launch per wave for all m tenants.
+    shared_svc = ShardVerifyService(verifier, max_depth=0)
+    shared = [
+        TenantShard(
+            f"tenant-{i}", n_validators=VALIDATORS, target_height=heights
+        ).attach_local(shared_svc)
+        for i in range(m)
+    ]
+    # Warmup wave (compile + caches) outside the timed window, for both
+    # legs identically: one extra height beyond the measured target.
+    for t in shared:
+        t.target_height += 1
+    _drive_waves([shared_svc], shared, 1)
+    for t in shared:
+        # The warmup commit may carry a one-time XLA compile; keep it
+        # out of the reported latency quantiles like it is kept out of
+        # the walls.
+        t.commit_latencies.clear()
+    shared_wall = _drive_waves([shared_svc], shared, heights)
+    assert all(t.done and not t.rejected for t in shared)
+
+    # Dedicated leg: the same workload, one service (queue) per tenant.
+    # The verifier object is shared so both legs use the same compiled
+    # kernels — the difference under test is the launch schedule.
+    dedicated_svcs = [
+        ShardVerifyService(verifier, max_depth=0) for _ in range(m)
+    ]
+    dedicated = [
+        TenantShard(
+            f"tenant-{i}", n_validators=VALIDATORS,
+            target_height=heights + 1,
+        ).attach_local(svc)
+        for i, svc in enumerate(dedicated_svcs)
+    ]
+    _drive_waves(dedicated_svcs, dedicated, 1)
+    dedicated_wall = _drive_waves(dedicated_svcs, dedicated, heights)
+    assert all(t.done and not t.rejected for t in dedicated)
+
+    digest_equal = all(
+        a.commit_digest() == b.commit_digest()
+        for a, b in zip(shared, dedicated)
+    )
+    rows = m * heights * VALIDATORS
+    lat = sorted(
+        x for t in shared for x in t.commit_latencies
+    )
+    return {
+        "m": m,
+        "shared_wall_s": round(shared_wall, 4),
+        "dedicated_wall_s": round(dedicated_wall, 4),
+        "shared_votes_per_s": round(rows / shared_wall, 1),
+        "dedicated_votes_per_s": round(rows / dedicated_wall, 1),
+        "speedup": round(dedicated_wall / shared_wall, 4),
+        "shared_launches": shared_svc.queue.launches,
+        "dedicated_launches": sum(
+            s.queue.launches for s in dedicated_svcs
+        ),
+        "digest_equal": digest_equal,
+        "p50_s": round(lat[len(lat) // 2], 4),
+        "p99_s": round(lat[min(len(lat) - 1, int(0.99 * len(lat)))], 4),
+    }
+
+
+def _fairness_rep(policy, verifier) -> float:
+    """One saturated run; returns the VICTIM's p99 commit latency."""
+    svc = ShardVerifyService(verifier, max_depth=0, policy=policy)
+    fire = TenantShard(
+        "firehose", n_validators=FIRE_VALIDATORS,
+        target_height=VICTIM_HEIGHTS * 2,
+    ).attach_local(svc)
+    victim = TenantShard(
+        "victim", n_validators=VICTIM_VALIDATORS,
+        target_height=VICTIM_HEIGHTS,
+    ).attach_local(svc)
+    guard = 0
+    while not victim.done:
+        fire.pump(max_inflight=4)
+        victim.pump(max_inflight=1)
+        svc.drain()
+        guard += 1
+        if guard > 100 * VICTIM_HEIGHTS:
+            raise SystemExit("fairness leg stalled")
+    assert not victim.rejected
+    lat = sorted(victim.commit_latencies)
+    return lat[min(len(lat) - 1, int(0.99 * len(lat)))]
+
+
+def run_bench(quick: bool) -> dict:
+    heights = QUICK_HEIGHTS if quick else FULL_HEIGHTS
+    verifier = _verifier()
+    rows = []
+    for m in M_SERIES:
+        r = _run_m(m, heights, verifier)
+        rows.append(r)
+        print(
+            f"M={m:3d} shared={r['shared_votes_per_s']:8.1f} votes/s "
+            f"({r['shared_launches']} launches)  "
+            f"dedicated={r['dedicated_votes_per_s']:8.1f} votes/s "
+            f"({r['dedicated_launches']} launches)  "
+            f"speedup={r['speedup']:.2f}x"
+        )
+        if not r["digest_equal"]:
+            raise SystemExit(
+                f"DIGEST MISMATCH at M={m}: shared service diverged "
+                f"from dedicated queues"
+            )
+        if m >= 4 and r["speedup"] < 1.0:
+            raise SystemExit(
+                f"shared service LOST to dedicated queues at M={m} "
+                f"({r['speedup']:.2f}x) — continuous batching is not "
+                f"paying for itself; artifact refused"
+            )
+
+    fifo_p99, drr_p99, fairness = [], [], []
+    deferrals, forced = [], []
+    for rep in range(FAIRNESS_REPS):
+        f99 = _fairness_rep(None, verifier)
+        policy = DeficitRoundRobin(**DRR_KW)
+        d99 = _fairness_rep(policy, verifier)
+        if policy.max_deferrals > policy.starve_after:
+            raise SystemExit(
+                f"starvation bound violated: max_deferrals="
+                f"{policy.max_deferrals} > starve_after="
+                f"{policy.starve_after}"
+            )
+        fifo_p99.append(round(f99, 4))
+        drr_p99.append(round(d99, 4))
+        fairness.append(round(f99 / d99, 4))
+        deferrals.append(policy.deferred_total)
+        forced.append(policy.forced_total)
+        print(
+            f"fairness rep={rep} victim p99: fifo={f99:.4f}s "
+            f"drr={d99:.4f}s speedup={f99 / d99:.2f}x "
+            f"(deferred={policy.deferred_total} "
+            f"forced={policy.forced_total})"
+        )
+
+    doc = {
+        "benchdiff_gate": [
+            "multitenant.shared_vs_dedicated_speedup_series",
+            "multitenant.fairness_p99_speedup_series",
+        ],
+        "measured_at": datetime.datetime.now().strftime(
+            "%Y-%m-%d %H:%M:%S"
+        ),
+        "multitenant_ok": all(r["digest_equal"] for r in rows),
+        "multitenant": {
+            "seed": SEED,
+            "validators": VALIDATORS,
+            "heights": heights,
+            "tenants_series": [r["m"] for r in rows],
+            "shared_vs_dedicated_speedup_series": [
+                r["speedup"] for r in rows
+            ],
+            "shared_votes_per_s": [r["shared_votes_per_s"] for r in rows],
+            "dedicated_votes_per_s": [
+                r["dedicated_votes_per_s"] for r in rows
+            ],
+            "shared_wall_s": [r["shared_wall_s"] for r in rows],
+            "dedicated_wall_s": [r["dedicated_wall_s"] for r in rows],
+            "shared_launches": [r["shared_launches"] for r in rows],
+            "dedicated_launches": [r["dedicated_launches"] for r in rows],
+            "digest_equal": [r["digest_equal"] for r in rows],
+            "commit_latency_p50_s": [r["p50_s"] for r in rows],
+            "commit_latency_p99_s": [r["p99_s"] for r in rows],
+            "fairness": {
+                "fire_validators": FIRE_VALIDATORS,
+                "victim_validators": VICTIM_VALIDATORS,
+                "victim_heights": VICTIM_HEIGHTS,
+                "drr": DRR_KW,
+                "fifo_victim_p99_s": fifo_p99,
+                "drr_victim_p99_s": drr_p99,
+                "deferred_total": deferrals,
+                "forced_total": forced,
+            },
+            "fairness_p99_speedup_series": fairness,
+        },
+    }
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output", default="BENCH_r11.json")
+    ap.add_argument(
+        "--quick",
+        action="store_true",
+        help="CI mode: same M series, fewer heights per leg",
+    )
+    ns = ap.parse_args(argv)
+    doc = run_bench(ns.quick)
+    with open(ns.output, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"wrote {ns.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -686,7 +686,8 @@ class MetricNameRule:
     #: Event-name prefixes whose membership is closed: an ``.emit``
     #: literal under one of these must appear in EVENT_KINDS verbatim.
     _CLOSED_PREFIXES = ("sched.launch.", "verify.occupancy.", "metrics.",
-                        "load.", "admission.", "bls.")
+                        "load.", "admission.", "bls.", "tenant.drain.",
+                        "service.")
 
     def check(self, ctx):
         findings: list = []

@@ -6,7 +6,7 @@ Usage::
         [--n N_REPLICAS] [--target H] [--out DIR] [--replay-every K]
         [--pipelined-every K] [--certs-every K] [--bls-certs-every K]
         [--churn-every K] [--overload-every K] [--overlay-every K]
-        [--dump-ok DIR]
+        [--tenants-every K] [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -225,6 +225,98 @@ def _bls_overlay_probe(scen_seed: int, args) -> int:
     return rejects
 
 
+def _tenant_service_probe(scen_seed: int) -> dict:
+    """The multi-tenant serving fault family (jax-free): three tenants
+    share one continuously-batching ShardVerifyService under a
+    DeficitRoundRobin drain policy while (a) one tenant firehoses the
+    queue with wide windows and deep inflight, and (b) another drops
+    off the drive loop for a seeded partition window. Invariants:
+
+    - the WITNESS tenant (neither overloaded nor partitioned) and the
+      healed partitioned tenant commit chains byte-identical to clean
+      solo runs on dedicated services — a neighbor's overload or outage
+      must never move a third tenant's digests;
+    - the fairness starvation bound holds
+      (:meth:`InvariantMonitor.check_tenant_fairness`) AND was actually
+      exercised — a leg whose firehose never forced a deferral proves
+      nothing.
+    """
+    from hyperdrive_tpu.devsched import DeficitRoundRobin
+    from hyperdrive_tpu.parallel.service import (
+        ShardVerifyService,
+        TenantShard,
+    )
+    from hyperdrive_tpu.verifier import NullVerifier
+
+    rng = random.Random(scen_seed * _SEED_STRIDE + 7)
+    heights = 12
+    policy = DeficitRoundRobin(
+        capacity_rows=16, quantum_rows=4, starve_after=3
+    )
+    svc = ShardVerifyService(NullVerifier(), max_depth=0, policy=policy)
+    fire = TenantShard(
+        "firehose", n_validators=16, target_height=heights, sign=False
+    ).attach_local(svc)
+    part = TenantShard(
+        "partitioned", n_validators=4, target_height=heights, sign=False
+    ).attach_local(svc)
+    wit = TenantShard(
+        "witness", n_validators=4, target_height=heights, sign=False
+    ).attach_local(svc)
+    p0 = rng.randrange(1, 5)
+    p1 = p0 + rng.randrange(3, 9)
+    step = 0
+    while not (fire.done and part.done and wit.done):
+        fire.pump(max_inflight=8)
+        if not (p0 <= step < p1):
+            part.pump(max_inflight=1)
+        wit.pump(max_inflight=1)
+        svc.drain()
+        step += 1
+        if step > 10_000:
+            raise InvariantViolation(
+                "tenant-liveness",
+                f"tenants stalled: firehose={len(fire.commits)} "
+                f"partitioned={len(part.commits)} "
+                f"witness={len(wit.commits)} of {heights}",
+            )
+    InvariantMonitor.check_tenant_fairness(policy)
+    if not policy.deferred_total:
+        raise InvariantViolation(
+            "tenant-fairness",
+            "firehose never forced a deferral — the leg did not "
+            "exercise the drain policy",
+        )
+    for shard, nv in ((part, 4), (wit, 4)):
+        solo_svc = ShardVerifyService(NullVerifier(), max_depth=0)
+        solo = TenantShard(
+            shard.name, n_validators=nv, target_height=heights,
+            sign=False,
+        ).attach_local(solo_svc)
+        while not solo.done:
+            solo.pump(max_inflight=1)
+            solo_svc.drain()
+        if shard.commit_digest() != solo.commit_digest():
+            raise InvariantViolation(
+                "tenant-digest",
+                f"tenant {shard.name} diverged from its clean solo run "
+                f"under a neighbor's overload/partition",
+            )
+        if shard.rejected:
+            raise InvariantViolation(
+                "tenant-digest",
+                f"tenant {shard.name} had {shard.rejected} rejected "
+                f"commits under a neighbor's faults",
+            )
+    return {
+        "deferred": policy.deferred_total,
+        "forced": policy.forced_total,
+        "max_deferrals": policy.max_deferrals,
+        "launches": svc.queue.launches,
+        "partition": (p0, p1),
+    }
+
+
 def _dump_failure(out: str, scen_seed: int, sim, err) -> str:
     os.makedirs(out, exist_ok=True)
     base = os.path.join(out, f"chaos_seed_{scen_seed}")
@@ -418,6 +510,21 @@ def soak(args) -> int:
                 print(
                     f"ok overload seed={scen_seed} n={n} "
                     f"injected={osnap['injected']} shed={shed_str}"
+                )
+            if args.tenants_every and k % args.tenants_every == 0:
+                # The multi-tenant serving fault family (ISSUE 14):
+                # overload on one tenant + a partition on another must
+                # not move a third tenant's digests, and the DRR
+                # starvation bound must hold while being exercised.
+                tstats = _tenant_service_probe(scen_seed)
+                print(
+                    f"ok tenants seed={scen_seed} "
+                    f"deferred={tstats['deferred']} "
+                    f"forced={tstats['forced']} "
+                    f"max_deferrals={tstats['max_deferrals']} "
+                    f"launches={tstats['launches']} "
+                    f"partition={tstats['partition'][0]}.."
+                    f"{tstats['partition'][1]}"
                 )
         except (InvariantViolation, AssertionError) as err:
             failures += 1
@@ -660,6 +767,15 @@ def main(argv=None) -> int:
         help="re-run every Kth plan under an open-loop duplicate storm "
         "with behavior-neutral admission and cross-check the commit "
         "digest against the unloaded run (0 = off)",
+    )
+    p.add_argument(
+        "--tenants-every",
+        type=int,
+        default=0,
+        help="additionally run every Kth seed as a multi-tenant serving "
+        "scenario (a firehose tenant + a partitioned tenant sharing one "
+        "continuously-batching verify service with a third, unfaulted "
+        "tenant; digest isolation + the DRR starvation bound; 0 = off)",
     )
     p.add_argument(
         "--churn-every",

@@ -303,6 +303,26 @@ class InvariantMonitor:
                 "the ranked fallback never engaged",
             )
 
+    @staticmethod
+    def check_tenant_fairness(policy) -> None:
+        """Multi-tenant drain-policy invariant (the ``--tenants-every``
+        soak leg): the starvation bound is a hard promise, not a
+        heuristic. A :class:`~hyperdrive_tpu.devsched.DeficitRoundRobin`
+        forces a command into the next launch once it has been deferred
+        ``starve_after`` times, so no command ever observes a deferral
+        count beyond the bound — however hard one tenant firehoses the
+        shared queue. ``max_deferrals`` is the policy's own high-water
+        mark; exceeding the bound means the forced lane failed."""
+        bound = getattr(policy, "starve_after", 0)
+        seen = getattr(policy, "max_deferrals", 0)
+        if bound and seen > bound:
+            raise InvariantViolation(
+                "tenant-fairness",
+                f"a tenant command was deferred {seen} times "
+                f"(starvation bound {bound}) — the forced lane never "
+                f"fired for it",
+            )
+
     def _check_journal(self) -> None:
         """Cross-check the obs flight recorder against the chain: every
         journalled commit event's value prefix must match what the

@@ -25,6 +25,7 @@ scopes, futures are the ONLY device-access idiom — a raw blocking
 """
 
 from hyperdrive_tpu.devsched.flusher import QueueFlusher
+from hyperdrive_tpu.devsched.policy import DeficitRoundRobin, FifoDrainPolicy
 from hyperdrive_tpu.devsched.queue import (
     DeviceFuture,
     DeviceWorkQueue,
@@ -34,8 +35,10 @@ from hyperdrive_tpu.devsched.queue import (
 )
 
 __all__ = [
+    "DeficitRoundRobin",
     "DeviceFuture",
     "DeviceWorkQueue",
+    "FifoDrainPolicy",
     "NullVerifyLauncher",
     "QueueFlusher",
     "SpeculationMismatch",

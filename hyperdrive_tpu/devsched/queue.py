@@ -20,7 +20,7 @@ ShardVerifyService`).
 from __future__ import annotations
 
 from hyperdrive_tpu.analysis.annotations import drain_point
-from hyperdrive_tpu.obs.devtel import NULL_DEVTEL
+from hyperdrive_tpu.obs.devtel import NULL_DEVTEL, CmdMeta
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
 __all__ = [
@@ -194,10 +194,16 @@ class DeviceWorkQueue:
     every drain that resolved work — the sim's commit-finalization
     flush hooks here, so gated commits land the moment their settle's
     future does.
+
+    ``policy``: a drain policy (devsched/policy.py) consulted once per
+    drain cycle to partition pending commands into this cycle's
+    launches vs next cycle's — tenant-aware fairness for the
+    multi-tenant service. ``None`` (the default) keeps the historical
+    FIFO-everything drain byte-identical.
     """
 
     def __init__(self, max_depth: int = 0, obs=None, tracer=None,
-                 devtel=None):
+                 devtel=None, policy=None):
         self.max_depth = int(max_depth)
         self.obs = obs if obs is not None else NULL_BOUND
         self.tracer = tracer
@@ -212,6 +218,7 @@ class DeviceWorkQueue:
         #: the queue itself stays wall-clock-free). None = no admission
         #: coupling, exactly the pre-backpressure behavior.
         self.controller = None
+        self.policy = policy
         self._pending: list = []  # (launcher, payload, future, gen, meta)
         self._launchers: dict = {}  # id(verifier) -> VerifyLauncher
         self._draining = False
@@ -271,6 +278,13 @@ class DeviceWorkQueue:
                 rows = len(payload) if hasattr(payload, "__len__") else 0
             meta = self.devtel.command(origin, rows)
             fut.seq = meta.seq
+        elif self.policy is not None:
+            # The drain policy reads origin/rows off the command meta;
+            # synthesize a probe-free one when no devtel is installed
+            # (fairness must not require telemetry).
+            if rows is None:
+                rows = len(payload) if hasattr(payload, "__len__") else 0
+            meta = CmdMeta(self.submitted, 0.0, origin, rows)
         self._pending.append((launcher, payload, fut, generation, meta))
         self.submitted += 1
         if self.controller is not None:
@@ -310,6 +324,27 @@ class DeviceWorkQueue:
             while self._pending:
                 batch = self._pending
                 self._pending = []
+                policy = self.policy
+                if policy is not None:
+                    live = [c for c in batch if not c[2].cancelled()]
+                    batch, deferred = policy.select(live)
+                    if deferred:
+                        # Deferred commands rejoin pending FIRST, so
+                        # work submitted by this cycle's callbacks
+                        # queues behind them — age order survives.
+                        self._pending.extend(deferred)
+                        if self.obs is not NULL_BOUND:
+                            self.obs.emit(
+                                "tenant.drain.deferred", -1, -1,
+                                len(deferred),
+                            )
+                    if policy.last_forced and self.obs is not NULL_BOUND:
+                        self.obs.emit(
+                            "tenant.drain.forced", -1, -1,
+                            policy.last_forced,
+                        )
+                    if not batch:
+                        continue
                 groups: dict = {}
                 order: list = []
                 for cmd in batch:

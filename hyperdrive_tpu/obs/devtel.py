@@ -269,14 +269,21 @@ class DeviceTelemetry:
 
     # ----------------------------------------------------- per-tenant
 
+    #: Latency legs a service attributes per tenant. ``commit_rejected``
+    #: is deliberately a separate histogram: a failed certificate verify
+    #: must not pollute the committed-path p95/p99.
+    _TENANT_LEGS = {
+        "verify": "tenant.verify.latency",
+        "commit": "tenant.commit.latency",
+        "commit_rejected": "tenant.commit_rejected.latency",
+    }
+
     def tenant_latency(self, tenant, seconds: float, leg: str = "verify"):
         """Per-tenant latency attribution (ShardVerifyService): labeled
         histograms so cross-tenant aggregation stays mergeable."""
-        name = (
-            "tenant.verify.latency" if leg == "verify"
-            else "tenant.commit.latency"
+        self.registry.observe(
+            self._TENANT_LEGS[leg], seconds, label=tenant
         )
-        self.registry.observe(name, seconds, label=tenant)
 
 
 class NullDeviceTelemetry(DeviceTelemetry):

@@ -141,6 +141,18 @@ EVENT_KINDS = frozenset(
         # (HD005) and OBSERVABILITY.md enumerate exactly these.
         "bls.cert.agg",
         "bls.partial.reject",
+        # Multi-tenant serving (devsched/policy.py, parallel/service.py):
+        # drain-policy deferrals/starvation-bound firings on the queue
+        # track, and the cross-process submit path's lifecycle — remote
+        # windows admitted, certificate frames resolved, overload sheds,
+        # retired tenant certificate prunes. Closed families — the lint
+        # (HD005) and OBSERVABILITY.md enumerate exactly these.
+        "tenant.drain.deferred",
+        "tenant.drain.forced",
+        "service.remote.submit",
+        "service.remote.resolve",
+        "service.remote.shed",
+        "service.tenant.retire",
     }
 )
 

@@ -9,30 +9,43 @@ ICI/DCN, while the host network stays the control path exactly where the
 reference assumes an external network.
 """
 
-from hyperdrive_tpu.parallel.mesh import (
-    grid_pack,
-    grid_pack_wire,
-    make_mesh,
-    make_sharded_step,
-    sharded_chalwire_tally,
-    sharded_verify_tally,
-)
-from hyperdrive_tpu.parallel.multihost import (
-    global_window_from_local,
-    init_distributed,
-    make_hybrid_mesh,
-    replicate_to_all_hosts,
-)
+# Lazy exports (PEP 562): the mesh/multihost members need jax at import
+# time, but the multi-tenant serving layer (parallel/service.py) and its
+# chaos/CLI consumers must be importable jax-free. Attribute access
+# resolves the owning submodule on first touch.
 
-__all__ = [
-    "grid_pack",
-    "grid_pack_wire",
-    "make_mesh",
-    "make_sharded_step",
-    "sharded_chalwire_tally",
-    "sharded_verify_tally",
-    "global_window_from_local",
-    "init_distributed",
-    "make_hybrid_mesh",
-    "replicate_to_all_hosts",
-]
+_EXPORTS = {
+    "grid_pack": "hyperdrive_tpu.parallel.mesh",
+    "grid_pack_wire": "hyperdrive_tpu.parallel.mesh",
+    "make_mesh": "hyperdrive_tpu.parallel.mesh",
+    "make_sharded_step": "hyperdrive_tpu.parallel.mesh",
+    "sharded_chalwire_tally": "hyperdrive_tpu.parallel.mesh",
+    "sharded_verify_tally": "hyperdrive_tpu.parallel.mesh",
+    "global_window_from_local": "hyperdrive_tpu.parallel.multihost",
+    "init_distributed": "hyperdrive_tpu.parallel.multihost",
+    "make_hybrid_mesh": "hyperdrive_tpu.parallel.multihost",
+    "replicate_to_all_hosts": "hyperdrive_tpu.parallel.multihost",
+    "ShardVerifyService": "hyperdrive_tpu.parallel.service",
+    "ServicePort": "hyperdrive_tpu.parallel.service",
+    "RemoteServiceClient": "hyperdrive_tpu.parallel.service",
+    "TenantShard": "hyperdrive_tpu.parallel.service",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    import importlib
+
+    value = getattr(importlib.import_module(module), name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,341 @@
+"""The multi-tenant serving CLI (CI's ``multitenant-smoke`` job).
+
+Usage::
+
+    python -m hyperdrive_tpu.parallel serve
+        [--tenants M] [--heights H] [--validators V]
+        [--policy drr|fifo] [--capacity-rows N] [--quantum-rows N]
+        [--starve-after K] [--weights T=W,...]
+        [--verifier host|null|device] [--max-depth D]
+        [--listen] [--remote-tenants K] [--parity] [--json] [-o FILE]
+
+    python -m hyperdrive_tpu.parallel tenant
+        --connect HOST:PORT --name NAME
+        [--validators V] [--heights H] [--unsigned] [--inflight N]
+
+``serve`` runs the deployment shape of ROADMAP item 2: M independent
+shard-consensus instances (each its own deterministic committee)
+funneling verify windows into ONE continuously-batching
+:class:`~hyperdrive_tpu.parallel.service.ShardVerifyService`. The drive
+loop pumps every tenant, services the remote port, and drains the
+shared queue — each drain is one coalesced launch covering whatever
+every tenant had pending.
+
+``--remote-tenants K`` spawns K child processes running the ``tenant``
+subcommand against the port: REAL cross-process batching over TCP, with
+commits finalized by O(1) certificate frames. ``--parity`` re-runs
+every tenant on its own dedicated service afterwards and asserts the
+commit digests match — continuous batching must change scheduling,
+never results.
+
+The ``serve`` path is jax-free unless ``--verifier device`` asks for
+the compiled batch verifier; the ``tenant`` subcommand never imports
+jax at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+from hyperdrive_tpu.parallel.service import (
+    RemoteServiceClient,
+    ShardVerifyService,
+    TenantShard,
+)
+
+
+def _percentile(values, q: float):
+    vals = sorted(values)
+    if not vals:
+        return None
+    return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
+def _build_verifier(kind: str):
+    if kind == "null":
+        from hyperdrive_tpu.verifier import NullVerifier
+
+        return NullVerifier()
+    if kind == "device":
+        from hyperdrive_tpu.ops.ed25519_jax import TpuBatchVerifier
+
+        return TpuBatchVerifier()
+    from hyperdrive_tpu.verifier import HostVerifier
+
+    return HostVerifier()
+
+
+def _build_policy(args):
+    if args.policy == "fifo":
+        return None
+    from hyperdrive_tpu.devsched import DeficitRoundRobin
+
+    weights = {}
+    if args.weights:
+        for part in args.weights.split(","):
+            name, _, w = part.partition("=")
+            weights[name.strip()] = int(w)
+    return DeficitRoundRobin(
+        capacity_rows=args.capacity_rows,
+        quantum_rows=args.quantum_rows,
+        weights=weights or None,
+        starve_after=args.starve_after,
+    )
+
+
+def _dedicated_digest(name: str, validators: int, heights: int,
+                      sign: bool, verifier_kind: str) -> str:
+    """The per-tenant-queue baseline: the same tenant driven through its
+    own fresh service (own queue, own verifier instance) — what the
+    shared run's digest must match exactly."""
+    svc = ShardVerifyService(_build_verifier(verifier_kind), max_depth=0)
+    shard = TenantShard(
+        name, n_validators=validators, target_height=heights, sign=sign
+    ).attach_local(svc)
+    while not shard.done:
+        if not shard.pump(max_inflight=2):
+            break
+        svc.drain()
+    svc.close()
+    return shard.commit_digest()
+
+
+def serve(args) -> int:
+    from hyperdrive_tpu.obs.devtel import DeviceTelemetry
+
+    sign = args.verifier != "null"
+    devtel = DeviceTelemetry(keep=4096)
+    policy = _build_policy(args)
+    service = ShardVerifyService(
+        _build_verifier(args.verifier),
+        max_depth=args.max_depth,
+        devtel=devtel,
+        policy=policy,
+    )
+    tenants = [
+        TenantShard(
+            f"tenant-{i}", n_validators=args.validators,
+            target_height=args.heights, sign=sign,
+        ).attach_local(service)
+        for i in range(args.tenants)
+    ]
+
+    port = None
+    children = []
+    if args.listen or args.remote_tenants:
+        port = service.remote_port()
+        host, pnum = port.address
+        for i in range(args.remote_tenants):
+            cmd = [
+                sys.executable, "-m", "hyperdrive_tpu.parallel", "tenant",
+                "--connect", f"{host}:{pnum}",
+                "--name", f"remote-{i}",
+                "--validators", str(args.validators),
+                "--heights", str(args.heights),
+            ]
+            if not sign:
+                cmd.append("--unsigned")
+            children.append(
+                subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+            )
+
+    t_start = time.perf_counter()
+    deadline = t_start + args.timeout
+    while time.perf_counter() < deadline:
+        submitted = sum(t.pump(max_inflight=2) for t in tenants)
+        handled = port.pump() if port is not None else 0
+        if service.queue.depth:
+            service.drain()
+        locals_done = all(t.done for t in tenants)
+        remote_quiet = port is None or (
+            port.inflight == 0
+            and all(c.poll() is not None for c in children)
+        )
+        if locals_done and remote_quiet and not service.queue.depth:
+            break
+        if not submitted and not handled and not service.queue.depth:
+            time.sleep(0.001)
+    wall = time.perf_counter() - t_start
+    service.drain()
+
+    child_reports = []
+    for c in children:
+        out, _ = c.communicate(timeout=30)
+        child_reports.append(json.loads(out) if out.strip() else {})
+    if port is not None:
+        port.close()
+    service.close()
+
+    # Coalescing evidence straight from the launch probe: launches whose
+    # origin tuples span more than one tenant track, and — with remote
+    # tenants — launches mixing a remote tenant's track with local ones.
+    local_tids = {service.tenant_ids[t.name] for t in tenants}
+    multi_origin = 0
+    remote_coalesced = 0
+    for rec in devtel.records:
+        origins = set(rec.origins)
+        if len(origins) > 1:
+            multi_origin += 1
+            if origins - local_tids and origins & local_tids:
+                remote_coalesced += 1
+
+    total_rows = sum(
+        len(t.commits) * args.validators for t in tenants
+    ) + sum(
+        r.get("commits", 0) * args.validators for r in child_reports
+    )
+    latencies = [lat for t in tenants for lat in t.commit_latencies]
+    parity_ok = None
+    if args.parity:
+        parity_ok = True
+        for t in tenants:
+            want = _dedicated_digest(
+                t.name, args.validators, args.heights, sign, args.verifier
+            )
+            if t.commit_digest() != want:
+                parity_ok = False
+                print(
+                    f"PARITY MISMATCH tenant={t.name}: shared "
+                    f"{t.commit_digest()[:16]} != dedicated {want[:16]}",
+                    file=sys.stderr,
+                )
+        for r in child_reports:
+            if not r:
+                continue
+            want = _dedicated_digest(
+                r["name"], args.validators, args.heights, sign,
+                args.verifier,
+            )
+            if r.get("digest") != want:
+                parity_ok = False
+                print(
+                    f"PARITY MISMATCH remote tenant={r['name']}: "
+                    f"{str(r.get('digest'))[:16]} != local {want[:16]}",
+                    file=sys.stderr,
+                )
+
+    summary = {
+        "tenants": args.tenants,
+        "remote_tenants": args.remote_tenants,
+        "heights": args.heights,
+        "validators": args.validators,
+        "policy": args.policy,
+        "verifier": args.verifier,
+        "completed": all(t.done for t in tenants)
+        and all(r.get("done") for r in child_reports if r),
+        "wall_s": wall,
+        "votes_per_s": (total_rows / wall) if wall > 0 else 0.0,
+        "launches": service.queue.launches,
+        "coalesced": service.queue.coalesced,
+        "multi_origin_launches": multi_origin,
+        "remote_coalesced_launches": remote_coalesced,
+        "commit_latency_p50_s": _percentile(latencies, 0.50),
+        "commit_latency_p95_s": _percentile(latencies, 0.95),
+        "commit_latency_p99_s": _percentile(latencies, 0.99),
+        "remote": None if port is None else {
+            "submits": port.remote_submits,
+            "resolves": port.remote_resolves,
+            "sheds": port.remote_sheds,
+            "children": child_reports,
+        },
+        "policy_stats": None if policy is None else {
+            "deferred_total": policy.deferred_total,
+            "forced_total": policy.forced_total,
+            "max_deferrals": policy.max_deferrals,
+        },
+        "parity_ok": parity_ok,
+    }
+    text = json.dumps(summary, indent=None if args.json else 2)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as fh:
+            fh.write(text + "\n")
+    print(text)
+    if not summary["completed"]:
+        print("serve: tenants did not finish before --timeout",
+              file=sys.stderr)
+        return 1
+    if args.parity and not parity_ok:
+        return 1
+    return 0
+
+
+def tenant(args) -> int:
+    host, _, pnum = args.connect.rpartition(":")
+    client = RemoteServiceClient(host or "127.0.0.1", int(pnum))
+    shard = TenantShard(
+        args.name, n_validators=args.validators,
+        target_height=args.heights, sign=not args.unsigned,
+    ).attach_remote(client)
+    t0 = time.perf_counter()
+    shard.run_remote(max_inflight=args.inflight, timeout=args.timeout)
+    client.close()
+    print(json.dumps({
+        "name": shard.name,
+        "done": shard.done,
+        "commits": len(shard.commits),
+        "digest": shard.commit_digest(),
+        "wall_s": time.perf_counter() - t0,
+        "rejected": shard.rejected,
+        "shed_retries": shard.shed_retries,
+        "commit_latency_p95_s": _percentile(shard.commit_latencies, 0.95),
+    }))
+    return 0 if shard.done else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m hyperdrive_tpu.parallel")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser(
+        "serve", help="run the continuously-batching multi-tenant service"
+    )
+    p.add_argument("--tenants", type=int, default=4)
+    p.add_argument("--heights", type=int, default=16)
+    p.add_argument("--validators", type=int, default=4)
+    p.add_argument("--policy", choices=("drr", "fifo"), default="drr")
+    p.add_argument("--capacity-rows", type=int, default=256)
+    p.add_argument("--quantum-rows", type=int, default=64)
+    p.add_argument("--starve-after", type=int, default=4)
+    p.add_argument("--weights", default="",
+                   help="per-tenant DRR weights, e.g. tenant-0=3,tenant-1=1")
+    p.add_argument("--verifier", choices=("host", "null", "device"),
+                   default="host")
+    p.add_argument("--max-depth", type=int, default=0,
+                   help="queue auto-drain depth (0 = drive loop drains)")
+    p.add_argument("--listen", action="store_true",
+                   help="open the remote submit port even with no children")
+    p.add_argument("--remote-tenants", type=int, default=0,
+                   help="spawn K remote tenant subprocesses over TCP")
+    p.add_argument("--parity", action="store_true",
+                   help="assert shared-service digests == per-tenant-queue")
+    p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--json", action="store_true",
+                   help="single-line JSON summary")
+    p.add_argument("-o", "--out", default="",
+                   help="also write the summary JSON to this file")
+    p.set_defaults(fn=serve)
+
+    p = sub.add_parser(
+        "tenant", help="drive one remote tenant against a serve port"
+    )
+    p.add_argument("--connect", required=True, help="HOST:PORT of the serve")
+    p.add_argument("--name", required=True)
+    p.add_argument("--validators", type=int, default=4)
+    p.add_argument("--heights", type=int, default=16)
+    p.add_argument("--unsigned", action="store_true")
+    p.add_argument("--inflight", type=int, default=4)
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.set_defaults(fn=tenant)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

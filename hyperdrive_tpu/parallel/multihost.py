@@ -34,6 +34,11 @@ import jax
 from jax.experimental import mesh_utils, multihost_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# The multi-tenant service grew into its own (jax-free) module; the
+# import here keeps every historical ``multihost.ShardVerifyService``
+# call site working.
+from hyperdrive_tpu.parallel.service import ShardVerifyService
+
 __all__ = [
     "init_distributed",
     "make_hybrid_mesh",
@@ -41,152 +46,6 @@ __all__ = [
     "replicate_to_all_hosts",
     "ShardVerifyService",
 ]
-
-
-class ShardVerifyService:
-    """One verifier + one async device-work queue, shared by every
-    replica a host runs: the multi-tenant batching seam.
-
-    A host that runs many replicas (one per shard/tenant it serves) must
-    NOT let each of them launch its own verify — per-launch sync cost
-    multiplied by tenant count is exactly the bill devsched exists to
-    split. Every tenant submits into the same
-    :class:`~hyperdrive_tpu.devsched.DeviceWorkQueue`, so windows from
-    all of them coalesce into ONE launch per drain: the sync floor is
-    paid once per pipeline slot per HOST, not per replica.
-
-    The service is deliberately mesh-agnostic — it batches the *launch
-    schedule*, while :func:`make_hybrid_mesh` shapes the *launch
-    itself*; a pod host composes both (sharded verify kernels fed by a
-    coalesced queue).
-    """
-
-    def __init__(self, verifier, queue=None, max_depth: int = 8,
-                 obs=None, tracer=None, devtel=None):
-        from hyperdrive_tpu.devsched import DeviceWorkQueue
-
-        self.verifier = verifier
-        self.queue = (
-            queue
-            if queue is not None
-            else DeviceWorkQueue(max_depth=max_depth, obs=obs,
-                                 tracer=tracer, devtel=devtel)
-        )
-        if devtel is not None:
-            # An externally-built queue adopts the service's probe (the
-            # same late-binding the sim applies to its queue).
-            self.queue.devtel = devtel
-        self._launcher = self.queue.verify_launcher(verifier)
-        #: Commands submitted per tenant key (observability).
-        self.tenants: dict = {}
-        #: Tenant key -> small stable int track id (first-submit order):
-        #: what the launch probe records as each command's origin, so
-        #: journal events and registry labels agree on the tenant axis.
-        self.tenant_ids: dict = {}
-        #: tenant -> {height -> QuorumCertificate}: O(1) commit proofs
-        #: accepted through :meth:`accept_certificate`. A proof that
-        #: fails the certifier's check never lands here.
-        self.certificates: dict = {}
-
-    def certifier(self, signatories, f, obs=None):
-        """A :class:`~hyperdrive_tpu.certificates.Certifier` for one
-        tenant, transcript-bound to this service's shared launcher — its
-        certificates commit to the coalesced launch that verified the
-        quorum, whichever tenants co-submitted into it."""
-        from hyperdrive_tpu.certificates import Certifier
-
-        return Certifier(
-            signatories, f,
-            transcript_source=lambda: self._launcher.last_transcript,
-            obs=obs,
-        )
-
-    def accept_certificate(self, tenant, certifier, cert) -> bool:
-        """Cross-tenant commit-proof exchange: re-verify ``cert`` in
-        O(1) against ``certifier`` (quorum weight + binding; no
-        signatures re-checked, no vote set re-gossiped) and register it
-        under ``tenant`` on success. This replaces shipping the 2f+1
-        precommits a remote shard would otherwise need to trust the
-        commit."""
-        from hyperdrive_tpu.obs.devtel import NULL_DEVTEL
-
-        devtel = self.queue.devtel
-        t0 = devtel.now() if devtel is not NULL_DEVTEL else 0.0
-        ok = certifier.verify(cert)
-        if devtel is not NULL_DEVTEL:
-            # Per-tenant commit latency: the O(1) proof re-check that
-            # finalizes a remote shard's commit locally.
-            tid = self.tenant_ids.get(tenant)
-            if tid is None:
-                tid = self.tenant_ids[tenant] = len(self.tenant_ids)
-            devtel.tenant_latency(tid, devtel.now() - t0, "commit")
-        if not ok:
-            return False
-        self.certificates.setdefault(tenant, {})[cert.height] = cert
-        return True
-
-    def submit(self, tenant, items, generation: int = 0):
-        """Enqueue one tenant's verify batch; returns its
-        :class:`~hyperdrive_tpu.devsched.DeviceFuture`. ``tenant`` is an
-        opaque accounting key (replica id, shard id). ``generation``
-        tags the batch with its epoch pubkey-table generation
-        (epochs.py): tenants on different generations — mid-rotation,
-        some tenants already switched — still share the queue, but
-        their windows coalesce per generation, never into a mixed-key
-        launch."""
-        self.tenants[tenant] = self.tenants.get(tenant, 0) + 1
-        tid = self.tenant_ids.get(tenant)
-        if tid is None:
-            tid = self.tenant_ids[tenant] = len(self.tenant_ids)
-        fut = self.queue.submit(
-            self._launcher, items, generation,
-            origin=tid, rows=len(items),
-        )
-        from hyperdrive_tpu.obs.devtel import NULL_DEVTEL
-
-        devtel = self.queue.devtel
-        if devtel is not NULL_DEVTEL:
-            # Per-tenant verify latency: submit -> resolution, on the
-            # probe's (injectable) clock, into a labeled mergeable
-            # histogram (tenant.verify.latency{label=<tid>}).
-            t0 = devtel.now()
-
-            def _observe(f, devtel=devtel, t0=t0, tid=tid):
-                devtel.tenant_latency(tid, devtel.now() - t0, "verify")
-
-            fut.add_done_callback(_observe)
-        return fut
-
-    def rotate(self, generation: int, table=None) -> None:
-        """Propagate an epoch rotation to the shared verifier: installs
-        ``table`` when the verifier holds resident state
-        (:meth:`~hyperdrive_tpu.ops.ed25519_wire.TpuWireVerifier.
-        install_table` double-buffers it) and records the generation on
-        transcript-binding verifiers. Tenants then pass ``generation``
-        to :meth:`submit`; in-flight commands keep their old tag."""
-        if table is not None and hasattr(self.verifier, "install_table"):
-            self.verifier.install_table(table, generation)
-        elif hasattr(self.verifier, "set_generation"):
-            self.verifier.set_generation(generation)
-
-    def flusher(self, validators, **kwargs):
-        """A queue-backed :class:`~hyperdrive_tpu.tallyflush.
-        DeviceTallyFlusher` for one tenant replica. Every flusher built
-        here shares this service's queue (and verifier), which is the
-        whole point: co-located replicas' flush windows coalesce."""
-        from hyperdrive_tpu.tallyflush import DeviceTallyFlusher
-
-        return DeviceTallyFlusher(
-            self.verifier, validators, queue=self.queue, **kwargs
-        )
-
-    def drain(self) -> int:
-        """Resolve every tenant's pending commands (one coalesced
-        launch); the host event loop's idle hook."""
-        return self.queue.drain()
-
-    def close(self) -> int:
-        return self.queue.close()
 
 
 def init_distributed(
@@ -253,7 +112,6 @@ def make_hybrid_mesh(hr_dcn: int | None = None, val_ici: int | None = None) -> M
         # psums never leave a slice. That requires hr_dcn to absorb the
         # whole process count; validate here with the constraint spelled
         # out rather than letting mesh_utils fail on a derived shape.
-        local = n_dev // n_proc
         if hr_dcn % n_proc != 0:
             raise ValueError(
                 f"hr_dcn ({hr_dcn}) must be a multiple of the process "
@@ -261,10 +119,16 @@ def make_hybrid_mesh(hr_dcn: int | None = None, val_ici: int | None = None) -> M
                 f"quorum psums — stays inside one slice's ICI domain"
             )
         per_granule_hr = hr_dcn // n_proc
+        # Check against the devices ACTUALLY attached to this process,
+        # not the global-count average: on a misconfigured pod (uneven
+        # device visibility, a host joined with the wrong topology) the
+        # average can look right while the local slab cannot hold its
+        # per-granule tile.
+        local = jax.local_device_count()
         if per_granule_hr * val_ici != local:
             raise ValueError(
                 f"per-process mesh {per_granule_hr}x{val_ici} does not "
-                f"match the {local} devices attached to each process"
+                f"match the {local} devices attached to this process"
             )
         # Granule = process: 'hr' tiles one row-block per process, which
         # keeps 'val' on process-local (hence intra-slice) devices. This

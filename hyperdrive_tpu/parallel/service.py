@@ -1,0 +1,1002 @@
+"""The multi-tenant verify service: continuous batching as a deployment.
+
+M independent shard-consensus instances (each its own committee, its own
+chain) funnel verify/tally windows into ONE
+:class:`~hyperdrive_tpu.devsched.DeviceWorkQueue` — inference-server
+continuous batching applied to consensus: the drain loop coalesces
+whatever is pending across ALL tenants into the next launch, so the
+measured ~107 ms launch+sync floor is amortized across every instance
+instead of paid per shard (BENCH_r11; PAPERS.md "ACE Runtime" makes the
+serving-system framing, arXiv:2302.00418 shows verify throughput is the
+binding resource).
+
+Three layers, all host-side and jax-free (the device enters only through
+whatever verifier the caller hands in):
+
+- :class:`ShardVerifyService` — the shared verifier + queue + per-tenant
+  accounting (certificates, watermarks, telemetry tracks). The drain
+  policy seam (devsched/policy.py) rides the queue, so a firehose tenant
+  cannot monopolize launch occupancy.
+- :class:`ServicePort` / :class:`RemoteServiceClient` — cross-process
+  batching over the transport's length-framed TCP machinery: replicas in
+  OTHER processes ship packed precommit windows to the host that owns
+  the device queue and get their futures resolved by certificate frames
+  back (O(1) proof, not 2f+1 signatures). Ingress reuses the
+  admission/backpressure doctrine from ``load/``: duplicate and
+  stale-height windows shed at pressure, CRITICAL_ONLY turns submits
+  away with a busy status, and nothing is ever silently dropped — every
+  request is answered.
+- :class:`TenantShard` — one instance's drive loop: sign a window,
+  submit (locally or through a client), count the quorum, mint/verify
+  the certificate, record the commit. The same class runs both sides of
+  the wire, which is what makes local-vs-remote digest parity a single
+  assertion.
+
+``python -m hyperdrive_tpu.parallel serve`` runs the deployment shape;
+``benches/multitenant_bench.py`` measures it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import queue as queue_mod
+import socket
+import threading
+import time
+
+from hyperdrive_tpu.certificates import (
+    marshal_certificate,
+    unmarshal_certificate,
+)
+from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.crypto.keys import KeyRing
+from hyperdrive_tpu.messages import Precommit
+from hyperdrive_tpu.obs.recorder import NULL_BOUND
+from hyperdrive_tpu.transport import _LEN, _MAX_FRAME, _recv_exact
+
+__all__ = [
+    "ShardVerifyService",
+    "ServicePort",
+    "RemoteServiceClient",
+    "RemoteFuture",
+    "TenantShard",
+    "STATUS_COMMITTED",
+    "STATUS_NO_QUORUM",
+    "STATUS_SHED",
+    "STATUS_UNKNOWN_TENANT",
+]
+
+# ------------------------------------------------------------ wire format
+#
+# Same 4-byte little-endian length framing as transport.py, distinct
+# payload tags (this port speaks windows and certificates, not consensus
+# envelopes). All payloads go through codec.Writer/Reader so adversarial
+# bytes raise SerdeError instead of crashing the port.
+
+TAG_HELLO = 1
+TAG_SUBMIT = 2
+TAG_RESULT = 3
+
+STATUS_COMMITTED = 0
+STATUS_NO_QUORUM = 1
+STATUS_SHED = 2
+STATUS_UNKNOWN_TENANT = 3
+
+STATUS_NAMES = ("committed", "no_quorum", "shed", "unknown_tenant")
+
+#: Committee width cap for HELLO (matches the certificate bitmap cap).
+_MAX_SIGNATORIES = 4096
+#: Rows per submitted window — far above any committee's 2f+1 burst.
+_MAX_ROWS = 65536
+
+
+def encode_hello(name: str, signatories, f: int) -> bytes:
+    w = Writer()
+    w.u8(TAG_HELLO)
+    w.raw(name.encode("utf-8"))
+    w.u32(int(f))
+    w.u32(len(signatories))
+    for s in signatories:
+        w.bytes32(s)
+    return w.data()
+
+
+def encode_submit(req_id: int, height: int, round: int, value: bytes,
+                  rows, generation: int = 0) -> bytes:
+    """``rows``: signed :class:`~hyperdrive_tpu.messages.Precommit`s (or
+    bare ``(sender, signature)`` pairs) for ONE (height, round, value)
+    window. The digest is recomputed server-side from the header, so the
+    wire carries 32 + ~68 bytes per row, not the whole envelope."""
+    w = Writer()
+    w.u8(TAG_SUBMIT)
+    w.u64(req_id)
+    w.i64(height)
+    w.i64(round)
+    w.bytes32(value)
+    w.u32(int(generation))
+    w.u32(len(rows))
+    for row in rows:
+        if isinstance(row, tuple):
+            sender, sig = row
+        else:
+            sender, sig = row.sender, row.signature
+        w.bytes32(sender)
+        w.raw(sig)
+    return w.data()
+
+
+def encode_result(req_id: int, status: int, nrows: int, mask,
+                  cert=None) -> bytes:
+    w = Writer()
+    w.u8(TAG_RESULT)
+    w.u64(req_id)
+    w.u8(int(status))
+    w.u32(int(nrows))
+    bitmap = bytearray(-(-nrows // 8)) if nrows else bytearray()
+    for i, ok in enumerate(mask or ()):
+        if ok:
+            bitmap[i >> 3] |= 1 << (i & 7)
+    w.raw(bytes(bitmap))
+    if cert is not None:
+        cw = Writer()
+        marshal_certificate(cert, cw)
+        w.raw(cw.data())
+    else:
+        w.raw(b"")
+    return w.data()
+
+
+def decode_request(payload: bytes):
+    """Server-side decode: ``("hello", name, f, signatories)`` or
+    ``("submit", req_id, height, round, value, generation, rows)`` with
+    ``rows`` as ``(sender, signature)`` pairs. Raises SerdeError on
+    anything malformed or over the width caps."""
+    r = Reader(payload)
+    tag = r.u8()
+    if tag == TAG_HELLO:
+        name = r.raw().decode("utf-8", "replace")
+        f = r.u32()
+        n = r.u32()
+        if n > _MAX_SIGNATORIES:
+            raise SerdeError(f"committee too wide: {n}")
+        return ("hello", name, f, [r.bytes32() for _ in range(n)])
+    if tag == TAG_SUBMIT:
+        req_id = r.u64()
+        height = r.i64()
+        rnd = r.i64()
+        value = r.bytes32()
+        generation = r.u32()
+        n = r.u32()
+        if n > _MAX_ROWS:
+            raise SerdeError(f"window too wide: {n} rows")
+        rows = [(r.bytes32(), r.raw()) for _ in range(n)]
+        return ("submit", req_id, height, rnd, value, generation, rows)
+    raise SerdeError(f"unknown service frame tag: {tag}")
+
+
+def decode_result(payload: bytes):
+    """Client-side decode: ``(req_id, status, mask, cert_or_None)``."""
+    r = Reader(payload)
+    if r.u8() != TAG_RESULT:
+        raise SerdeError("expected a result frame")
+    req_id = r.u64()
+    status = r.u8()
+    n = r.u32()
+    if n > _MAX_ROWS:
+        raise SerdeError(f"result mask too wide: {n} rows")
+    bitmap = r.raw()
+    if len(bitmap) < -(-n // 8):
+        raise SerdeError("result bitmap narrower than its row count")
+    mask = [bool(bitmap[i >> 3] >> (i & 7) & 1) for i in range(n)]
+    cert_bytes = r.raw()
+    cert = unmarshal_certificate(Reader(cert_bytes)) if cert_bytes else None
+    return req_id, status, mask, cert
+
+
+# ---------------------------------------------------------------- service
+
+
+class ShardVerifyService:
+    """One verifier + one async device-work queue, shared by every
+    replica a host runs: the multi-tenant batching seam.
+
+    A host that runs many replicas (one per shard/tenant it serves) must
+    NOT let each of them launch its own verify — per-launch sync cost
+    multiplied by tenant count is exactly the bill devsched exists to
+    split. Every tenant submits into the same
+    :class:`~hyperdrive_tpu.devsched.DeviceWorkQueue`, so windows from
+    all of them coalesce into ONE launch per drain: the sync floor is
+    paid once per pipeline slot per HOST, not per replica.
+
+    ``policy`` installs a tenant-aware drain policy
+    (:class:`~hyperdrive_tpu.devsched.DeficitRoundRobin`) on the queue;
+    the default keeps the digest-neutral FIFO drain. ``cert_keep``
+    bounds per-tenant certificate retention: entries more than
+    ``cert_keep`` heights below the tenant's committed-height watermark
+    are retired on accept, so a long-running service stays O(tenants),
+    not O(heights). ``remote_port()`` opens the cross-process submit
+    path (:class:`ServicePort`).
+
+    The service is deliberately mesh-agnostic — it batches the *launch
+    schedule*, while :func:`~hyperdrive_tpu.parallel.multihost.
+    make_hybrid_mesh` shapes the *launch itself*; a pod host composes
+    both (sharded verify kernels fed by a coalesced queue).
+    """
+
+    def __init__(self, verifier, queue=None, max_depth: int = 8,
+                 obs=None, tracer=None, devtel=None, policy=None,
+                 cert_keep=None):
+        from hyperdrive_tpu.devsched import DeviceWorkQueue
+
+        self.verifier = verifier
+        self.queue = (
+            queue
+            if queue is not None
+            else DeviceWorkQueue(max_depth=max_depth, obs=obs,
+                                 tracer=tracer, devtel=devtel,
+                                 policy=policy)
+        )
+        if devtel is not None:
+            # An externally-built queue adopts the service's probe (the
+            # same late-binding the sim applies to its queue).
+            self.queue.devtel = devtel
+        if policy is not None and self.queue.policy is None:
+            self.queue.policy = policy
+        self.obs = obs if obs is not None else self.queue.obs
+        self._launcher = self.queue.verify_launcher(verifier)
+        #: Commands submitted per tenant key (observability).
+        self.tenants: dict = {}
+        #: Tenant key -> small stable int track id (first-submit order):
+        #: what the launch probe records as each command's origin, so
+        #: journal events and registry labels agree on the tenant axis.
+        #: Ids are never reused, even after :meth:`retire_tenant` — a
+        #: revived tenant must not inherit a dead one's track.
+        self.tenant_ids: dict = {}
+        self._next_tid = 0
+        #: tenant -> {height -> QuorumCertificate}: O(1) commit proofs
+        #: accepted through :meth:`accept_certificate`. A proof that
+        #: fails the certifier's check never lands here.
+        self.certificates: dict = {}
+        #: tenant -> highest committed height accepted (the retirement
+        #: watermark; also the remote port's stale-height reference).
+        self.watermarks: dict = {}
+        self.cert_keep = None if cert_keep is None else int(cert_keep)
+        self.retired_certs = 0
+
+    def _tenant_id(self, tenant) -> int:
+        tid = self.tenant_ids.get(tenant)
+        if tid is None:
+            tid = self.tenant_ids[tenant] = self._next_tid
+            self._next_tid += 1
+        return tid
+
+    def certifier(self, signatories, f, obs=None):
+        """A :class:`~hyperdrive_tpu.certificates.Certifier` for one
+        tenant, transcript-bound to this service's shared launcher — its
+        certificates commit to the coalesced launch that verified the
+        quorum, whichever tenants co-submitted into it."""
+        from hyperdrive_tpu.certificates import Certifier
+
+        return Certifier(
+            signatories, f,
+            transcript_source=lambda: self._launcher.last_transcript,
+            obs=obs,
+        )
+
+    def accept_certificate(self, tenant, certifier, cert) -> bool:
+        """Cross-tenant commit-proof exchange: re-verify ``cert`` in
+        O(1) against ``certifier`` (quorum weight + binding; no
+        signatures re-checked, no vote set re-gossiped) and register it
+        under ``tenant`` on success. This replaces shipping the 2f+1
+        precommits a remote shard would otherwise need to trust the
+        commit."""
+        from hyperdrive_tpu.obs.devtel import NULL_DEVTEL
+
+        devtel = self.queue.devtel
+        t0 = devtel.now() if devtel is not NULL_DEVTEL else 0.0
+        ok = certifier.verify(cert)
+        if devtel is not NULL_DEVTEL:
+            # Per-tenant commit latency: the O(1) proof re-check that
+            # finalizes a remote shard's commit locally. Rejected proofs
+            # land in their own histogram — a forged or stale cert must
+            # not pollute the committed-path p95/p99.
+            devtel.tenant_latency(
+                self._tenant_id(tenant),
+                devtel.now() - t0,
+                "commit" if ok else "commit_rejected",
+            )
+        if not ok:
+            return False
+        certs = self.certificates.setdefault(tenant, {})
+        certs[cert.height] = cert
+        wm = self.watermarks.get(tenant, 0)
+        if cert.height > wm:
+            wm = self.watermarks[tenant] = cert.height
+        if self.cert_keep is not None:
+            floor = wm - self.cert_keep
+            if floor > 0:
+                stale = [h for h in certs if h <= floor]
+                for h in stale:
+                    del certs[h]
+                if stale:
+                    self.retired_certs += len(stale)
+                    if self.obs is not NULL_BOUND:
+                        self.obs.emit(
+                            "service.tenant.retire", wm,
+                            self._tenant_id(tenant), len(stale),
+                        )
+        return True
+
+    def retire_tenant(self, tenant) -> int:
+        """Drop every table entry for a departed tenant; returns how
+        many certificates were released. The tenant's track id is
+        retired with it (never reused)."""
+        released = len(self.certificates.pop(tenant, ()))
+        self.tenants.pop(tenant, None)
+        tid = self.tenant_ids.pop(tenant, None)
+        self.watermarks.pop(tenant, None)
+        if released:
+            self.retired_certs += released
+        if tid is not None and self.obs is not NULL_BOUND:
+            self.obs.emit("service.tenant.retire", -1, tid, released)
+        return released
+
+    def submit(self, tenant, items, generation: int = 0):
+        """Enqueue one tenant's verify batch; returns its
+        :class:`~hyperdrive_tpu.devsched.DeviceFuture`. ``tenant`` is an
+        opaque accounting key (replica id, shard id). ``generation``
+        tags the batch with its epoch pubkey-table generation
+        (epochs.py): tenants on different generations — mid-rotation,
+        some tenants already switched — still share the queue, but
+        their windows coalesce per generation, never into a mixed-key
+        launch."""
+        self.tenants[tenant] = self.tenants.get(tenant, 0) + 1
+        tid = self._tenant_id(tenant)
+        fut = self.queue.submit(
+            self._launcher, items, generation,
+            origin=tid, rows=len(items),
+        )
+        from hyperdrive_tpu.obs.devtel import NULL_DEVTEL
+
+        devtel = self.queue.devtel
+        if devtel is not NULL_DEVTEL:
+            # Per-tenant verify latency: submit -> resolution, on the
+            # probe's (injectable) clock, into a labeled mergeable
+            # histogram (tenant.verify.latency{label=<tid>}).
+            t0 = devtel.now()
+
+            def _observe(f, devtel=devtel, t0=t0, tid=tid):
+                devtel.tenant_latency(tid, devtel.now() - t0, "verify")
+
+            fut.add_done_callback(_observe)
+        return fut
+
+    def rotate(self, generation: int, table=None) -> None:
+        """Propagate an epoch rotation to the shared verifier: installs
+        ``table`` when the verifier holds resident state
+        (:meth:`~hyperdrive_tpu.ops.ed25519_wire.TpuWireVerifier.
+        install_table` double-buffers it) and records the generation on
+        transcript-binding verifiers. Tenants then pass ``generation``
+        to :meth:`submit`; in-flight commands keep their old tag."""
+        if table is not None and hasattr(self.verifier, "install_table"):
+            self.verifier.install_table(table, generation)
+        elif hasattr(self.verifier, "set_generation"):
+            self.verifier.set_generation(generation)
+
+    def flusher(self, validators, **kwargs):
+        """A queue-backed :class:`~hyperdrive_tpu.tallyflush.
+        DeviceTallyFlusher` for one tenant replica. Every flusher built
+        here shares this service's queue (and verifier), which is the
+        whole point: co-located replicas' flush windows coalesce."""
+        from hyperdrive_tpu.tallyflush import DeviceTallyFlusher
+
+        return DeviceTallyFlusher(
+            self.verifier, validators, queue=self.queue, **kwargs
+        )
+
+    def remote_port(self, host: str = "127.0.0.1", port: int = 0,
+                    controller=None, obs=None) -> "ServicePort":
+        """Open the cross-process submit path: replicas in other
+        processes connect a :class:`RemoteServiceClient` here and their
+        windows coalesce into the same launches as local tenants'."""
+        return ServicePort(
+            self, host=host, port=port, controller=controller, obs=obs
+        )
+
+    def drain(self) -> int:
+        """Resolve every tenant's pending commands (one coalesced
+        launch); the host event loop's idle hook."""
+        return self.queue.drain()
+
+    def close(self) -> int:
+        return self.queue.close()
+
+
+# ----------------------------------------------------------- tenant shard
+
+
+class TenantShard:
+    """One shard-consensus instance's drive loop against a service.
+
+    Deliberately smaller than a full :class:`~hyperdrive_tpu.harness.
+    sim.Simulation`: the serving benchmark measures the VERIFY/COMMIT
+    data path (window → coalesced launch → quorum → certificate), so the
+    shard models exactly that — a deterministic committee
+    (``KeyRing.deterministic`` under a per-tenant namespace) emitting
+    one full precommit window per height. ``sign=False`` swaps real
+    Ed25519 signatures for fixed nonzero bytes (the NullVerifier /
+    chaos leg, jax- and crypto-free).
+
+    ``commit_digest()`` is the cross-run equality handle: the same
+    canonical fold the sim's ``SimulationResult.commit_digest`` uses,
+    over this tenant's committed (height, value) pairs — shared-service
+    vs per-tenant-queue vs remote-over-TCP runs must all agree on it.
+    """
+
+    def __init__(self, name: str, n_validators: int = 4, f=None,
+                 target_height: int = 8, sign: bool = True,
+                 time_fn=None):
+        self.name = str(name)
+        self.ring = KeyRing.deterministic(
+            n_validators, namespace=b"tenant/" + self.name.encode()
+        )
+        self.f = (n_validators - 1) // 3 if f is None else int(f)
+        self.target_height = int(target_height)
+        self.sign = bool(sign)
+        self.time_fn = time_fn if time_fn is not None else time.perf_counter
+        self.certifier = None
+        self.service = None
+        self.client = None
+        self.generation = 0
+        #: height -> committed value (32 bytes), in acceptance order.
+        self.commits: dict = {}
+        #: Per-commit submit->finalize latency (seconds on time_fn).
+        self.commit_latencies: list = []
+        self.rejected = 0
+        self.shed_retries = 0
+        self.next_height = 1
+        self._inflight = 0
+
+    # ---------------------------------------------------------- windows
+
+    def value_at(self, height: int) -> bytes:
+        return hashlib.sha256(
+            f"{self.name}:{height}".encode()
+        ).digest()
+
+    def window(self, height: int) -> list:
+        """The full committee's signed precommits for ``height``."""
+        value = self.value_at(height)
+        rows = []
+        for kp in self.ring.pairs:
+            pc = Precommit(
+                height=height, round=0, value=value, sender=kp.public
+            )
+            rows.append(
+                kp.sign_message(pc) if self.sign
+                else pc.with_signature(b"\x01" * 64)
+            )
+        return rows
+
+    @property
+    def done(self) -> bool:
+        return len(self.commits) >= self.target_height
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    def commit_digest(self) -> str:
+        h = hashlib.sha256()
+        for height in sorted(self.commits):
+            h.update(int(height).to_bytes(8, "little"))
+            h.update(self.commits[height])
+        return h.hexdigest()
+
+    # ------------------------------------------------------- local drive
+
+    def attach_local(self, service: ShardVerifyService,
+                     generation: int = 0) -> "TenantShard":
+        self.service = service
+        self.generation = int(generation)
+        self.certifier = service.certifier(self.ring.signatories, self.f)
+        return self
+
+    def pump(self, max_inflight: int = 2) -> int:
+        """Submit up to ``max_inflight`` outstanding height windows into
+        the attached local service; commits finalize inside the queue's
+        drain via done-callbacks. Returns how many windows were
+        submitted. The caller owns the drain cadence (that IS the
+        continuous-batching knob)."""
+        submitted = 0
+        while (
+            self.next_height <= self.target_height
+            and self._inflight < max_inflight
+        ):
+            height = self.next_height
+            self.next_height += 1
+            self._inflight += 1
+            value = self.value_at(height)
+            rows = self.window(height)
+            items = [(pc.sender, pc.digest(), pc.signature) for pc in rows]
+            t0 = self.time_fn()
+            fut = self.service.submit(self.name, items, self.generation)
+            fut.add_done_callback(
+                lambda f, height=height, value=value, rows=rows, t0=t0:
+                self._finalize(f, height, value, rows, t0)
+            )
+            submitted += 1
+        return submitted
+
+    def _finalize(self, fut, height, value, rows, t0) -> None:
+        self._inflight -= 1
+        mask = fut.result()
+        signers = [pc.sender for pc, ok in zip(rows, mask) if ok]
+        if len(set(signers)) < 2 * self.f + 1:
+            self.rejected += 1
+            return
+        cert = self.certifier.observe_commit(height, 0, value, signers)
+        if self.service.accept_certificate(self.name, self.certifier, cert):
+            self.commits[height] = value
+            self.commit_latencies.append(self.time_fn() - t0)
+        else:
+            self.rejected += 1
+
+    # ------------------------------------------------------ remote drive
+
+    def attach_remote(self, client: "RemoteServiceClient",
+                      generation: int = 0) -> "TenantShard":
+        """Bind to a service in ANOTHER process: introduces the
+        committee over the wire, and builds a local certifier — its
+        :meth:`~hyperdrive_tpu.certificates.Certifier.verify` is fully
+        self-contained (binding recomputation, no transcript state), so
+        server-minted certificates finalize here in O(1)."""
+        from hyperdrive_tpu.certificates import Certifier
+
+        self.client = client
+        self.generation = int(generation)
+        self.certifier = Certifier(self.ring.signatories, self.f)
+        client.hello(self.name, self.ring.signatories, self.f)
+        return self
+
+    def run_remote(self, max_inflight: int = 4, timeout: float = 30.0,
+                   max_shed_retries: int = 1024) -> None:
+        """Drive every height through the attached client. Keeps
+        ``max_inflight`` windows on the wire so the serving host can
+        coalesce them with other tenants' work; a shed (busy) answer
+        re-submits the same height — backpressure is flow control here,
+        never data loss."""
+        pending: dict = {}
+        while not self.done:
+            while (
+                self.next_height <= self.target_height
+                and len(pending) < max_inflight
+            ):
+                height = self.next_height
+                self.next_height += 1
+                pending[height] = self._remote_submit(height)
+            if not pending:
+                break
+            height = min(pending)
+            fut, value, t0 = pending.pop(height)
+            status, mask, cert = fut.result(timeout)
+            if status == STATUS_SHED:
+                self.shed_retries += 1
+                if self.shed_retries > max_shed_retries:
+                    raise RuntimeError(
+                        f"tenant {self.name}: height {height} shed "
+                        f"{max_shed_retries} times"
+                    )
+                pending[height] = self._remote_submit(height)
+                continue
+            if (
+                status == STATUS_COMMITTED
+                and cert is not None
+                and cert.height == height
+                and self.certifier.verify(cert)
+            ):
+                self.commits[height] = value
+                self.commit_latencies.append(self.time_fn() - t0)
+            else:
+                self.rejected += 1
+
+    def _remote_submit(self, height: int):
+        value = self.value_at(height)
+        rows = self.window(height)
+        t0 = self.time_fn()
+        fut = self.client.submit(
+            height, 0, value, rows, generation=self.generation
+        )
+        return (fut, value, t0)
+
+
+# ------------------------------------------------------------ server port
+
+
+class _RemoteConn:
+    """One accepted connection's state: socket, bounded sender queue,
+    and — after HELLO — the tenant identity, its certifier, and its
+    admission gate."""
+
+    __slots__ = (
+        "sock", "outbox", "tenant", "f", "certifier", "gate",
+        "send_drops", "closed",
+    )
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.outbox = queue_mod.Queue(maxsize=4096)
+        self.tenant = None
+        self.f = 0
+        self.certifier = None
+        self.gate = None
+        self.send_drops = 0
+        self.closed = False
+
+
+class ServicePort:
+    """The cross-process submit path of one :class:`ShardVerifyService`.
+
+    Socket I/O runs on daemon threads (an accept loop plus one
+    reader/sender pair per connection — the transport.py shape), but
+    every decision touches the service on the owner's drive loop:
+    readers park decoded requests in an inbox, and :meth:`pump` —
+    called from the same thread that drains the queue — admits,
+    submits, and resolves. The queue's single-writer discipline is
+    preserved by construction.
+
+    Admission reuses the ``load/`` doctrine verbatim: a
+    :class:`~hyperdrive_tpu.load.backpressure.BackpressureController`
+    watching the shared queue sets the level, and each tenant's
+    :class:`~hyperdrive_tpu.load.backpressure.AdmissionGate` sheds
+    duplicate/stale precommit rows at SHED_DUPLICATES and above (the
+    gate's ``height_fn`` is the tenant's committed watermark, so replays
+    of finalized heights classify stale). At CRITICAL_ONLY the port
+    answers ``STATUS_SHED`` without touching the queue — the client
+    retries, so overload is flow control, not loss.
+    """
+
+    def __init__(self, service: ShardVerifyService,
+                 host: str = "127.0.0.1", port: int = 0,
+                 controller=None, obs=None):
+        from hyperdrive_tpu.load.backpressure import BackpressureController
+
+        self.service = service
+        self.obs = obs if obs is not None else service.obs
+        if controller is None:
+            controller = BackpressureController()
+            controller.watch(service.queue)
+        self.controller = controller
+        self._inbox: queue_mod.Queue = queue_mod.Queue()
+        self._conns: list = []
+        self._lock = threading.Lock()
+        self._closed = False
+        #: Remote windows submitted into the queue and not yet resolved.
+        self.inflight = 0
+        #: Lifetime counters (tests / the serve report).
+        self.remote_submits = 0
+        self.remote_resolves = 0
+        self.remote_sheds = 0
+        self.bad_frames = 0
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(64)
+        self._srv = srv
+        self.address = srv.getsockname()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="svcport-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    # --------------------------------------------------------- io threads
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._srv.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _RemoteConn(sock)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._read_loop, args=(conn,),
+                name="svcport-read", daemon=True,
+            ).start()
+            threading.Thread(
+                target=self._send_loop, args=(conn,),
+                name="svcport-send", daemon=True,
+            ).start()
+
+    def _read_loop(self, conn: _RemoteConn) -> None:
+        sock = conn.sock
+        try:
+            while True:
+                header = _recv_exact(sock, _LEN.size)
+                if header is None:
+                    return
+                (n,) = _LEN.unpack(header)
+                if n > _MAX_FRAME:
+                    return
+                payload = _recv_exact(sock, n)
+                if payload is None:
+                    return
+                self._inbox.put((conn, payload))
+        except OSError:
+            return
+        finally:
+            conn.closed = True
+
+    def _send_loop(self, conn: _RemoteConn) -> None:
+        while True:
+            frame = conn.outbox.get()
+            if frame is None:
+                return
+            try:
+                conn.sock.sendall(frame)
+            except OSError:
+                conn.closed = True
+                return
+
+    def _send(self, conn: _RemoteConn, payload: bytes) -> None:
+        try:
+            conn.outbox.put_nowait(_LEN.pack(len(payload)) + payload)
+        except queue_mod.Full:
+            conn.send_drops += 1
+
+    # -------------------------------------------------------- drive loop
+
+    def pump(self, max_requests: int = 64) -> int:
+        """Process up to ``max_requests`` parked requests on the
+        caller's (drive-loop) thread. Submitted windows resolve at the
+        next queue drain, whose done-callbacks send the certificate
+        frames back. Returns how many requests were handled."""
+        handled = 0
+        while handled < max_requests:
+            try:
+                conn, payload = self._inbox.get_nowait()
+            except queue_mod.Empty:
+                break
+            handled += 1
+            try:
+                req = decode_request(payload)
+            except SerdeError:
+                self.bad_frames += 1
+                continue
+            if req[0] == "hello":
+                self._handle_hello(conn, *req[1:])
+            else:
+                self._handle_submit(conn, *req[1:])
+        return handled
+
+    def _handle_hello(self, conn, name, f, signatories) -> None:
+        from hyperdrive_tpu.load.backpressure import AdmissionGate
+
+        conn.tenant = name
+        conn.f = int(f)
+        conn.certifier = self.service.certifier(signatories, f)
+        watermarks = self.service.watermarks
+        conn.gate = AdmissionGate(
+            self.controller,
+            height_fn=lambda name=name: watermarks.get(name, 0) + 1,
+        )
+
+    def _handle_submit(self, conn, req_id, height, rnd, value,
+                       generation, rows) -> None:
+        from hyperdrive_tpu.load.backpressure import CRITICAL_ONLY
+
+        if conn.tenant is None:
+            self._send(
+                conn,
+                encode_result(req_id, STATUS_UNKNOWN_TENANT, len(rows), ()),
+            )
+            return
+        if self.controller.poll() >= CRITICAL_ONLY:
+            # Panic level: answer busy without touching the queue. The
+            # client re-submits — certificates/windows are never lost,
+            # merely deferred (the load/ doctrine's never-drop-quorum
+            # rule, expressed as flow control).
+            self.remote_sheds += 1
+            if self.obs is not NULL_BOUND:
+                self.obs.emit(
+                    "service.remote.shed", height, rnd, conn.tenant
+                )
+            self._send(
+                conn, encode_result(req_id, STATUS_SHED, len(rows), ())
+            )
+            return
+        precommits = [
+            Precommit(
+                height=height, round=rnd, value=value, sender=sender,
+                signature=sig,
+            )
+            for sender, sig in rows
+        ]
+        admitted_idx = [
+            i for i, pc in enumerate(precommits)
+            if conn.gate.admit(pc, peer=conn.tenant)
+        ]
+        if rows and not admitted_idx:
+            # Every row shed (duplicate window / stale height): busy-
+            # answer so the client backs off and retries or moves on.
+            self.remote_sheds += 1
+            if self.obs is not NULL_BOUND:
+                self.obs.emit(
+                    "service.remote.shed", height, rnd, conn.tenant
+                )
+            self._send(
+                conn, encode_result(req_id, STATUS_SHED, len(rows), ())
+            )
+            return
+        items = [
+            (precommits[i].sender, precommits[i].digest(),
+             precommits[i].signature)
+            for i in admitted_idx
+        ]
+        self.remote_submits += 1
+        self.inflight += 1
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "service.remote.submit", height, rnd, len(items)
+            )
+        fut = self.service.submit(conn.tenant, items, generation)
+        fut.add_done_callback(
+            lambda f, conn=conn, req_id=req_id, height=height, rnd=rnd,
+            value=value, rows=rows, admitted_idx=admitted_idx:
+            self._resolve(
+                f, conn, req_id, height, rnd, value, rows, admitted_idx
+            )
+        )
+
+    def _resolve(self, fut, conn, req_id, height, rnd, value, rows,
+                 admitted_idx) -> None:
+        """Queue-drain callback: fold the launch verdict back into a
+        full-window mask, mint the certificate if the quorum stands,
+        and answer with ONE O(1) certificate frame — never the 2f+1
+        signatures."""
+        self.inflight -= 1
+        verdict = [] if fut.cancelled() else fut.result()
+        mask = [False] * len(rows)
+        for i, ok in zip(admitted_idx, verdict):
+            mask[i] = bool(ok)
+        signers = [rows[i][0] for i in range(len(rows)) if mask[i]]
+        status = STATUS_NO_QUORUM
+        cert = None
+        if len(set(signers)) >= 2 * conn.f + 1:
+            cert = conn.certifier.observe_commit(height, rnd, value, signers)
+            if self.service.accept_certificate(
+                conn.tenant, conn.certifier, cert
+            ):
+                status = STATUS_COMMITTED
+            else:
+                cert = None
+        self.remote_resolves += 1
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "service.remote.resolve", height, rnd,
+                STATUS_NAMES[status],
+            )
+        self._send(
+            conn, encode_result(req_id, status, len(rows), mask, cert)
+        )
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.outbox.put_nowait(None)
+            except queue_mod.Full:
+                pass
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+
+# ----------------------------------------------------------- client side
+
+
+class RemoteFuture:
+    """Resolution handle for one remote window: a thread event the
+    client's reader sets when the certificate frame lands."""
+
+    __slots__ = ("_event", "status", "mask", "cert")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.status = None
+        self.mask = None
+        self.cert = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: float = 30.0):
+        """``(status, mask, cert_or_None)``; raises TimeoutError if the
+        serving host never answers (a closed port fails loudly, it does
+        not hang the tenant forever)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("remote verify window timed out")
+        return self.status, self.mask, self.cert
+
+
+class RemoteServiceClient:
+    """One remote tenant's connection to a :class:`ServicePort`.
+
+    ``submit`` is async (returns a :class:`RemoteFuture`); a daemon
+    reader thread resolves futures as result frames arrive, so a tenant
+    can keep several windows on the wire — which is exactly what lets
+    the serving host coalesce them with other tenants' work."""
+
+    def __init__(self, host: str, port: int, timeout: float = 10.0):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+        self.sock.settimeout(None)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._send_lock = threading.Lock()
+        self._pending_lock = threading.Lock()
+        self._pending: dict = {}
+        self._next_req = 1
+        self._reader = threading.Thread(
+            target=self._read_loop, name="svcclient-read", daemon=True
+        )
+        self._reader.start()
+
+    def hello(self, name: str, signatories, f: int) -> None:
+        self._send(encode_hello(name, signatories, f))
+
+    def submit(self, height: int, round: int, value: bytes, rows,
+               generation: int = 0) -> RemoteFuture:
+        fut = RemoteFuture()
+        with self._pending_lock:
+            req_id = self._next_req
+            self._next_req += 1
+            self._pending[req_id] = fut
+        self._send(
+            encode_submit(req_id, height, round, value, rows, generation)
+        )
+        return fut
+
+    def _send(self, payload: bytes) -> None:
+        frame = _LEN.pack(len(payload)) + payload
+        with self._send_lock:
+            self.sock.sendall(frame)
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                header = _recv_exact(self.sock, _LEN.size)
+                if header is None:
+                    return
+                (n,) = _LEN.unpack(header)
+                if n > _MAX_FRAME:
+                    return
+                payload = _recv_exact(self.sock, n)
+                if payload is None:
+                    return
+                try:
+                    req_id, status, mask, cert = decode_result(payload)
+                except SerdeError:
+                    continue
+                with self._pending_lock:
+                    fut = self._pending.pop(req_id, None)
+                if fut is not None:
+                    fut.status = status
+                    fut.mask = mask
+                    fut.cert = cert
+                    fut._event.set()
+        except OSError:
+            return
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

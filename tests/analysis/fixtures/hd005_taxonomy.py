@@ -1,9 +1,10 @@
 """HD005 fixture: closed-family emit literals must be in EVENT_KINDS.
 
 Well-formed lowercase dotted names that sit under the closed event
-families (sched.launch.*, verify.occupancy.*, metrics.*, bls.*) but are not
-members of the recorder taxonomy are silent forks — the grep-based
-journal test only audits files it covers, the lint covers the rest.
+families (sched.launch.*, verify.occupancy.*, metrics.*, bls.*,
+tenant.drain.*, service.*) but are not members of the recorder taxonomy
+are silent forks — the grep-based journal test only audits files it
+covers, the lint covers the rest.
 """
 
 
@@ -24,12 +25,20 @@ class Pipeline:
     def bad_unknown_bls(self, h):
         self.obs.emit("bls.cert.minted", -1, h, -1, 0)  # BAD: fork
 
+    def bad_unknown_drain(self, n):
+        self.obs.emit("tenant.drain.skipped", -1, -1, -1, n)  # BAD: fork
+
+    def bad_unknown_service(self, t):
+        self.obs.emit("service.remote.ack", -1, -1, -1, t)  # BAD: fork
+
     def good_taxonomy_members(self, lid, pct):
         self.obs.emit("sched.launch.begin", -2, -1, -1, lid)
         self.obs.emit("verify.occupancy.pct", -1, -1, -1, pct)
         self.obs.emit("metrics.snapshot", -1, -1, -1, 0)
         self.obs.emit("bls.cert.agg", -1, -1, -1, 0)
         self.obs.emit("bls.partial.reject", -1, -1, -1, 0)
+        self.obs.emit("tenant.drain.deferred", -1, -1, -1, 0)
+        self.obs.emit("service.remote.resolve", -1, -1, -1, 0)
 
     def good_open_family(self):
         # Families outside the closed prefixes stay grep-audited only:

@@ -14,8 +14,10 @@ and the sim runs use the HostVerifier leg (``sign=True``).
 import pytest
 
 from hyperdrive_tpu.devsched import (
+    DeficitRoundRobin,
     DeviceFuture,
     DeviceWorkQueue,
+    FifoDrainPolicy,
     NullVerifyLauncher,
     QueueFlusher,
     SpeculationMismatch,
@@ -181,6 +183,145 @@ def test_null_launcher_matches_null_verifier_verdicts():
     # change verdicts: unsigned rows stay accepted.
     payload = [(b"\x00" * 32, b"\x01" * 32, None)] * 3
     assert NullVerifyLauncher().launch([payload]) == [[True, True, True]]
+
+
+# ------------------------------------------------- tenant drain policies
+
+
+def _submit_tenants(q, launcher, plan):
+    """plan: list of (origin, rows) — submit one command each, payload
+    is `rows` copies of the origin tag so results identify tenants."""
+    return [
+        q.submit(launcher, [origin] * rows, origin=origin, rows=rows)
+        for origin, rows in plan
+    ]
+
+
+def test_fifo_policy_is_scheduling_identical_to_no_policy():
+    plan = [("a", 3), ("b", 1), ("a", 2), ("c", 5), ("b", 4)]
+    shapes = []
+    for policy in (None, FifoDrainPolicy()):
+        q = DeviceWorkQueue(policy=policy)
+        launcher = CountingLauncher()
+        futs = _submit_tenants(q, launcher, plan)
+        q.drain()
+        shapes.append(launcher.launches)
+        assert [f.result() for f in futs] == [
+            [o] * r for o, r in plan
+        ]
+    assert shapes[0] == shapes[1] == [[3, 1, 2, 5, 4]]
+
+
+def test_drr_bounds_rows_per_cycle_and_shares_seats():
+    # A firehose tenant (40 rows) next to two small tenants: the DRR
+    # capacity splits one monster launch into a bounded train, and the
+    # small tenants ride the FIRST launch instead of queuing behind
+    # the firehose.
+    q = DeviceWorkQueue(
+        policy=DeficitRoundRobin(capacity_rows=16, quantum_rows=8)
+    )
+    launcher = CountingLauncher()
+    plan = [("fire", 10)] * 4 + [("b", 2), ("c", 2)]
+    futs = _submit_tenants(q, launcher, plan)
+    q.drain()
+    assert all(f.done() for f in futs)  # nothing leaks past a drain
+    assert len(launcher.launches) > 1  # the train, not one monster
+    assert all(sum(shape) <= 16 for shape in launcher.launches)
+    first = launcher.launches[0]
+    # Small tenants seated in cycle 1 alongside ONE firehose window.
+    assert 2 in first and first.count(10) <= 2
+
+
+def test_drr_starvation_bound_forces_selection():
+    # quantum 1 << rows 8 means tenant "slow" can never afford its
+    # command through deficit alone before the bound fires; after
+    # starve_after deferrals it MUST be force-selected.
+    policy = DeficitRoundRobin(
+        capacity_rows=8, quantum_rows=1, starve_after=3
+    )
+    q = DeviceWorkQueue(policy=policy)
+    launcher = CountingLauncher()
+    slow = q.submit(launcher, ["s"] * 8, origin="slow", rows=8)
+    # Competing 1-row traffic resubmitted by callbacks keeps cycles
+    # coming without ever letting "slow"'s deficit catch up cheaply.
+    count = [0]
+
+    def resubmit(f):
+        count[0] += 1
+        if count[0] < 12:
+            q.submit(
+                launcher, ["t"], origin="talk", rows=1
+            ).add_done_callback(resubmit)
+
+    q.submit(launcher, ["t"], origin="talk", rows=1).add_done_callback(
+        resubmit
+    )
+    q.drain()
+    assert slow.done() and slow.result() == ["s"] * 8
+    assert policy.forced_total >= 1
+    # The spec'd fairness bound: nothing ever waits more cycles than
+    # starve_after (the chaos invariant).
+    assert policy.max_deferrals <= policy.starve_after
+
+
+def test_drr_progress_guarantee_over_capacity_command():
+    # A command larger than capacity_rows launches alone rather than
+    # deadlocking the drain.
+    q = DeviceWorkQueue(policy=DeficitRoundRobin(capacity_rows=4))
+    launcher = CountingLauncher()
+    fut = q.submit(launcher, ["x"] * 9, origin="big", rows=9)
+    q.drain()
+    assert fut.result() == ["x"] * 9
+    assert launcher.launches == [[9]]
+
+
+def test_drr_weights_tilt_occupancy():
+    # Weight 3 vs 1 at equal demand: the heavy tenant gets more rows
+    # into the first bounded cycle (credit 6/visit vs 2/visit).
+    policy = DeficitRoundRobin(
+        capacity_rows=8, quantum_rows=2, weights={"heavy": 3}
+    )
+    q = DeviceWorkQueue(policy=policy)
+
+    class TaggingLauncher(CountingLauncher):
+        def __init__(self):
+            super().__init__()
+            self.tags = []
+
+        def launch(self, payloads):
+            self.tags.append([p[0] for p in payloads])
+            return super().launch(payloads)
+
+    launcher = TaggingLauncher()
+    plan = [("heavy", 2)] * 4 + [("light", 2)] * 4
+    futs = _submit_tenants(q, launcher, plan)
+    q.drain()
+    assert all(f.done() for f in futs)
+    assert all(sum(shape) <= 8 for shape in launcher.launches)
+    first = launcher.tags[0]
+    assert first.count("heavy") > first.count("light") >= 1
+
+
+def test_drr_preserves_per_tenant_fifo():
+    q = DeviceWorkQueue(
+        policy=DeficitRoundRobin(capacity_rows=4, quantum_rows=4)
+    )
+    launcher = CountingLauncher()
+    order = []
+    for i in range(6):
+        fut = q.submit(launcher, [("a", i)], origin="a", rows=1)
+        fut.add_done_callback(lambda f, i=i: order.append(i))
+    q.drain()
+    assert order == sorted(order)
+
+
+def test_policy_validation():
+    with pytest.raises(ValueError, match="capacity_rows"):
+        DeficitRoundRobin(capacity_rows=0)
+    with pytest.raises(ValueError, match="quantum_rows"):
+        DeficitRoundRobin(quantum_rows=0)
+    with pytest.raises(ValueError, match="starve_after"):
+        DeficitRoundRobin(starve_after=0)
 
 
 # ------------------------------------------------ sim integration (burst)
