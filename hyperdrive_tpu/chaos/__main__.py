@@ -115,6 +115,47 @@ def _build_churn(scen_seed: int, n: int, target: int):
     return plan, sim
 
 
+def _build_exec_churn(scen_seed: int, n: int, target: int):
+    """An execution-churn scenario: stake-churn transactions mutate the
+    replicated ledger while short epochs elect committees FROM that
+    stake, under the churn fault plan (partition spanning a boundary,
+    crash-restore inside it, laggards rejoining under rotated keys).
+    The monitor's exec invariants are armed — state-root agreement at
+    every committed height plus commit/ledger binding — and the leg
+    adds a record-replay determinism self-check that must reproduce the
+    identical root-extended chain from the dump alone. Host executors
+    keep the soak jax-free; kernel parity has its own CI smoke."""
+    from hyperdrive_tpu.epochs import EpochConfig
+    from hyperdrive_tpu.exec import ExecutionConfig
+
+    plan = FaultPlan.churn(scen_seed, n)
+    epoch_length = 2
+    committee = max(3, (3 * n) // 4)
+    target = max(target, 3 * epoch_length + 1)
+    sim = Simulation(
+        n=n,
+        target_height=target,
+        seed=scen_seed,
+        timeout=1.0,
+        delivery_cost=1e-3,
+        chaos=plan,
+        observe=True,
+        certificates=True,
+        epochs=EpochConfig(
+            epoch_length=epoch_length,
+            committee_size=committee,
+            rekey_per_epoch=1,
+        ),
+        execution=ExecutionConfig(
+            accounts=max(2 * n, 16),
+            txs_per_block=64,
+            stake_every=2,
+            seed=scen_seed,
+        ),
+    )
+    return plan, sim
+
+
 def _build_overlay(scen_seed: int, n: int, target: int):
     """An aggregation-overlay scenario: the full tree-slicing fault
     family (partition cutting a level block, Byzantine contributors
@@ -674,6 +715,57 @@ def soak(args) -> int:
                 ysim.record.dump(okbase)
                 overlay_dumped = True
                 print(f"  dumped passing overlay record: {okbase}")
+        if args.exec_every and k % args.exec_every == 0:
+            # Every Kth scenario additionally runs the execution-churn
+            # family (ISSUE 15): stake-churn transactions feeding
+            # stake-driven elections across epoch boundaries under
+            # partition + crash-restore, with the monitor's exec
+            # invariants armed (state-root agreement network-wide,
+            # commit/ledger binding) and a record-replay determinism
+            # self-check on the root-extended chain.
+            en = args.n if args.n else 8
+            xplan, xsim = _build_exec_churn(scen_seed, en, args.target)
+            xmon = InvariantMonitor(xsim)
+            try:
+                xresult = xsim.run(max_steps=args.max_steps)
+                xmon.check_final(xresult)
+                if not xmon.epoch_switches:
+                    raise InvariantViolation(
+                        "epoch-liveness",
+                        "exec-churn run never crossed an epoch boundary",
+                    )
+                if not sum(e.applied_total for e in xsim.executors):
+                    raise InvariantViolation(
+                        "exec-root",
+                        "exec-churn run applied no transactions — the "
+                        "leg did not exercise the ledger",
+                    )
+                xreplayed = Simulation.replay(xsim.record)
+                if xreplayed.commits != xresult.commits:
+                    raise InvariantViolation(
+                        "replay",
+                        "exec-churn replay diverges from live run "
+                        "(root-extended commits)",
+                    )
+            except (InvariantViolation, AssertionError) as err:
+                failures += 1
+                base = _dump_failure(args.out, scen_seed, xsim, err)
+                print(
+                    f"FAIL exec seed={scen_seed} n={en} {err}\n"
+                    f"  dumped {base}.bin (+ journal, checkpoints)\n"
+                    f"  reproduce: python -m hyperdrive_tpu.chaos "
+                    f"replay {base}.bin",
+                    file=sys.stderr,
+                )
+                if not args.keep_going:
+                    return 1
+                continue
+            print(
+                f"ok exec seed={scen_seed} n={en} epoch={xsim.epoch} "
+                f"applied={sum(e.applied_total for e in xsim.executors)} "
+                f"rejected={sum(e.rejected_total for e in xsim.executors)} "
+                f"roots={len(xsim.executors[0].roots)} root-agreement=ok"
+            )
     if failures:
         print(f"soak FAILED: {failures}/{args.scenarios}", file=sys.stderr)
         return 1
@@ -792,6 +884,16 @@ def main(argv=None) -> int:
         "scenario (tree-slicing partition + Byzantine contributors on "
         "the overlay path, plus a digest-neutrality cross-check against "
         "the all-to-all baseline; 0 = off)",
+    )
+    p.add_argument(
+        "--exec-every",
+        type=int,
+        default=0,
+        help="additionally run every Kth seed as an execution-churn "
+        "scenario (stake-churn transactions driving stake-elected "
+        "epochs under partition + crash-restore, with state-root "
+        "agreement armed and a root-extended replay self-check; "
+        "0 = off)",
     )
     p.add_argument(
         "--dump-ok",

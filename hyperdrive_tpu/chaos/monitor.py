@@ -159,10 +159,19 @@ class InvariantMonitor:
     def check_final(self, result: "SimulationResult") -> "InvariantMonitor":
         """Assert the post-run invariants; returns self for chaining."""
         result.assert_safety()
+        has_exec = bool(getattr(self.sim, "executors", None))
         for i in sorted(self.honest):
             for height, value in result.commits[i].items():
                 want = self.chain.get(height)
-                if want is not None and value != want:
+                if want is None:
+                    continue
+                if has_exec:
+                    # Execution runs store root-extended commits
+                    # (value + state root); the monitor's chain holds
+                    # the raw consensus value from the callback seam.
+                    # Root agreement is _check_exec's dedicated job.
+                    value = value[: len(want)]
+                if value != want:
                     raise InvariantViolation(
                         "digest",
                         f"replica {i} holds {value.hex()[:16]} at height "
@@ -193,6 +202,7 @@ class InvariantMonitor:
             )
         self._check_epochs()
         self._check_overlay()
+        self._check_exec()
         return self
 
     def _check_epochs(self) -> None:
@@ -302,6 +312,56 @@ class InvariantMonitor:
                 f"{ov.config.max_waves} waves with coverage missing but "
                 "the ranked fallback never engaged",
             )
+
+    def _check_exec(self) -> None:
+        """Replicated-ledger invariants (execution runs only):
+
+        - **state-root agreement** — every honest replica that applied
+          a block at a committed height derived the SAME chained state
+          root: the deterministic-execution analogue of no-fork. The
+          commit values already carry the root (the sim chains it into
+          the commit digest), so a divergence would eventually surface
+          as a value fork too — checking the executors directly
+          localizes blame to the apply path and catches a replica whose
+          ledger ran ahead of or behind its own commits.
+        - **commit/ledger binding** — each replica's stored commit at a
+          height must end with that replica's own root for the height,
+          so the root the certificate chain vouches for is the root the
+          ledger actually computed.
+        """
+        executors = getattr(self.sim, "executors", None)
+        if not executors:
+            return
+        by_height: dict[int, dict[bytes, list[int]]] = {}
+        for i, ex in enumerate(executors):
+            if i not in self.honest:
+                continue
+            for height, root in ex.roots.items():
+                by_height.setdefault(height, {}).setdefault(
+                    root, []
+                ).append(i)
+        for height in sorted(by_height):
+            by_root = by_height[height]
+            if len(by_root) > 1:
+                raise InvariantViolation(
+                    "exec-root",
+                    f"state-root fork at height {height}: "
+                    + "; ".join(
+                        f"{root[:8].hex()} from replicas {reps}"
+                        for root, reps in sorted(by_root.items())
+                    ),
+                )
+        for i in sorted(self.honest):
+            ex = executors[i]
+            for height, value in self.sim.commits[i].items():
+                root = ex.roots.get(height)
+                if root is not None and not value.endswith(root):
+                    raise InvariantViolation(
+                        "exec-root",
+                        f"replica {i}'s commit at height {height} does "
+                        f"not end with its own state root "
+                        f"{root[:8].hex()}",
+                    )
 
     @staticmethod
     def check_tenant_fairness(policy) -> None:

@@ -273,6 +273,13 @@ class EpochSchedule:
         self.seed = int(seed)
         self.rekey_per_epoch = int(rekey_per_epoch)
         self.signatory_fn = signatory_fn
+        #: Optional ``height -> stake vector`` hook consulted when
+        #: :meth:`transition_at` gets no explicit ``stakes`` override:
+        #: the execution layer binds the committed ledger's stake
+        #: column here, so EVERY transition-creating path — the sim's
+        #: commit seam and EpochCertifier.observe_commit alike — elects
+        #: from replicated state. Must be deterministic in ``height``.
+        self.stake_source = None
         self._gens = [0] * len(self.stakes)
         anchor0 = genesis_anchor(self.seed)
         self._anchors: dict = {0: anchor0}
@@ -353,13 +360,25 @@ class EpochSchedule:
 
     # ----------------------------------------------------------- transition
 
-    def transition_at(self, height: int, value: bytes) -> EpochTransition:
+    def transition_at(
+        self, height: int, value: bytes, stakes=None
+    ) -> EpochTransition:
         """Compute (or fetch) the transition triggered by committing
         ``value`` at boundary ``height``. Raises on a non-boundary
         height, and raises ``ValueError`` when a cached transition was
         anchored on a *different* committed value — that is a fork at
         the boundary, and electing from it would split the network into
-        two futures."""
+        two futures.
+
+        ``stakes`` overrides the static construction-time table for
+        THIS election (and the committee's ValidatorInfo stakes): the
+        execution layer passes the committed ledger's stake column at
+        the boundary, so elections read replicated state instead of a
+        fixed table (ROADMAP item 4). Callers must be deterministic —
+        every replica reaching this boundary passes the same vector
+        (the chained state root enforces it); the cached first-
+        committer transition is returned as-is, same as value-anchored
+        determinism."""
         if not self.is_boundary(height):
             raise ValueError(f"height {height} is not an epoch boundary")
         new_epoch = self.epoch_of(height) + 1
@@ -385,8 +404,18 @@ class EpochSchedule:
             + self._anchors[new_epoch - 1] + vdigest
         ).digest()
         self._anchors[new_epoch] = anchor
+        if stakes is None and self.stake_source is not None:
+            stakes = self.stake_source(height)
+        elect_stakes = (
+            self.stakes if stakes is None else tuple(int(s) for s in stakes)
+        )
+        if len(elect_stakes) != len(self.stakes):
+            raise ValueError(
+                f"stake override has {len(elect_stakes)} entries for a "
+                f"{len(self.stakes)}-member pool"
+            )
         members = elect_committee(
-            self.stakes, self.committee_size, anchor + b"elect"
+            elect_stakes, self.committee_size, anchor + b"elect"
         )
         # Deterministic re-key: rekey_per_epoch distinct members of the
         # NEW committee bump their key generation, drawn from the same
@@ -408,7 +437,7 @@ class EpochSchedule:
         committee = tuple(
             ValidatorInfo(
                 i, self.signatory_fn(i, self._gens[i]),
-                self.stakes[i], self._gens[i],
+                elect_stakes[i], self._gens[i],
             )
             for i in members
         )

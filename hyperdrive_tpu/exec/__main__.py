@@ -1,0 +1,143 @@
+"""Execution-layer smokes: device apply vs host reference parity.
+
+Usage::
+
+    python -m hyperdrive_tpu.exec parity [--blocks H] [--accounts A]
+        [--txs T] [--seed S]
+
+Runs the SAME deterministic block workload through
+:class:`~hyperdrive_tpu.exec.ledger.HostLedgerExecutor` (pure-Python
+two-pass reference) and :class:`~hyperdrive_tpu.exec.device
+.DeviceLedgerExecutor` (one padded segment-sum/scatter-add launch per
+block, ops/ledger.py) and demands byte-equal chained state roots at
+EVERY height — three legs:
+
+  1. unsigned transfers + stake churn (the sim/chaos configuration),
+  2. signed transactions with a deterministically corrupted lane every
+     8th tx (the admission mask must reject identically on both),
+  3. an insolvency-heavy leg (tiny balances) hammering the
+     block-atomic sender-solvency rule where vectorized and serial
+     semantics would first diverge if they could.
+
+Exit 1 on any root mismatch. Shapes are tiny; with the checkout's
+``.jax_cache`` warmed the run is seconds. HD_SANITIZE=1 in the CI
+environment arms the runtime sanitizer exactly as the devsched parity
+smoke does.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", ".jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2.0")
+
+
+def _leg(name: str, cfg, genesis_stakes, blocks: int) -> int:
+    from hyperdrive_tpu.exec.device import DeviceLedgerExecutor
+    from hyperdrive_tpu.exec.ledger import BlockSource, HostLedgerExecutor
+
+    src = BlockSource(cfg)
+    host = HostLedgerExecutor(cfg, genesis_stakes, source=src)
+    dev = DeviceLedgerExecutor(cfg, genesis_stakes, source=src)
+    if host.genesis_root != dev.genesis_root:
+        print(f"FAIL {name}: genesis roots differ", file=sys.stderr)
+        return 1
+    for h in range(1, blocks + 1):
+        hr = host.advance_to(h)
+        dr = dev.advance_to(h)
+        if hr != dr:
+            print(
+                f"FAIL {name}: root mismatch at height {h}: "
+                f"host={hr.hex()[:16]} device={dr.hex()[:16]}",
+                file=sys.stderr,
+            )
+            return 1
+    if host.applied_total != dev.applied_total:
+        print(
+            f"FAIL {name}: applied counts differ "
+            f"({host.applied_total} != {dev.applied_total})",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"ok {name}: {blocks} blocks, roots identical, "
+        f"applied={host.applied_total} rejected={host.rejected_total}"
+    )
+    return 0
+
+
+def parity(args) -> int:
+    from hyperdrive_tpu.exec import ExecutionConfig
+
+    rc = 0
+    rc |= _leg(
+        "exec-apply",
+        ExecutionConfig(
+            accounts=args.accounts,
+            txs_per_block=args.txs,
+            stake_every=3,
+            stake_accounts=min(4, args.accounts),
+            seed=args.seed,
+        ),
+        (5, 9, 2, 7),
+        args.blocks,
+    )
+    rc |= _leg(
+        "exec-signed",
+        ExecutionConfig(
+            accounts=min(args.accounts, 16),
+            txs_per_block=min(args.txs, 24),
+            stake_every=4,
+            stake_accounts=4,
+            seed=args.seed + 1,
+            sign_txs=True,
+            bad_sig_every=8,
+        ),
+        (3, 3, 3, 3),
+        min(args.blocks, 3),
+    )
+    rc |= _leg(
+        "exec-insolvent",
+        ExecutionConfig(
+            accounts=args.accounts,
+            txs_per_block=args.txs,
+            stake_every=2,
+            stake_accounts=min(4, args.accounts),
+            seed=args.seed + 2,
+            amount_cap=64,
+            initial_balance=40,
+        ),
+        (1, 0, 2, 0),
+        args.blocks,
+    )
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m hyperdrive_tpu.exec")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser(
+        "parity",
+        help="device batched apply vs host reference executor: chained "
+        "state roots must be byte-equal at every height",
+    )
+    p.add_argument("--blocks", type=int, default=6)
+    p.add_argument("--accounts", type=int, default=32)
+    p.add_argument("--txs", type=int, default=48)
+    p.add_argument("--seed", type=int, default=7)
+    p.set_defaults(fn=parity)
+
+    args = ap.parse_args(argv)
+    rc = args.fn(args)
+    if rc == 0:
+        print("exec parity ok")
+    else:
+        print("exec parity FAILED", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""The device executor: ledger state as int32 tensors, block apply as
+one jitted segment-sum/scatter-add launch (ops/ledger.py).
+
+Digest-identical to :class:`~hyperdrive_tpu.exec.ledger
+.HostLedgerExecutor` by construction — the root chain hashes the same
+8-byte little-endian packing of the same int32 state — and enforced by
+``python -m hyperdrive_tpu.exec parity`` (CI: exec-parity smoke on
+forced CPU devices, HD_SANITIZE=1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from hyperdrive_tpu.exec.ledger import HostLedgerExecutor, TxBlock
+from hyperdrive_tpu.ops import ledger as ops_ledger
+
+__all__ = ["DeviceLedgerExecutor"]
+
+
+class DeviceLedgerExecutor(HostLedgerExecutor):
+    """Ledger state lives on device between blocks; each applied block
+    is one padded kernel call (pad rows inert), and only the root hash
+    pulls the state back to host — the per-block transfer both
+    executors pay, since the root is a host hash either way."""
+
+    device = True
+
+    def _init_state(self, balances, stakes):
+        self._dbal = jnp.asarray(np.asarray(balances, dtype=np.int32))
+        self._dstk = jnp.asarray(np.asarray(stakes, dtype=np.int32))
+
+    def _state_bytes(self) -> bytes:
+        bal = np.asarray(self._dbal, dtype=np.int64)
+        stk = np.asarray(self._dstk, dtype=np.int64)
+        return (
+            bal.astype("<i8").tobytes() + stk.astype("<i8").tobytes()
+        )
+
+    @staticmethod
+    def _device_cols(blk: TxBlock):
+        # Padded device tensors, cached ON the block: the list->tensor
+        # conversion is block materialization (shared by every replica
+        # via the shared source, freed with the block by the source's
+        # LRU), so the per-apply cost is the kernel launch itself. The
+        # cached mask is the no-signature mask (real rows True, pad
+        # rows inert False); signed runs overwrite it per call.
+        cols = blk._cols
+        if cols is None:
+            k, s, r, a, m = ops_ledger.pad_block(
+                blk.kind, blk.sender, blk.recipient, blk.amount,
+                [True] * len(blk),
+            )
+            cols = blk._cols = (
+                jnp.asarray(k), jnp.asarray(s), jnp.asarray(r),
+                jnp.asarray(a), jnp.asarray(m),
+            )
+        return cols
+
+    def _apply_block(self, blk: TxBlock, ok) -> int:
+        n = len(blk)
+        k, s, r, a, m = self._device_cols(blk)
+        if ok is not None:
+            padded = np.zeros(len(m), dtype=bool)
+            padded[:n] = ok
+            m = jnp.asarray(padded)
+        self._dbal, self._dstk, applied = ops_ledger._jitted()(
+            self._dbal, self._dstk, k, s, r, a, m
+        )
+        # Pad rows are inert (mask False), so the full-width sum is the
+        # true applied count.
+        return int(np.asarray(applied).sum())
+
+    # Host views for election_stakes / debugging: materialize on read.
+    @property
+    def balances(self):
+        return np.asarray(self._dbal)
+
+    @property
+    def stakes(self):
+        return np.asarray(self._dstk)

@@ -1,0 +1,371 @@
+"""Deterministic tx blocks, the host reference executor, root chaining.
+
+Everything here is jax-free and bit-deterministic. A height's block is
+a pure function of ``(config.seed, height)`` — never of committed
+values or delivery order — so a laggard resyncing straight to height H
+executes the identical blocks every up-to-date replica executed, and a
+replayed dump re-derives the whole ledger trajectory from the config
+ints alone (ScenarioRecord v7 stores no state).
+
+State roots chain like the commit chain itself:
+
+  root_0 = H("exec-genesis" || pack(balances) || pack(stakes))
+  root_h = H("exec-root" || root_{h-1} || state_digest_h)
+
+with ``pack`` fixed as 8-byte little-endian signed per account, so the
+host executor (Python ints) and the device executor (int32 tensors)
+hash identical bytes — the differential-parity contract the
+``python -m hyperdrive_tpu.exec parity`` smoke enforces.
+
+Apply semantics are ORDER-INDEPENDENT and block-atomic per sender: a
+sender whose summed asks (balance asks for TRANSFER/STAKE, stake asks
+for UNSTAKE) exceed its pre-block funds has every transaction in that
+block rejected. That is what makes the vectorized device form
+(ops/ledger.py: segment-sum → solvency gather → scatter-add) exactly
+equal to any serial schedule of the same block.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+
+from hyperdrive_tpu.devsched.queue import VerifyLauncher
+from hyperdrive_tpu.exec import ExecutionConfig
+from hyperdrive_tpu.obs.recorder import NULL_BOUND
+
+__all__ = [
+    "KIND_TRANSFER",
+    "KIND_STAKE",
+    "KIND_UNSTAKE",
+    "TxBlock",
+    "BlockSource",
+    "HostLedgerExecutor",
+    "ExecApplyLauncher",
+]
+
+#: Transaction kinds — must match ops/ledger.py (the device kernel keeps
+#: its own copies so ops/ stays importable without this package;
+#: tests/test_exec.py pins the equality).
+KIND_TRANSFER = 0
+KIND_STAKE = 1
+KIND_UNSTAKE = 2
+
+_INT32_MAX = 2**31 - 1
+
+
+def pack_state(values) -> bytes:
+    """Account vector -> bytes, 8-byte little-endian signed per entry.
+    The ONE packing both executors must agree on for root equality."""
+    return b"".join(int(v).to_bytes(8, "little", signed=True) for v in values)
+
+
+class TxBlock:
+    """One height's transactions as dense columns (the device layout is
+    the native layout; the host executor just walks the columns)."""
+
+    __slots__ = (
+        "height", "kind", "sender", "recipient", "amount", "digest",
+        "_sig_items", "_cols",
+    )
+
+    def __init__(self, height, kind, sender, recipient, amount, digest):
+        self.height = height
+        self.kind = kind
+        self.sender = sender
+        self.recipient = recipient
+        self.amount = amount
+        #: Content digest: what the exec proposer's value commits to.
+        self.digest = digest
+        self._sig_items = None
+        #: Device-padded column cache (DeviceLedgerExecutor): the
+        #: list->tensor conversion is block MATERIALIZATION, shared by
+        #: every replica on the source like the columns themselves, and
+        #: evicted with the block by the source's LRU.
+        self._cols = None
+
+    def __len__(self) -> int:
+        return len(self.kind)
+
+
+class BlockSource:
+    """Deterministic per-height workload, shared by every replica.
+
+    ``block(h)`` derives height h's transactions from a seeded RNG
+    keyed on ``(config.seed, h)``; every ``stake_every``-th tx is a
+    STAKE/UNSTAKE on a validator stake account (``stake_accounts``
+    wide, biased toward STAKE so validator weights drift and epoch
+    elections have something to read). ``value(h)`` is the 32-byte
+    proposal value committing to the block. With ``sign_txs`` each tx
+    carries a real Ed25519 signature from its sender's deterministic
+    account key; ``bad_sig_every`` corrupts every K-th one.
+    """
+
+    #: Blocks cached per source; sim runs walk heights forward and
+    #: bench blocks are large, so a short LRU covers re-reads (the n
+    #: replicas' executors share one source) without pinning 64k-tx
+    #: columns for every committed height.
+    CACHE = 8
+
+    def __init__(self, config: ExecutionConfig):
+        self.config = config
+        self._cache: dict[int, TxBlock] = {}
+        self._values: dict[int, bytes] = {}
+        self._ring = None
+
+    def block(self, height: int) -> TxBlock:
+        blk = self._cache.get(height)
+        if blk is not None:
+            return blk
+        cfg = self.config
+        key = hashlib.sha256(
+            b"exec-block-%d-%d" % (cfg.seed, height)
+        ).digest()
+        rnd = random.Random(int.from_bytes(key[:8], "little"))
+        kind, sender, recipient, amount = [], [], [], []
+        stake_lane = cfg.stake_every > 0 and cfg.stake_accounts > 0
+        for t in range(cfg.txs_per_block):
+            if stake_lane and t % cfg.stake_every == 0:
+                s = rnd.randrange(cfg.stake_accounts)
+                kind.append(
+                    KIND_STAKE if rnd.random() < 0.6 else KIND_UNSTAKE
+                )
+                sender.append(s)
+                recipient.append(s)
+            else:
+                kind.append(KIND_TRANSFER)
+                sender.append(rnd.randrange(cfg.accounts))
+                recipient.append(rnd.randrange(cfg.accounts))
+            amount.append(rnd.randint(1, cfg.amount_cap))
+        h = hashlib.sha256()
+        h.update(b"exec-txs")
+        h.update(key)
+        for col in (kind, sender, recipient, amount):
+            h.update(b"".join(v.to_bytes(4, "little") for v in col))
+        blk = TxBlock(height, kind, sender, recipient, amount, h.digest())
+        while len(self._cache) >= self.CACHE:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[height] = blk
+        return blk
+
+    def value(self, height: int) -> bytes:
+        """The proposal value for ``height`` — commits to the block
+        content (round-independent: retries re-propose the same
+        block)."""
+        v = self._values.get(height)
+        if v is None:
+            v = hashlib.sha256(
+                b"exec-value" + self.block(height).digest
+            ).digest()
+            while len(self._values) >= 4096:
+                self._values.pop(next(iter(self._values)))
+            self._values[height] = v
+        return v
+
+    def keyring(self):
+        """Deterministic per-account Ed25519 keys (sign_txs mode)."""
+        if self._ring is None:
+            from hyperdrive_tpu.crypto.keys import KeyRing
+
+            self._ring = KeyRing.deterministic(
+                self.config.accounts, namespace=b"exec-%d" % self.config.seed
+            )
+        return self._ring
+
+    def sig_items(self, block: TxBlock) -> list:
+        """The block's ``(pub, digest, sig)`` verifier triples, cached
+        on the block. Only meaningful with ``sign_txs``."""
+        if block._sig_items is not None:
+            return block._sig_items
+        cfg = self.config
+        ring = self.keyring()
+        bad = cfg.bad_sig_every
+        items = []
+        for t in range(len(block)):
+            kp = ring[block.sender[t]]
+            digest = hashlib.sha256(
+                b"exec-tx" + block.digest
+                + t.to_bytes(4, "little")
+            ).digest()
+            sig = kp.sign_digest(digest)
+            if bad and (t + 1) % bad == 0:
+                # Deterministically corrupted lane: the mask must
+                # reject it on every replica and both executors.
+                sig = bytes([sig[0] ^ 0xFF]) + sig[1:]
+            items.append((kp.public, digest, sig))
+        block._sig_items = items
+        return items
+
+
+class HostLedgerExecutor:
+    """The reference executor: one ledger, blocks applied in height
+    order with pure-Python two-pass semantics. ``advance_to(h)``
+    applies every missing block in ``(height, h]`` (resync gaps catch
+    up deterministically) and returns the chained root at ``h``;
+    re-asking a settled height returns the cached root (crash-restore
+    re-commits).
+
+    ``masks`` is an optional SHARED ``height -> [bool]`` dict the sim's
+    devsched launcher path fills (ExecApplyLauncher futures resolve
+    into it); absent an entry, sign_txs blocks are verified host-side —
+    same signatures, same verdict, so launcher and fallback paths are
+    digest-identical (replayed dumps never re-propose, hence never
+    re-submit, and still reproduce the live roots).
+    """
+
+    device = False
+
+    def __init__(
+        self,
+        config: ExecutionConfig,
+        genesis_stakes=(),
+        source: BlockSource | None = None,
+        masks: dict | None = None,
+        obs=NULL_BOUND,
+    ):
+        cfg = config
+        self.config = cfg
+        self.source = source if source is not None else BlockSource(cfg)
+        gs = list(genesis_stakes)
+        if len(gs) > cfg.accounts:
+            raise ValueError(
+                f"{len(gs)} genesis stakes exceed {cfg.accounts} accounts"
+            )
+        gs += [0] * (cfg.accounts - len(gs))
+        if any(s < 0 or s > _INT32_MAX for s in gs):
+            raise ValueError("genesis stakes must fit int32")
+        self._init_state([cfg.initial_balance] * cfg.accounts, gs)
+        #: Last applied height; 0 = genesis (heights are 1-based).
+        self.height = 0
+        self.genesis_root = hashlib.sha256(
+            b"exec-genesis" + self._state_bytes()
+        ).digest()
+        self.root = self.genesis_root
+        #: height -> chained root, for every applied height.
+        self.roots: dict[int, bytes] = {}
+        self.applied_total = 0
+        self.rejected_total = 0
+        self.masks = masks
+        self.obs = obs
+        self._verifier = None
+        # Cumulative int32 headroom: every block can move at most
+        # txs_per_block * amount_cap units into one account.
+        self._flow = cfg.initial_balance
+
+    # ---- state representation (overridden by the device executor)
+
+    def _init_state(self, balances, stakes):
+        self.balances = balances
+        self.stakes = stakes
+
+    def _state_bytes(self) -> bytes:
+        return pack_state(self.balances) + pack_state(self.stakes)
+
+    def _apply_block(self, blk: TxBlock, ok) -> int:
+        bal, stk = self.balances, self.stakes
+        out_bal: dict[int, int] = {}
+        out_stk: dict[int, int] = {}
+        for t in range(len(blk)):
+            if ok is not None and not ok[t]:
+                continue
+            s, a = blk.sender[t], blk.amount[t]
+            if blk.kind[t] == KIND_UNSTAKE:
+                out_stk[s] = out_stk.get(s, 0) + a
+            else:
+                out_bal[s] = out_bal.get(s, 0) + a
+        # Solvency is a statement about the PRE-block snapshot (the
+        # block-atomic rule): freeze the verdict per sender before any
+        # mutation, or mid-block balances would re-order-couple the txs.
+        sender_ok = {
+            s: bal[s] >= out_bal.get(s, 0) and stk[s] >= out_stk.get(s, 0)
+            for s in set(out_bal) | set(out_stk)
+        }
+        applied = 0
+        for t in range(len(blk)):
+            if ok is not None and not ok[t]:
+                continue
+            s = blk.sender[t]
+            if not sender_ok.get(s, True):
+                continue
+            k, a = blk.kind[t], blk.amount[t]
+            if k == KIND_TRANSFER:
+                bal[s] -= a
+                bal[blk.recipient[t]] += a
+            elif k == KIND_STAKE:
+                bal[s] -= a
+                stk[s] += a
+            else:
+                stk[s] -= a
+                bal[s] += a
+            applied += 1
+        return applied
+
+    # ---- the public surface
+
+    def advance_to(self, height: int) -> bytes:
+        """Root at ``height``, applying any missing blocks up to it."""
+        if height <= self.height:
+            return self.roots[height] if height > 0 else self.genesis_root
+        for h in range(self.height + 1, height + 1):
+            self._step(h)
+        return self.root
+
+    def _step(self, h: int) -> None:
+        cfg = self.config
+        self._flow += cfg.txs_per_block * cfg.amount_cap
+        if self._flow > _INT32_MAX:
+            raise OverflowError(
+                "cumulative block flow exceeds int32 headroom — lower "
+                "amount_cap/initial_balance or widen the kernel"
+            )
+        blk = self.source.block(h)
+        ok = self._mask_for(h, blk)
+        applied = self._apply_block(blk, ok)
+        self.applied_total += applied
+        self.rejected_total += len(blk) - applied
+        self.height = h
+        d = hashlib.sha256(
+            b"exec-state" + h.to_bytes(8, "little") + self._state_bytes()
+        ).digest()
+        self.root = hashlib.sha256(b"exec-root" + self.root + d).digest()
+        self.roots[h] = self.root
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "exec.apply", h, -1,
+                "txs=%d applied=%d dev=%d"
+                % (len(blk), applied, int(self.device)),
+            )
+            self.obs.emit("exec.root", h, -1, self.root[:8].hex())
+
+    def _mask_for(self, h: int, blk: TxBlock):
+        if not self.config.sign_txs:
+            return None
+        if self.masks is not None:
+            m = self.masks.get(h)
+            if m is not None:
+                return m
+        if self._verifier is None:
+            from hyperdrive_tpu.verifier import HostVerifier
+
+            self._verifier = HostVerifier()
+        mask = self._verifier.verify_signatures(self.source.sig_items(blk))
+        return [bool(v) for v in mask]
+
+    def election_stakes(self, n: int) -> tuple:
+        """What the epoch election reads at a boundary: the first ``n``
+        stake accounts, floored so weight can hit the floor but a pool
+        member never leaves candidacy (ROBUSTNESS.md)."""
+        floor = self.config.stake_floor
+        return tuple(int(self.stakes[i]) + floor for i in range(n))
+
+
+class ExecApplyLauncher(VerifyLauncher):
+    """The ``exec.apply`` device-queue command: a block's tx-signature
+    triples, coalesced by the SAME drain that carries vote verifies —
+    grouped separately by launcher identity, so one drain cycle issues
+    the vote launch and the exec launch back to back, and the block's
+    admission mask resolves with the settle futures it shares a slot
+    with. Mutation itself doesn't ride the queue: it is one call on the
+    executor at commit time, already a single fused kernel."""
+
+    kind = "exec.apply"

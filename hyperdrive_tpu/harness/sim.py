@@ -139,6 +139,13 @@ class ScenarioRecord:
     #: functions of them plus the committed boundary values, which the
     #: message stream reproduces.
     epochs: "tuple | None" = None
+    #: Execution-layer configuration (exec/__init__.py
+    #: ``ExecutionConfig.as_ints()``) or None. Block content, admission
+    #: masks, and the chained state roots are all deterministic
+    #: functions of these ints plus committed heights, so replay
+    #: re-derives the full ledger trajectory — root-extended commit
+    #: values included — with no stored state.
+    execution: "tuple | None" = None
 
     OP_CRASH = 0
     OP_RESTORE = 1
@@ -152,9 +159,11 @@ class ScenarioRecord:
     #: captured under per-message dispatch. v5 appends the chaos
     #: lifecycle-op trailer (pre-v5 dumps load with no lifecycle ops).
     #: v6 appends the epoch-config trailer (pre-v6 dumps load with no
-    #: epochs — dynamic validator sets did not exist then).
+    #: epochs — dynamic validator sets did not exist then). v7 appends
+    #: the execution-layer trailer (pre-v7 dumps load with no execution
+    #: — blocks were opaque digests then).
     MAGIC = 0x48594456  # "HYDV"
-    VERSION = 6
+    VERSION = 7
 
     def marshal(self, w: Writer) -> None:
         w.u32(self.MAGIC)
@@ -190,6 +199,13 @@ class ScenarioRecord:
             w.u32(len(stakes))
             for s in stakes:
                 w.u64(s)
+        w.bool(self.execution is not None)
+        if self.execution is not None:
+            # Length-prefixed u64 fields: a future config int extends
+            # the trailer without another version bump.
+            w.u32(len(self.execution))
+            for v in self.execution:
+                w.u64(int(v))
 
     @classmethod
     def unmarshal(cls, r: Reader) -> "ScenarioRecord":
@@ -197,7 +213,7 @@ class ScenarioRecord:
         if magic != cls.MAGIC:
             raise SerdeError(f"not a scenario dump (magic {magic:#x})")
         version = r.u32()
-        if version not in (2, 3, 4, 5, cls.VERSION):
+        if version not in (2, 3, 4, 5, 6, cls.VERSION):
             raise SerdeError(
                 f"scenario dump version {version} unsupported "
                 f"(expected {cls.VERSION})"
@@ -255,6 +271,11 @@ class ScenarioRecord:
                 epoch_length, committee, rekey, eseed,
                 tuple(r.u64() for _ in range(nstakes)),
             )
+        if version >= 7 and r.bool():
+            nvals = r.u32()
+            if nvals > 64:
+                raise SerdeError("execution trailer too large")
+            rec.execution = tuple(r.u64() for _ in range(nvals))
         return rec
 
     def dump(self, path: str) -> None:
@@ -471,6 +492,7 @@ class Simulation:
         catchup_lag: Optional[int] = None,
         load=None,
         overlay=None,
+        execution=None,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -1151,6 +1173,113 @@ class Simulation:
                 )
             overlay.validate(n)
 
+        #: Execution layer (hyperdrive_tpu/exec): pass
+        #: ``execution=ExecutionConfig`` to give every committed height
+        #: a deterministic transaction block. Each replica runs its own
+        #: executor (host reference or device kernel per
+        #: ``config.device``) over exactly the heights it commits, and
+        #: every commit value stored in ``self.commits`` is extended
+        #: with the 32-byte chained state root (raw 32-byte values
+        #: still flow to votes, certificates, and epoch anchors — the
+        #: extension is the EXTERNAL commit record, which is where the
+        #: commit digest reads). With epochs, boundary elections read
+        #: the committed ledger's stake column instead of the static
+        #: table. ``sign_txs`` blocks submit their signature triples
+        #: through the devsched queue (ExecApplyLauncher — the
+        #: ``exec.apply`` command kind) when one is wired, coalescing
+        #: with vote verifies in the same drain.
+        self.executors: list = []
+        self._execution = None
+        self._exec_source = None
+        self._exec_masks: dict = {}
+        self._exec_futs: dict = {}
+        self._exec_launcher = None
+        if execution is not None:
+            if payload_bytes:
+                raise ValueError(
+                    "execution blocks and MPC payload bundles both "
+                    "define the proposed value's content; run one "
+                    "content layer at a time"
+                )
+            if load is not None:
+                raise ValueError(
+                    "open-loop load re-injects recorded votes with no "
+                    "block content; execution-driven traffic is the "
+                    "named ROADMAP follow-up — run them separately"
+                )
+            import dataclasses as _dc
+
+            from hyperdrive_tpu.exec.ledger import BlockSource
+
+            cfg = execution
+            if cfg.stake_accounts == 0 and cfg.stake_every > 0:
+                if cfg.accounts < n:
+                    raise ValueError(
+                        f"execution.accounts={cfg.accounts} cannot host "
+                        f"{n} validator stake accounts (accounts 0..n-1)"
+                    )
+                cfg = _dc.replace(cfg, stake_accounts=n)
+            self._execution = cfg
+            self._exec_source = BlockSource(cfg)
+            genesis_stakes = (
+                self.epoch_schedule.stakes
+                if self.epoch_schedule is not None
+                else ()
+            )
+            if cfg.device:
+                from hyperdrive_tpu.exec.device import DeviceLedgerExecutor
+
+                exec_cls = DeviceLedgerExecutor
+            else:
+                from hyperdrive_tpu.exec.ledger import HostLedgerExecutor
+
+                exec_cls = HostLedgerExecutor
+            for i in range(n):
+                self.executors.append(
+                    exec_cls(
+                        cfg,
+                        genesis_stakes,
+                        source=self._exec_source,
+                        masks=self._exec_masks,
+                        obs=self.obs.scoped(i) if observe else _OBS_NULL,
+                    )
+                )
+            if cfg.sign_txs and self._sched is not None:
+                from hyperdrive_tpu.exec.ledger import ExecApplyLauncher
+                from hyperdrive_tpu.verifier import HostVerifier
+
+                self._exec_launcher = ExecApplyLauncher(
+                    getattr(self, "batch_verifier", None) or HostVerifier()
+                )
+            if self.epoch_schedule is not None:
+                from hyperdrive_tpu.exec.ledger import HostLedgerExecutor
+
+                if cfg.accounts < n:
+                    raise ValueError(
+                        f"execution.accounts={cfg.accounts} < n={n}: "
+                        "epoch elections read stake accounts 0..n-1"
+                    )
+                # Stake oracle: one extra host executor bound to the
+                # schedule's stake_source hook, so the FIRST path to
+                # mint a boundary transition — EpochCertifier
+                # .observe_commit fires inside the replica commit,
+                # before this sim's commit seam — already elects from
+                # committed ledger state. Host class on purpose:
+                # root-parity with the device executors is enforced, so
+                # the oracle is digest-neutral and jax-free.
+                oracle = HostLedgerExecutor(
+                    cfg, genesis_stakes,
+                    source=self._exec_source, masks=self._exec_masks,
+                )
+                self._exec_oracle = oracle
+
+                def _stake_source(height, _o=oracle, _n=n):
+                    _o.advance_to(height)
+                    return _o.election_stakes(_n)
+
+                self.epoch_schedule.stake_source = _stake_source
+            self.record.execution = cfg.as_ints()
+
         byz_prop = byzantine_proposer or {}
         byz_val = byzantine_validator or {}
 
@@ -1275,6 +1404,41 @@ class Simulation:
         return hashlib.sha256(
             b"value-%d-%d-%d" % (self.seed, height, round_)
         ).digest()
+
+    # ---------------------------------------------------------- execution
+
+    def _exec_value(self, height: Height, round_: int) -> Value:
+        """Proposal value in execution mode: commits to the height's
+        deterministic tx block. First proposal of a sign_txs height
+        also submits the block's signature triples as ONE
+        ``exec.apply`` command — the same drain that carries the vote
+        verifies resolves the admission mask into the shared
+        ``_exec_masks`` dict the executors read at commit time."""
+        if (
+            self._exec_launcher is not None
+            and height not in self._exec_futs
+        ):
+            blk = self._exec_source.block(height)
+            fut = self._sched.submit(
+                self._exec_launcher, self._exec_source.sig_items(blk)
+            )
+            self._exec_futs[height] = fut
+            fut.add_done_callback(
+                lambda f, h=height: self._exec_masks.setdefault(h, f._value)
+            )
+        return self._exec_source.value(height)
+
+    def _exec_valid(self, height: Height, round_: int, value: Value) -> bool:
+        return value == self._exec_source.value(height)
+
+    def _exec_extend(self, i: int, height: Height, value: Value) -> Value:
+        """The external commit record in execution mode: the agreed
+        value extended with replica ``i``'s chained state root after
+        applying every block up to ``height`` (resync gaps included).
+        Votes, certificates, and epoch anchors keep the raw 32-byte
+        value; the extension is what ``commits``/``commit_digest``
+        cover, so two runs agree end-to-end only if their ledgers do."""
+        return value + self.executors[i].advance_to(height)
 
     # -------------------------------------------------- BLS certificates
 
@@ -1456,6 +1620,17 @@ class Simulation:
             proposer = _PayloadProposer(self, byz_proposer or self._default_value)
             if not byz_validator:
                 validator = _PayloadValidator(self)
+        if self._execution is not None:
+            # Execution mode: the proposed value commits to the
+            # height's deterministic tx block (round-independent —
+            # retries re-propose the same block), and honest validators
+            # accept ONLY that value, so a Byzantine proposer cannot
+            # commit a valueless block. Proposing also submits the
+            # block's signature triples to the device queue (sign_txs),
+            # so the admission mask rides the drain its settles share.
+            proposer = MockProposer(fn=byz_proposer or self._exec_value)
+            if not byz_validator:
+                validator = MockValidator(fn=self._exec_valid)
 
         certifier = None
         if self.certificates_on:
@@ -1555,7 +1730,9 @@ class Simulation:
             if self._obs_sim is not _OBS_NULL:
                 self._obs_sim.emit("sched.gated", height, -1, i)
             return (0, None)
-        self.commits[i][height] = value
+        self.commits[i][height] = (
+            self._exec_extend(i, height, value) if self.executors else value
+        )
         if self.payload_bytes:
             self._reconstruct_commit(i, height, value)
         if self._overlay is not None:
@@ -1583,7 +1760,28 @@ class Simulation:
         scheduler)`` pair flows through the commit seam into
         ``start_round(0)`` of ``height + 1``."""
         sched = self.epoch_schedule
-        tr = sched.transition_at(height, value)
+        stakes = None
+        if self.executors:
+            # Stake-driven election (ROADMAP item 4 tail): the ledger's
+            # stake column at the boundary height — this replica's
+            # executor already applied the boundary block in
+            # _exec_extend — floored so candidacy never collapses
+            # (ROBUSTNESS.md "State-root doctrine"). Deterministic
+            # across replicas: same committed heights, same blocks,
+            # same stakes; the root-equality invariant enforces it.
+            stakes = self.executors[i].election_stakes(self.n)
+            if (
+                self._obs_sim is not _OBS_NULL
+                and sched.epoch_of(height) + 1 > sched.latest_epoch
+            ):
+                self._obs_sim.emit(
+                    "exec.stake", height, -1,
+                    "e%d min=%d max=%d" % (
+                        sched.epoch_of(height) + 1,
+                        min(stakes), max(stakes),
+                    ),
+                )
+        tr = sched.transition_at(height, value, stakes=stakes)
         if tr.epoch > self.epoch:
             self._epoch_install(tr, height)
         r = self.replicas[i]
@@ -1683,6 +1881,12 @@ class Simulation:
         gated = self._gated_commits
         self._gated_commits = []
         for i, height, value, fut in gated:
+            # Execution rides the finalize edge: the covering drain
+            # just resolved the height's exec.apply mask (submitted at
+            # proposal time), so the executor can apply the block and
+            # extend the commit record with its root.
+            if self.executors:
+                value = self._exec_extend(i, height, value)
             self.commits[i][height] = value
             if (
                 self._obs_sim is not _OBS_NULL
@@ -3505,6 +3709,17 @@ class Simulation:
                 rekey_per_epoch=rekey,
                 seed=eseed,
                 stakes=stakes,
+            )
+        if record.execution is not None and "execution" not in kwargs:
+            from hyperdrive_tpu.exec import ExecutionConfig
+
+            # The replayed ledger trajectory is a pure function of the
+            # config ints plus the committed heights the message stream
+            # reproduces — device and host executors are root-identical
+            # (the parity smoke), so the recorded backend choice only
+            # affects replay speed, never its commits.
+            kwargs["execution"] = ExecutionConfig.from_ints(
+                record.execution
             )
         sim = cls(
             n=record.n,

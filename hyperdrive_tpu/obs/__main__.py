@@ -5,7 +5,8 @@ benchdiff.
     report     render the round-anatomy table from a saved journal
                (``--tenants`` for per-origin device-launch latency,
                ``--overload`` for admission/shed posture,
-               ``--overlay`` for aggregation-overlay posture)
+               ``--overlay`` for aggregation-overlay posture,
+               ``--exec`` for execution-layer/state-root posture)
     export     convert a saved journal to Perfetto/Chrome trace JSON
     metrics    run a short observed sim, print its metrics-registry
                snapshot (JSON; ``--prometheus FILE`` for exposition text)
@@ -28,9 +29,11 @@ import sys
 from hyperdrive_tpu.obs.recorder import load_journal
 from hyperdrive_tpu.obs.report import (
     anatomy,
+    exec_summary,
     overlay_summary,
     overload_summary,
     phase_summary,
+    render_exec_table,
     render_overlay_table,
     render_overload_table,
     render_table,
@@ -70,6 +73,18 @@ def _cmd_record(ns):
 
 def _cmd_report(ns):
     journal = load_journal(ns.journal)
+    if ns.exec:
+        summary = exec_summary(journal["events"])
+        if ns.json:
+            print(json.dumps({"exec": summary}, indent=1))
+            return 0
+        if not (summary["blocks"] or summary["roots"]
+                or summary["stake_marks"]):
+            print("no exec.* events in journal window "
+                  "(record an execution run: Simulation(execution=...))")
+            return 1
+        print(render_exec_table(summary))
+        return 0
     if ns.overlay:
         summary = overlay_summary(journal["events"])
         if ns.json:
@@ -247,6 +262,13 @@ def main(argv=None):
         help="aggregation-overlay posture summary instead "
              "(the closed overlay.* family: frames, charges, "
              "escalations, demotions)",
+    )
+    rep.add_argument(
+        "--exec",
+        action="store_true",
+        help="execution-layer posture summary instead "
+             "(the closed exec.* family: applied blocks, state-root "
+             "agreement, epoch stake snapshots)",
     )
     rep.set_defaults(fn=_cmd_report)
 

@@ -153,6 +153,15 @@ EVENT_KINDS = frozenset(
         "service.remote.resolve",
         "service.remote.shed",
         "service.tenant.retire",
+        # Execution layer (exec/ledger.py, harness/sim.py): one mark
+        # per applied block (detail: tx count, admitted count, host vs
+        # device kernel route), one per chained state root, and one per
+        # boundary stake snapshot read by an epoch election. Closed
+        # family — the lint (HD005), the --exec report decoder, and
+        # OBSERVABILITY.md enumerate exactly these.
+        "exec.apply",
+        "exec.root",
+        "exec.stake",
     }
 )
 

@@ -26,6 +26,8 @@ __all__ = [
     "render_overload_table",
     "overlay_summary",
     "render_overlay_table",
+    "exec_summary",
+    "render_exec_table",
 ]
 
 _TIMEOUT_FIRES = (
@@ -524,6 +526,114 @@ def render_overlay_table(summary):
             "rekeys: " + " -> ".join(summary["rekeys"][:6])
             + (f" (+{len(summary['rekeys']) - 6} more)"
                if len(summary["rekeys"]) > 6 else "")
+        )
+    return "\n".join(lines)
+
+
+def exec_summary(events):
+    """Execution-layer posture from the journal alone.
+
+    Decodes the closed ``exec.*`` family (obs/recorder.py) so a saved
+    journal from an execution run answers the ledger questions without
+    a live sim: how many transactions each replica applied vs rejected,
+    whether the per-height state roots agree across every replica that
+    reported one, and which stake snapshots fed epoch elections.
+    """
+    out = {
+        "blocks": 0,
+        "txs": 0,
+        "applied": 0,
+        "rejected": 0,
+        "device_blocks": 0,
+        "host_blocks": 0,
+        "per_replica": {},  # replica -> {blocks, txs, applied}
+        "roots": {},  # height -> {root8 -> [replicas]}
+        "root_forks": [],  # heights where >1 distinct root was reported
+        "stake_marks": [],  # (height, detail) epoch stake snapshots
+    }
+    for ev in events:
+        replica, height, kind, detail = ev[1], ev[2], ev[4], ev[5]
+        if kind == "exec.apply":
+            out["blocks"] += 1
+            txs = applied = dev = None
+            for part in str(detail or "").split():
+                if part.startswith("txs="):
+                    txs = int(part[4:])
+                elif part.startswith("applied="):
+                    applied = int(part[8:])
+                elif part.startswith("dev="):
+                    dev = int(part[4:])
+            rep = out["per_replica"].setdefault(
+                replica, {"blocks": 0, "txs": 0, "applied": 0}
+            )
+            rep["blocks"] += 1
+            if txs is not None:
+                out["txs"] += txs
+                rep["txs"] += txs
+            if applied is not None:
+                out["applied"] += applied
+                rep["applied"] += applied
+            if txs is not None and applied is not None:
+                out["rejected"] += txs - applied
+            if dev:
+                out["device_blocks"] += 1
+            elif dev is not None:
+                out["host_blocks"] += 1
+        elif kind == "exec.root":
+            root8 = str(detail or "")
+            by_root = out["roots"].setdefault(height, {})
+            by_root.setdefault(root8, []).append(replica)
+        elif kind == "exec.stake":
+            out["stake_marks"].append((height, str(detail or "")))
+    out["root_forks"] = sorted(
+        h for h, by_root in out["roots"].items() if len(by_root) > 1
+    )
+    return out
+
+
+def render_exec_table(summary):
+    """The exec summary as aligned text (the CLI's ``--exec``)."""
+    lines = [
+        f"{summary['blocks']} applied blocks · "
+        f"{summary['txs']} txs ({summary['applied']} applied, "
+        f"{summary['rejected']} rejected) · "
+        f"route device={summary['device_blocks']} "
+        f"host={summary['host_blocks']}"
+    ]
+    per = summary["per_replica"]
+    if per:
+        rows = [["replica", "blocks", "txs", "applied"]]
+        for rep in sorted(per):
+            s = per[rep]
+            rows.append(
+                [str(rep), str(s["blocks"]), str(s["txs"]),
+                 str(s["applied"])]
+            )
+        widths = [max(len(r[i]) for r in rows) for i in range(4)]
+        for i, r in enumerate(rows):
+            lines.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+    roots = summary["roots"]
+    if roots:
+        agreed = len(roots) - len(summary["root_forks"])
+        lines.append(
+            f"state roots: {len(roots)} heights reported · "
+            f"{agreed} unanimous"
+        )
+        if summary["root_forks"]:
+            lines.append(
+                "ROOT FORKS at heights: "
+                + ", ".join(str(h) for h in summary["root_forks"])
+            )
+    if summary["stake_marks"]:
+        lines.append(
+            "epoch stake snapshots: "
+            + " · ".join(
+                f"h{h} {d}" for h, d in summary["stake_marks"][:6]
+            )
+            + (f" (+{len(summary['stake_marks']) - 6} more)"
+               if len(summary["stake_marks"]) > 6 else "")
         )
     return "\n".join(lines)
 

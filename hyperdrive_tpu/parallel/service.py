@@ -126,7 +126,12 @@ def encode_submit(req_id: int, height: int, round: int, value: bytes,
 
 
 def encode_result(req_id: int, status: int, nrows: int, mask,
-                  cert=None) -> bytes:
+                  cert=None, root=None) -> bytes:
+    """``root`` (32 bytes or None) rides between the mask and the
+    certificate tail: a serving host with an execution ledger attached
+    for the tenant stamps the committed frame with the chained state
+    root its executor derived at that height, so the O(1) certificate
+    answer vouches for ledger state, not just the agreed value."""
     w = Writer()
     w.u8(TAG_RESULT)
     w.u64(req_id)
@@ -137,6 +142,7 @@ def encode_result(req_id: int, status: int, nrows: int, mask,
         if ok:
             bitmap[i >> 3] |= 1 << (i & 7)
     w.raw(bytes(bitmap))
+    w.raw(root or b"")
     if cert is not None:
         cw = Writer()
         marshal_certificate(cert, cw)
@@ -175,7 +181,8 @@ def decode_request(payload: bytes):
 
 
 def decode_result(payload: bytes):
-    """Client-side decode: ``(req_id, status, mask, cert_or_None)``."""
+    """Client-side decode:
+    ``(req_id, status, mask, cert_or_None, root_or_None)``."""
     r = Reader(payload)
     if r.u8() != TAG_RESULT:
         raise SerdeError("expected a result frame")
@@ -188,9 +195,12 @@ def decode_result(payload: bytes):
     if len(bitmap) < -(-n // 8):
         raise SerdeError("result bitmap narrower than its row count")
     mask = [bool(bitmap[i >> 3] >> (i & 7) & 1) for i in range(n)]
+    root = r.raw() or None
+    if root is not None and len(root) != 32:
+        raise SerdeError(f"state root must be 32 bytes, got {len(root)}")
     cert_bytes = r.raw()
     cert = unmarshal_certificate(Reader(cert_bytes)) if cert_bytes else None
-    return req_id, status, mask, cert
+    return req_id, status, mask, cert, root
 
 
 # ---------------------------------------------------------------- service
@@ -262,6 +272,10 @@ class ShardVerifyService:
         self.watermarks: dict = {}
         self.cert_keep = None if cert_keep is None else int(cert_keep)
         self.retired_certs = 0
+        #: tenant -> HostLedgerExecutor (see :meth:`attach_execution`).
+        self.executors: dict = {}
+        #: tenant -> {height -> 32-byte chained state root}.
+        self.state_roots: dict = {}
 
     def _tenant_id(self, tenant) -> int:
         tid = self.tenant_ids.get(tenant)
@@ -282,6 +296,23 @@ class ShardVerifyService:
             transcript_source=lambda: self._launcher.last_transcript,
             obs=obs,
         )
+
+    def attach_execution(self, tenant, config, genesis_stakes=()):
+        """Give ``tenant`` a replicated ledger on this host: every
+        certificate accepted for it advances a deterministic
+        :class:`~hyperdrive_tpu.exec.ledger.HostLedgerExecutor` and
+        records the chained state root, so the O(1) certificate frame a
+        shard gets back can vouch for ledger state, not just the
+        committed value. The host executor is deliberate — the serving
+        path stays jax-free, and host/device parity is enforced by the
+        exec CLI smoke, so the root is the same either route. Returns
+        the executor (tests read ``roots`` off it directly)."""
+        from hyperdrive_tpu.exec.ledger import HostLedgerExecutor
+
+        ex = HostLedgerExecutor(config, genesis_stakes=genesis_stakes)
+        self.executors[tenant] = ex
+        self.state_roots[tenant] = {}
+        return ex
 
     def accept_certificate(self, tenant, certifier, cert) -> bool:
         """Cross-tenant commit-proof exchange: re-verify ``cert`` in
@@ -309,6 +340,14 @@ class ShardVerifyService:
             return False
         certs = self.certificates.setdefault(tenant, {})
         certs[cert.height] = cert
+        ex = self.executors.get(tenant)
+        if ex is not None:
+            # Advance the tenant's ledger to the certified height (the
+            # executor catches up any gap deterministically from its
+            # block source) and pin the root the frame will carry.
+            self.state_roots[tenant][cert.height] = ex.advance_to(
+                cert.height
+            )
         wm = self.watermarks.get(tenant, 0)
         if cert.height > wm:
             wm = self.watermarks[tenant] = cert.height
@@ -435,8 +474,12 @@ class TenantShard:
 
     def __init__(self, name: str, n_validators: int = 4, f=None,
                  target_height: int = 8, sign: bool = True,
-                 time_fn=None):
+                 time_fn=None, execution=None):
         self.name = str(name)
+        #: Optional :class:`~hyperdrive_tpu.exec.ExecutionConfig`:
+        #: attach_local registers it with the service so committed
+        #: certificate frames carry the tenant's chained state root.
+        self.execution = execution
         self.ring = KeyRing.deterministic(
             n_validators, namespace=b"tenant/" + self.name.encode()
         )
@@ -450,6 +493,9 @@ class TenantShard:
         self.generation = 0
         #: height -> committed value (32 bytes), in acceptance order.
         self.commits: dict = {}
+        #: height -> 32-byte state root the committed frame carried
+        #: (execution-attached tenants only).
+        self.state_roots: dict = {}
         #: Per-commit submit->finalize latency (seconds on time_fn).
         self.commit_latencies: list = []
         self.rejected = 0
@@ -500,6 +546,8 @@ class TenantShard:
         self.service = service
         self.generation = int(generation)
         self.certifier = service.certifier(self.ring.signatories, self.f)
+        if self.execution is not None:
+            service.attach_execution(self.name, self.execution)
         return self
 
     def pump(self, max_inflight: int = 2) -> int:
@@ -538,6 +586,9 @@ class TenantShard:
         cert = self.certifier.observe_commit(height, 0, value, signers)
         if self.service.accept_certificate(self.name, self.certifier, cert):
             self.commits[height] = value
+            root = self.service.state_roots.get(self.name, {}).get(height)
+            if root is not None:
+                self.state_roots[height] = root
             self.commit_latencies.append(self.time_fn() - t0)
         else:
             self.rejected += 1
@@ -596,6 +647,8 @@ class TenantShard:
                 and self.certifier.verify(cert)
             ):
                 self.commits[height] = value
+                if fut.root is not None:
+                    self.state_roots[height] = fut.root
                 self.commit_latencies.append(self.time_fn() - t0)
             else:
                 self.rejected += 1
@@ -878,8 +931,15 @@ class ServicePort:
                 "service.remote.resolve", height, rnd,
                 STATUS_NAMES[status],
             )
+        root = None
+        if status == STATUS_COMMITTED:
+            root = self.service.state_roots.get(
+                conn.tenant, {}
+            ).get(height)
         self._send(
-            conn, encode_result(req_id, status, len(rows), mask, cert)
+            conn,
+            encode_result(req_id, status, len(rows), mask, cert,
+                          root=root),
         )
 
     def close(self) -> None:
@@ -908,13 +968,18 @@ class RemoteFuture:
     """Resolution handle for one remote window: a thread event the
     client's reader sets when the certificate frame lands."""
 
-    __slots__ = ("_event", "status", "mask", "cert")
+    __slots__ = ("_event", "status", "mask", "cert", "root")
 
     def __init__(self):
         self._event = threading.Event()
         self.status = None
         self.mask = None
         self.cert = None
+        #: 32-byte chained state root the committed frame carried, or
+        #: None (execution-attached tenants only). Deliberately outside
+        #: :meth:`result`'s tuple so root-less deployments keep their
+        #: 3-tuple unpack.
+        self.root = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -982,7 +1047,9 @@ class RemoteServiceClient:
                 if payload is None:
                     return
                 try:
-                    req_id, status, mask, cert = decode_result(payload)
+                    req_id, status, mask, cert, root = decode_result(
+                        payload
+                    )
                 except SerdeError:
                     continue
                 with self._pending_lock:
@@ -991,6 +1058,7 @@ class RemoteServiceClient:
                     fut.status = status
                     fut.mask = mask
                     fut.cert = cert
+                    fut.root = root
                     fut._event.set()
         except OSError:
             return

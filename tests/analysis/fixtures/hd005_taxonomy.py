@@ -2,7 +2,8 @@
 
 Well-formed lowercase dotted names that sit under the closed event
 families (sched.launch.*, verify.occupancy.*, metrics.*, bls.*,
-tenant.drain.*, service.*) but are not members of the recorder taxonomy
+tenant.drain.*, service.*, exec.*) but are not members of the recorder
+taxonomy
 are silent forks — the grep-based journal test only audits files it
 covers, the lint covers the rest.
 """
@@ -31,6 +32,9 @@ class Pipeline:
     def bad_unknown_service(self, t):
         self.obs.emit("service.remote.ack", -1, -1, -1, t)  # BAD: fork
 
+    def bad_unknown_exec(self, h):
+        self.obs.emit("exec.applied", -1, h, -1, 0)  # BAD: fork
+
     def good_taxonomy_members(self, lid, pct):
         self.obs.emit("sched.launch.begin", -2, -1, -1, lid)
         self.obs.emit("verify.occupancy.pct", -1, -1, -1, pct)
@@ -39,6 +43,9 @@ class Pipeline:
         self.obs.emit("bls.partial.reject", -1, -1, -1, 0)
         self.obs.emit("tenant.drain.deferred", -1, -1, -1, 0)
         self.obs.emit("service.remote.resolve", -1, -1, -1, 0)
+        self.obs.emit("exec.apply", -1, -1, -1, 0)
+        self.obs.emit("exec.root", -1, -1, -1, 0)
+        self.obs.emit("exec.stake", -1, -1, -1, 0)
 
     def good_open_family(self):
         # Families outside the closed prefixes stay grep-audited only:

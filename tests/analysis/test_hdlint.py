@@ -92,10 +92,10 @@ def test_hd005_taxonomy_fixture_flags_closed_family_forks():
     findings = run_on(path)
     assert {f.rule for f in findings} == {"HD005"}
     # One unknown name per closed family (sched.launch.*,
-    # verify.occupancy.*, metrics.*, bls.*, tenant.drain.*, service.*)
-    # — and none of the GOOD members, open-family literals, or
+    # verify.occupancy.*, metrics.*, bls.*, tenant.drain.*, service.*,
+    # exec.*) — and none of the GOOD members, open-family literals, or
     # non-emit methods.
-    assert len(findings) == 6
+    assert len(findings) == 7
     src = open(path).read()
     bad_lines = {
         i + 1 for i, text in enumerate(src.splitlines()) if "# BAD" in text

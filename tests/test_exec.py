@@ -1,0 +1,393 @@
+"""The execution layer: deterministic ledger, device-kernel parity,
+stake-driven epochs.
+
+Unit layer: the order-independent block-atomic apply semantics
+(handcrafted blocks + permutation invariance), the host/device kind
+constants, root-chain determinism across resync gaps, and the
+``exec.apply`` launcher riding the shared device-work drain.
+
+Integration layer: full Simulation runs with ``execution=
+ExecutionConfig(...)`` — root-extended commit values, record/replay
+determinism (ScenarioRecord v7 execution trailer), device-vs-host
+digest equality, and the stake-driven election specs: the elected
+committee genuinely differs from the static-stake counterfactual, the
+grinding resistance of proportional election, and retired keys across
+a stake-changing boundary.
+"""
+
+import hashlib
+
+import pytest
+
+from hyperdrive_tpu.chaos.monitor import InvariantMonitor
+from hyperdrive_tpu.devsched.queue import DeviceWorkQueue
+from hyperdrive_tpu.epochs import EpochConfig, elect_committee
+from hyperdrive_tpu.exec import ExecutionConfig
+from hyperdrive_tpu.exec.ledger import (
+    KIND_STAKE,
+    KIND_TRANSFER,
+    KIND_UNSTAKE,
+    BlockSource,
+    ExecApplyLauncher,
+    HostLedgerExecutor,
+    TxBlock,
+    pack_state,
+)
+from hyperdrive_tpu.harness.sim import ScenarioRecord, Simulation
+
+
+def _cfg(**kw) -> ExecutionConfig:
+    base = dict(
+        accounts=32,
+        txs_per_block=24,
+        stake_every=3,
+        stake_accounts=8,
+        seed=9,
+        amount_cap=16,
+        initial_balance=500,
+    )
+    base.update(kw)
+    if base["stake_accounts"] > base["accounts"]:
+        base["stake_accounts"] = base["accounts"] // 2
+    return ExecutionConfig(**base)
+
+
+def _block(height, rows) -> TxBlock:
+    kind = [r[0] for r in rows]
+    sender = [r[1] for r in rows]
+    recipient = [r[2] for r in rows]
+    amount = [r[3] for r in rows]
+    return TxBlock(
+        height, kind, sender, recipient, amount,
+        hashlib.sha256(repr(rows).encode()).digest(),
+    )
+
+
+def _apply_rows(executor, rows):
+    return executor._apply_block(_block(1, rows), None)
+
+
+# ----------------------------------------------------------------- semantics
+
+
+def test_kind_constants_match_device_kernel():
+    from hyperdrive_tpu.ops import ledger as ops_ledger
+
+    assert KIND_TRANSFER == ops_ledger.KIND_TRANSFER
+    assert KIND_STAKE == ops_ledger.KIND_STAKE
+    assert KIND_UNSTAKE == ops_ledger.KIND_UNSTAKE
+
+
+def test_block_atomic_insolvency_kills_every_tx_of_the_sender():
+    # Sender 0 holds 10; two 6-unit transfers are each affordable alone
+    # but not together — the block-atomic rule rejects BOTH (solvency is
+    # a statement about the pre-block snapshot, not a running balance).
+    ex = HostLedgerExecutor(_cfg(accounts=4, initial_balance=10))
+    applied = _apply_rows(ex, [
+        (KIND_TRANSFER, 0, 1, 6),
+        (KIND_TRANSFER, 0, 2, 6),
+        (KIND_TRANSFER, 3, 1, 6),   # a solvent bystander still lands
+    ])
+    assert applied == 1
+    assert ex.balances[0] == 10 and ex.balances[3] == 4
+    assert ex.balances[1] == 16 and ex.balances[2] == 10
+    # Alone, the same transfer goes through.
+    ex2 = HostLedgerExecutor(_cfg(accounts=4, initial_balance=10))
+    assert _apply_rows(ex2, [(KIND_TRANSFER, 0, 1, 6)]) == 1
+    assert ex2.balances[0] == 4
+
+
+def test_stake_and_unstake_move_between_columns():
+    ex = HostLedgerExecutor(
+        _cfg(accounts=4, initial_balance=10), genesis_stakes=(0, 7)
+    )
+    applied = _apply_rows(ex, [
+        (KIND_STAKE, 0, 0, 4),
+        (KIND_UNSTAKE, 1, 1, 5),
+        (KIND_UNSTAKE, 2, 2, 1),    # no stake to unstake: rejected
+    ])
+    assert applied == 2
+    assert (ex.balances[0], ex.stakes[0]) == (6, 4)
+    assert (ex.balances[1], ex.stakes[1]) == (15, 2)
+    assert (ex.balances[2], ex.stakes[2]) == (10, 0)
+    assert ex.rejected_total == 0  # _apply_block alone doesn't count
+
+
+def test_apply_is_order_independent():
+    import random
+
+    rows = []
+    rnd = random.Random(3)
+    for _ in range(40):
+        rows.append((
+            rnd.choice((KIND_TRANSFER, KIND_STAKE, KIND_UNSTAKE)),
+            rnd.randrange(8), rnd.randrange(8), rnd.randint(1, 20),
+        ))
+    ref = HostLedgerExecutor(_cfg(accounts=8, initial_balance=30))
+    n_ref = _apply_rows(ref, rows)
+    for i in range(4):
+        shuffled = rows[:]
+        random.Random(i).shuffle(shuffled)
+        ex = HostLedgerExecutor(_cfg(accounts=8, initial_balance=30))
+        assert _apply_rows(ex, shuffled) == n_ref
+        assert ex.balances == ref.balances and ex.stakes == ref.stakes
+
+
+def test_root_chain_deterministic_across_resync_gaps():
+    cfg = _cfg()
+    stepper = HostLedgerExecutor(cfg)
+    for h in range(1, 6):
+        stepper.advance_to(h)
+    jumper = HostLedgerExecutor(cfg)
+    assert jumper.advance_to(5) == stepper.roots[5]
+    assert jumper.roots == stepper.roots
+    # Re-asking a settled height is a cached read, not a re-apply.
+    assert jumper.advance_to(3) == stepper.roots[3]
+    assert jumper.height == 5
+    # Genesis root is a pure function of the config.
+    assert jumper.genesis_root == stepper.genesis_root
+    assert jumper.advance_to(0) == jumper.genesis_root
+
+
+def test_pack_state_is_le64_signed():
+    assert pack_state([1, -2]) == (1).to_bytes(8, "little", signed=True) + (
+        -2
+    ).to_bytes(8, "little", signed=True)
+
+
+def test_execution_config_rejects_overflow_risk():
+    with pytest.raises(ValueError):
+        ExecutionConfig(
+            accounts=8, txs_per_block=2**20, amount_cap=2**12,
+            initial_balance=2**30,
+        )
+    cfg = _cfg()
+    assert ExecutionConfig.from_ints(cfg.as_ints()) == cfg
+
+
+# -------------------------------------------------------------- device parity
+
+
+def test_device_executor_matches_host_reference():
+    from hyperdrive_tpu.exec.device import DeviceLedgerExecutor
+
+    for seed in (1, 2, 3):
+        cfg = _cfg(seed=seed, txs_per_block=64, initial_balance=40)
+        src = BlockSource(cfg)
+        host = HostLedgerExecutor(cfg, source=src, genesis_stakes=(5, 5))
+        dev = DeviceLedgerExecutor(cfg, source=src, genesis_stakes=(5, 5))
+        assert dev.genesis_root == host.genesis_root
+        host.advance_to(4)
+        dev.advance_to(4)
+        assert dev.roots == host.roots
+        assert dev.applied_total == host.applied_total
+        assert dev.rejected_total == host.rejected_total
+        assert list(dev.balances) == list(host.balances)
+        assert list(dev.stakes) == list(host.stakes)
+
+
+def test_device_executor_matches_host_on_signed_blocks():
+    from hyperdrive_tpu.exec.device import DeviceLedgerExecutor
+
+    cfg = _cfg(sign_txs=True, bad_sig_every=5, txs_per_block=16)
+    src = BlockSource(cfg)
+    host = HostLedgerExecutor(cfg, source=src)
+    dev = DeviceLedgerExecutor(cfg, source=src)
+    host.advance_to(2)
+    dev.advance_to(2)
+    assert dev.roots == host.roots
+    # Every 5th lane was corrupted: the mask must have rejected them.
+    assert host.rejected_total >= 2 * (16 // 5)
+
+
+# ------------------------------------------------------------------ launcher
+
+
+def test_exec_apply_launcher_rides_the_shared_drain():
+    from hyperdrive_tpu.verifier import HostVerifier
+
+    cfg = _cfg(sign_txs=True, bad_sig_every=4, txs_per_block=12)
+    src = BlockSource(cfg)
+    blk = src.block(1)
+    items = src.sig_items(blk)
+
+    q = DeviceWorkQueue()
+    verifier = HostVerifier()
+    exec_launcher = ExecApplyLauncher(verifier)
+    assert exec_launcher.kind == "exec.apply"
+    vote_launcher = q.verify_launcher(verifier)
+    f_vote = q.submit(vote_launcher, items[:2])
+    f_exec = q.submit(exec_launcher, items)
+    assert not f_exec.done()
+    # ONE drain cycle resolves both command kinds (grouped by launcher
+    # identity, so the exec launch coalesces separately from votes).
+    q.drain()
+    assert f_vote.done() and f_exec.done()
+    mask = f_exec.result()
+    assert len(mask) == len(blk)
+    want = [bool(v) for v in verifier.verify_signatures(items)]
+    assert mask == want
+    assert not all(mask)  # the corrupted lanes really got rejected
+    assert q.launches >= 2  # distinct launchers never share a launch
+
+    # The mask is exactly what a maskless executor derives host-side:
+    # launcher path and fallback path are digest-identical.
+    with_mask = HostLedgerExecutor(cfg, source=src, masks={1: mask})
+    without = HostLedgerExecutor(cfg, source=src)
+    assert with_mask.advance_to(1) == without.advance_to(1)
+
+
+# ----------------------------------------------------------------- harness
+
+
+def _exec_sim(seed=13, device=False, target=6, **kw) -> Simulation:
+    cfg = _cfg(seed=seed, device=device, txs_per_block=12)
+    return Simulation(
+        n=4, target_height=target, seed=seed, execution=cfg, **kw
+    )
+
+
+def test_sim_commits_are_root_extended_and_replayable(tmp_path):
+    sim = _exec_sim(observe=True)
+    res = sim.run()
+    assert res.completed
+    ref = HostLedgerExecutor(_cfg(seed=13, txs_per_block=12))
+    for i in range(sim.n):
+        for h, value in sim.commits[i].items():
+            assert len(value) == 64  # 32-byte value + 32-byte root
+            assert value[32:] == ref.advance_to(h)
+    assert sum(e.applied_total for e in sim.executors) > 0
+    # Record/replay: the v7 execution trailer reproduces the identical
+    # root-extended chain from the config ints alone.
+    path = str(tmp_path / "exec.bin")
+    sim.record.dump(path)
+    rec = ScenarioRecord.load(path)
+    assert rec.execution == _cfg(seed=13, txs_per_block=12).as_ints()
+    replayed = Simulation.replay(rec)
+    assert replayed.completed
+    assert replayed.commits == res.commits
+
+
+def test_sim_device_executor_is_digest_identical_to_host():
+    host = _exec_sim(seed=21, device=False).run()
+    dev = _exec_sim(seed=21, device=True).run()
+    assert host.completed and dev.completed
+    assert dev.commits == host.commits
+
+
+# ------------------------------------------------------- stake-driven epochs
+
+
+def _stake_sim(seed=17, target=9, **kw) -> Simulation:
+    # Heavy stake churn: every other tx is a STAKE/UNSTAKE on one of
+    # the n validator accounts, so the ledger's stake column drifts
+    # hard between boundaries.
+    cfg = _cfg(
+        seed=seed, accounts=16, txs_per_block=32, stake_every=2,
+        stake_accounts=4, amount_cap=32, initial_balance=2000,
+    )
+    return Simulation(
+        n=4,
+        target_height=target,
+        seed=seed,
+        execution=cfg,
+        epochs=EpochConfig(epoch_length=3, committee_size=3),
+        certificates=True,
+        **kw,
+    )
+
+
+def test_elections_read_stake_from_committed_state():
+    sim = _stake_sim()
+    res = sim.run()
+    assert res.completed and sim.epoch >= 2
+    sched = sim.epoch_schedule
+    # The sim seeds the ledger's stake column with the epoch pool's
+    # genesis stakes (uniform 1 when EpochConfig.stakes is ()), so the
+    # reference executor must start from the same genesis.
+    ref = HostLedgerExecutor(
+        _cfg(
+            seed=17, accounts=16, txs_per_block=32, stake_every=2,
+            stake_accounts=4, amount_cap=32, initial_balance=2000,
+        ),
+        genesis_stakes=sched.stakes,
+    )
+    differs = 0
+    for e in range(1, sim.epoch + 1):
+        tr = sched.transition(e)
+        boundary = sched.boundary_height(e - 1)
+        # The committee the sim elected == the committee elected from
+        # the ledger's floored stake column at the boundary height.
+        ref.advance_to(boundary)
+        stakes = ref.election_stakes(sim.n)
+        want = elect_committee(
+            stakes, sched.committee_size, sched.anchor(e) + b"elect"
+        )
+        assert tuple(v.index for v in tr.committee) == want
+        assert tuple(v.stake for v in tr.committee) == tuple(
+            stakes[i] for i in want
+        )
+        # The acceptance counterfactual: a static-stake election at the
+        # same anchor seats a DIFFERENT committee — the stake the
+        # ledger accumulated genuinely drove the outcome.
+        static = elect_committee(
+            sched.stakes, sched.committee_size, sched.anchor(e) + b"elect"
+        )
+        if want != static:
+            differs += 1
+    assert differs > 0, (
+        "every elected committee matched the static-stake counterfactual "
+        "— elections are not reading committed state"
+    )
+
+
+def test_stake_floor_keeps_drained_validators_electable():
+    cfg = _cfg(stake_floor=7)
+    ex = HostLedgerExecutor(cfg)  # zero genesis stake everywhere
+    stakes = ex.election_stakes(4)
+    assert stakes == (7, 7, 7, 7)
+    # A floored pool is always electable even when the ledger has
+    # drained every validator account to zero.
+    assert len(elect_committee(stakes, 3, b"m")) == 3
+
+
+def test_grinding_by_stake_splitting_buys_no_extra_seats():
+    # Proportional election's grinding resistance: an adversary
+    # splitting one 40-unit stake across two sybil accounts wins seats
+    # at the same aggregate rate as the merged whale. 256 independent
+    # anchors, 3-of-N committees; the split pool has one more member.
+    merged = (40,) + (10,) * 6
+    split = (20, 20) + (10,) * 6
+    rounds = 256
+    merged_wins = sum(
+        0 in elect_committee(merged, 3, b"grind%d" % i)
+        for i in range(rounds)
+    )
+    split_wins = sum(
+        bool({0, 1} & set(elect_committee(split, 3, b"grind%d" % i)))
+        for i in range(rounds)
+    )
+    assert abs(merged_wins - split_wins) <= rounds * 0.12, (
+        f"splitting moved the whale's seat rate from "
+        f"{merged_wins}/{rounds} to {split_wins}/{rounds}"
+    )
+
+
+def test_rekey_across_stake_changing_boundary():
+    # Key rotation and stake-driven election compose: a committee
+    # member retires its identity at a boundary whose election read
+    # freshly-mutated stake, and the run stays fork-free with the
+    # monitor's exec invariants armed (root agreement + commit/ledger
+    # binding).
+    sim = _stake_sim(seed=23, target=9, observe=True)
+    mon = InvariantMonitor(sim)
+    res = sim.run()
+    mon.check_final(res)
+    assert res.completed and sim.epoch >= 2
+    assert sim._retired, "no key was ever rotated out"
+    assert len(mon.epoch_switches) >= 2
+    retired_epochs = [
+        e for e in range(1, sim.epoch + 1)
+        if sim.epoch_schedule.transition(e).rekeyed
+    ]
+    assert retired_epochs, "no transition rotated a key"

@@ -341,11 +341,21 @@ def test_wire_roundtrip_hello_submit_result():
     assert pairs == [(pc.sender, pc.signature) for pc in rows]
 
     mask = [True, False, True, True, False]
-    req_id, status, got_mask, cert = decode_result(
+    req_id, status, got_mask, cert, root = decode_result(
         encode_result(9, STATUS_COMMITTED, 5, mask)
     )
-    assert (req_id, status, cert) == (9, STATUS_COMMITTED, None)
+    assert (req_id, status, cert, root) == (9, STATUS_COMMITTED, None, None)
     assert got_mask == mask
+
+    # A root-stamped frame round-trips the 32 bytes; a wrong-width root
+    # is malformed on its face.
+    stamped = encode_result(9, STATUS_COMMITTED, 5, mask,
+                            root=b"\x42" * 32)
+    assert decode_result(stamped)[4] == b"\x42" * 32
+    with pytest.raises(SerdeError):
+        decode_result(
+            encode_result(9, STATUS_COMMITTED, 5, mask, root=b"\x42" * 8)
+        )
 
 
 def test_wire_result_carries_certificate():
@@ -353,7 +363,7 @@ def test_wire_result_carries_certificate():
     shard = TenantShard("c", target_height=1, sign=False).attach_local(svc)
     _drive(svc, [shard])
     cert = svc.certificates["c"][1]
-    _req, _status, _mask, got = decode_result(
+    _req, _status, _mask, got, _root = decode_result(
         encode_result(1, STATUS_COMMITTED, 4, [True] * 4, cert)
     )
     assert got is not None
@@ -411,3 +421,114 @@ def test_port_counts_bad_frames_instead_of_dying():
         client.close()
         port.close()
         svc.close()
+
+
+# ------------------------------------------------ execution-layer hook
+
+
+def _exec_cfg(seed=5):
+    from hyperdrive_tpu.exec import ExecutionConfig
+
+    return ExecutionConfig(
+        accounts=16, txs_per_block=8, stake_every=3, stake_accounts=4,
+        seed=seed,
+    )
+
+
+def test_local_tenant_commits_carry_state_roots():
+    svc = _service()
+    shard = TenantShard(
+        "led", target_height=4, sign=False, execution=_exec_cfg()
+    ).attach_local(svc)
+    _drive(svc, [shard])
+    assert shard.done and shard.rejected == 0
+    # Every committed height carries the executor's chained root, and
+    # the chain is exactly what a standalone executor derives from the
+    # same config — the frame vouches for ledger state.
+    from hyperdrive_tpu.exec.ledger import HostLedgerExecutor
+
+    ref = HostLedgerExecutor(_exec_cfg())
+    for h in range(1, 5):
+        assert shard.state_roots[h] == ref.advance_to(h)
+    assert svc.executors["led"].applied_total == ref.applied_total
+
+
+def test_remote_tenant_frames_carry_state_roots():
+    svc = _service()
+    svc.attach_execution("rx", _exec_cfg(seed=9))
+    port = svc.remote_port()
+    client = RemoteServiceClient(*port.address)
+    remote = TenantShard("rx", target_height=3, sign=False)
+    remote.attach_remote(client)
+    try:
+        import threading
+
+        t = threading.Thread(target=remote.run_remote, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while not remote.done and time.monotonic() < deadline:
+            port.pump()
+            svc.drain()
+            time.sleep(0.001)
+        t.join(timeout=5.0)
+        assert remote.done and remote.rejected == 0
+        from hyperdrive_tpu.exec.ledger import HostLedgerExecutor
+
+        ref = HostLedgerExecutor(_exec_cfg(seed=9))
+        for h in range(1, 4):
+            assert remote.state_roots[h] == ref.advance_to(h)
+    finally:
+        client.close()
+        port.close()
+        svc.close()
+
+
+def test_rootless_tenant_unaffected_by_neighbors_ledger():
+    # A tenant WITHOUT execution attached must see no root on its
+    # frames and commit the byte-identical chain it commits solo —
+    # another tenant's ledger must never leak across accounting keys.
+    svc = _service()
+    led = TenantShard(
+        "led", target_height=3, sign=False, execution=_exec_cfg()
+    ).attach_local(svc)
+    plain = TenantShard("plain", target_height=3, sign=False)
+    plain.attach_local(svc)
+    _drive(svc, [led, plain])
+    assert plain.state_roots == {}
+    assert len(led.state_roots) == 3
+    solo_svc = _service()
+    solo = TenantShard("plain", target_height=3, sign=False)
+    solo.attach_local(solo_svc)
+    _drive(solo_svc, [solo])
+    assert plain.commit_digest() == solo.commit_digest()
+
+
+def test_epoch_rotation_mid_serve_keeps_roots_continuous():
+    # The regression frontier: a service-wide epoch rotation lands
+    # while an execution-attached tenant is mid-serve. The rotation
+    # retags subsequent windows with the new generation; the tenant's
+    # root chain must stay continuous across the boundary and the whole
+    # run must match a rotation-free serve byte for byte.
+    def serve(rotate_at):
+        svc = _service()
+        shard = TenantShard(
+            "rot", target_height=6, sign=False, execution=_exec_cfg(seed=3)
+        ).attach_local(svc)
+        for _ in range(10_000):
+            if shard.done:
+                break
+            if rotate_at is not None and len(shard.commits) >= rotate_at:
+                svc.rotate(generation=1)
+                shard.generation = 1
+                rotate_at = None
+            shard.pump(max_inflight=1)
+            svc.drain()
+        assert shard.done and shard.rejected == 0
+        return shard
+
+    rotated = serve(rotate_at=3)
+    baseline = serve(rotate_at=None)
+    assert sorted(rotated.state_roots) == list(range(1, 7))
+    assert rotated.state_roots == baseline.state_roots
+    assert rotated.commit_digest() == baseline.commit_digest()
+    assert rotated.generation == 1
