@@ -6,7 +6,8 @@ Usage::
         [--n N_REPLICAS] [--target H] [--out DIR] [--replay-every K]
         [--pipelined-every K] [--certs-every K] [--bls-certs-every K]
         [--churn-every K] [--overload-every K] [--overlay-every K]
-        [--tenants-every K] [--dump-ok DIR]
+        [--tenants-every K] [--exec-every K] [--exec-pipeline-every K]
+        [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -152,6 +153,58 @@ def _build_exec_churn(scen_seed: int, n: int, target: int):
             stake_every=2,
             seed=scen_seed,
         ),
+    )
+    return plan, sim
+
+
+def _build_exec_pipeline(scen_seed: int, n: int, target: int,
+                         speculate: bool):
+    """A speculative-execution-pipeline scenario (PR 16): signed
+    transaction blocks with forged-but-well-formed signatures (every
+    K-th sig byte-flipped, still 64 bytes — so the well-formedness
+    guess ADMITS the lane and verification then rejects it) applied
+    speculatively through a shared devsched queue, under the churn
+    fault plan's partition + crash-restore — faults land inside open
+    speculation windows. Every resolved window mismatches, so the
+    rollback path runs constantly; the monitor's no-rolled-back-root-
+    committed invariant is armed with real discarded roots to audit.
+    ``speculate=False`` builds the sequential settle-then-execute twin
+    (same config, no queue) the digest cross-check holds the pipelined
+    chain to. Host executors + the jax-free QueueFlusher keep the soak
+    accelerator-free; the signature checks run on the host verifier."""
+    from hyperdrive_tpu.exec import ExecutionConfig
+
+    plan = FaultPlan.churn(scen_seed, n)
+    extra = {}
+    if speculate:
+        from hyperdrive_tpu.devsched import DeviceWorkQueue, QueueFlusher
+        from hyperdrive_tpu.verifier import NullVerifier
+
+        queue = DeviceWorkQueue(max_depth=8)
+        extra = dict(
+            devsched=queue,
+            flusher_for=lambda i, validators: QueueFlusher(
+                NullVerifier(), queue
+            ),
+            exec_speculate=True,
+        )
+    sim = Simulation(
+        n=n,
+        target_height=target,
+        seed=scen_seed,
+        timeout=1.0,
+        delivery_cost=1e-3,
+        chaos=plan,
+        observe=True,
+        execution=ExecutionConfig(
+            accounts=max(2 * n, 16),
+            txs_per_block=12,
+            stake_every=4,
+            seed=scen_seed,
+            sign_txs=True,
+            bad_sig_every=5,
+        ),
+        **extra,
     )
     return plan, sim
 
@@ -766,6 +819,75 @@ def soak(args) -> int:
                 f"rejected={sum(e.rejected_total for e in xsim.executors)} "
                 f"roots={len(xsim.executors[0].roots)} root-agreement=ok"
             )
+        if args.exec_pipeline_every and k % args.exec_pipeline_every == 0:
+            # Every Kth scenario additionally runs the speculative-
+            # pipeline family (PR 16): forged-but-well-formed tx
+            # signatures force a rollback on every resolved window
+            # while churn faults (partition + crash-restore) land
+            # inside open windows. Armed invariants: no rolled-back
+            # root in any committed value (monitor), digest equality
+            # with the sequential settle-then-execute twin, and a
+            # record-replay self-check on the root-extended chain.
+            pn = args.n if args.n else 7
+            _, ssim = _build_exec_pipeline(
+                scen_seed, pn, args.target, speculate=True
+            )
+            smon = InvariantMonitor(ssim)
+            try:
+                sres = ssim.run(max_steps=args.max_steps)
+                smon.check_final(sres)
+                rolled = sum(
+                    e.spec_rolled_back for e in ssim._exec_unique
+                )
+                discarded: set = set()
+                for e in ssim._exec_unique:
+                    discarded |= e.discarded_roots
+                if not rolled or not discarded:
+                    raise InvariantViolation(
+                        "exec-rollback",
+                        "speculative leg resolved no rollbacks — the "
+                        "forged signatures did not exercise the unwind "
+                        "path",
+                    )
+                _, qsim = _build_exec_pipeline(
+                    scen_seed, pn, args.target, speculate=False
+                )
+                qmon = InvariantMonitor(qsim)
+                qres = qsim.run(max_steps=args.max_steps)
+                qmon.check_final(qres)
+                if sres.commit_digest() != qres.commit_digest():
+                    raise InvariantViolation(
+                        "exec-rollback",
+                        "speculative pipeline chain diverges from the "
+                        "sequential settle-then-execute run",
+                    )
+                sreplayed = Simulation.replay(ssim.record)
+                if sreplayed.commits != sres.commits:
+                    raise InvariantViolation(
+                        "replay",
+                        "speculative-pipeline replay diverges from "
+                        "live run (root-extended commits)",
+                    )
+            except (InvariantViolation, AssertionError) as err:
+                failures += 1
+                base = _dump_failure(args.out, scen_seed, ssim, err)
+                print(
+                    f"FAIL exec-pipeline seed={scen_seed} n={pn} {err}\n"
+                    f"  dumped {base}.bin (+ journal, checkpoints)\n"
+                    f"  reproduce: python -m hyperdrive_tpu.chaos "
+                    f"replay {base}.bin",
+                    file=sys.stderr,
+                )
+                if not args.keep_going:
+                    return 1
+                continue
+            print(
+                f"ok exec-pipeline seed={scen_seed} n={pn} "
+                f"rollbacks={rolled} discarded={len(discarded)} "
+                f"max_depth="
+                f"{max(e.spec_rollback_depth for e in ssim._exec_unique)} "
+                f"seq-digest=ok replay=ok"
+            )
     if failures:
         print(f"soak FAILED: {failures}/{args.scenarios}", file=sys.stderr)
         return 1
@@ -893,6 +1015,17 @@ def main(argv=None) -> int:
         "scenario (stake-churn transactions driving stake-elected "
         "epochs under partition + crash-restore, with state-root "
         "agreement armed and a root-extended replay self-check; "
+        "0 = off)",
+    )
+    p.add_argument(
+        "--exec-pipeline-every",
+        type=int,
+        default=0,
+        help="additionally run every Kth seed as a speculative-"
+        "execution-pipeline scenario (forged-but-well-formed tx "
+        "signatures forcing rollbacks inside churn faults, the "
+        "no-rolled-back-root-committed invariant armed, digest parity "
+        "with the sequential twin, and a record-replay self-check; "
         "0 = off)",
     )
     p.add_argument(

@@ -328,10 +328,21 @@ class InvariantMonitor:
           height must end with that replica's own root for the height,
           so the root the certificate chain vouches for is the root the
           ledger actually computed.
+        - **no rolled-back root committed** — the speculative pipeline's
+          hard promise (``--exec-pipeline-every`` soak legs): a root
+          computed under a wrong signature guess and then unwound
+          (``discarded_roots``) must never appear inside ANY honest
+          replica's committed value. A leak means a commit record was
+          minted from pre-rollback state — the one failure mode
+          speculation must not have.
         """
         executors = getattr(self.sim, "executors", None)
         if not executors:
             return
+        # Device executors queue applied heights on-device; materialize
+        # every pending root before auditing (host sync is a no-op).
+        for ex in {id(e): e for e in executors}.values():
+            ex.sync()
         by_height: dict[int, dict[bytes, list[int]]] = {}
         for i, ex in enumerate(executors):
             if i not in self.honest:
@@ -362,6 +373,23 @@ class InvariantMonitor:
                         f"not end with its own state root "
                         f"{root[:8].hex()}",
                     )
+        discarded: set[bytes] = set()
+        for i, ex in enumerate(executors):
+            if i in self.honest:
+                discarded |= getattr(ex, "discarded_roots", set())
+        if discarded:
+            for i in sorted(self.honest):
+                for height, value in self.sim.commits[i].items():
+                    for root in discarded:
+                        if root in value:
+                            raise InvariantViolation(
+                                "exec-rollback",
+                                f"rolled-back root {root[:8].hex()} "
+                                f"appears in replica {i}'s committed "
+                                f"value at height {height} — a commit "
+                                "was minted from speculative state that "
+                                "the pipeline later unwound",
+                            )
 
     @staticmethod
     def check_tenant_fairness(policy) -> None:

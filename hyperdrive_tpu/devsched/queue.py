@@ -39,11 +39,16 @@ class SpeculationMismatch(AssertionError):
     Speculation accepts exactly the parseable-and-signed rows; an
     honest network's signatures all verify, so a mismatch means a
     forged-but-well-formed signature was speculatively dispatched.
-    The pipeline fails LOUDLY (no rollback machinery): safety was
-    never at risk — the mismatch is detected before commit
-    finalization, which gates on this resolution — but the run is
-    aborted rather than silently diverging from the sequential
-    trajectory.
+    What happens next depends on the layer. The SETTLE pipeline
+    (harness/sim.py ``_settle_speculative``) fails LOUDLY with this
+    exception: safety was never at risk — the mismatch is detected
+    before commit finalization, which gates on this resolution — but
+    the run aborts rather than silently diverging from the sequential
+    trajectory. The EXECUTION pipeline (exec/ledger.py ``speculate``/
+    ``resolve``) instead rolls the speculative apply back
+    bit-identically and re-applies under the true mask — rollback
+    machinery exists there because a ledger state, unlike a vote
+    verdict, can be unwound from a snapshot.
     """
 
 

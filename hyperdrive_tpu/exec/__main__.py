@@ -3,7 +3,7 @@
 Usage::
 
     python -m hyperdrive_tpu.exec parity [--blocks H] [--accounts A]
-        [--txs T] [--seed S]
+        [--txs T] [--seed S] [--pipelined]
 
 Runs the SAME deterministic block workload through
 :class:`~hyperdrive_tpu.exec.ledger.HostLedgerExecutor` (pure-Python
@@ -18,6 +18,13 @@ EVERY height — three legs:
   3. an insolvency-heavy leg (tiny balances) hammering the
      block-atomic sender-solvency rule where vectorized and serial
      semantics would first diverge if they could.
+
+``--pipelined`` adds a fourth leg exercising the speculative pipeline
+end to end: every leg's config is replayed through speculate/resolve —
+including a forced wrong-guess rollback per window — and the resulting
+root chain must be byte-equal to the sequential ``advance_to`` chain,
+with ``host_verify`` re-deriving the final fold from fetched state on
+both executors (the state-root checkpoint doctrine, ROBUSTNESS.md).
 
 Exit 1 on any root mismatch. Shapes are tiny; with the checkout's
 ``.jax_cache`` warmed the run is seconds. HD_SANITIZE=1 in the CI
@@ -69,6 +76,75 @@ def _leg(name: str, cfg, genesis_stakes, blocks: int) -> int:
     return 0
 
 
+def _pipelined_leg(name: str, cfg, genesis_stakes, blocks: int) -> int:
+    """Speculative-pipeline parity: each executor class speculates a
+    window per height — first with a deliberately WRONG guess (forcing
+    a rollback) where the block has rows to mis-admit, then resolves
+    with the true mask — and the settled chain must equal the
+    sequential reference chain byte for byte."""
+    from hyperdrive_tpu.exec.device import DeviceLedgerExecutor
+    from hyperdrive_tpu.exec.ledger import BlockSource, HostLedgerExecutor
+
+    src = BlockSource(cfg)
+    ref = HostLedgerExecutor(cfg, genesis_stakes, source=src)
+    seq = [ref.advance_to(h) for h in range(1, blocks + 1)]
+
+    if cfg.sign_txs:
+        from hyperdrive_tpu.verifier import HostVerifier
+
+        v = HostVerifier()
+
+        def true_mask(h):
+            items = src.sig_items(src.block(h))
+            return [bool(b) for b in v.verify_signatures(items)]
+    else:
+        def true_mask(h):
+            return [True] * cfg.txs_per_block
+
+    rollbacks = 0
+    for cls in (HostLedgerExecutor, DeviceLedgerExecutor):
+        ex = cls(cfg, genesis_stakes, source=src)
+        for h in range(1, blocks + 1):
+            m = true_mask(h)
+            guess = list(m)
+            if h % 2 and any(guess):
+                # Force a mismatch: flip one admitted lane.
+                guess[guess.index(True)] = False
+            ex.speculate(h, guess)
+            if not ex.resolve(h, m):
+                rollbacks += 1
+        got = [ex.advance_to(h) for h in range(1, blocks + 1)]
+        if got != seq:
+            bad = next(h for h in range(blocks) if got[h] != seq[h])
+            print(
+                f"FAIL {name}: pipelined root diverges from sequential "
+                f"at height {bad + 1} ({cls.__name__})",
+                file=sys.stderr,
+            )
+            return 1
+        if ex.applied_total != ref.applied_total:
+            print(
+                f"FAIL {name}: pipelined applied count "
+                f"{ex.applied_total} != {ref.applied_total} "
+                f"({cls.__name__})",
+                file=sys.stderr,
+            )
+            return 1
+        if ex.discarded_roots & set(seq):
+            print(
+                f"FAIL {name}: a rolled-back root equals a committed "
+                f"root ({cls.__name__})",
+                file=sys.stderr,
+            )
+            return 1
+        ex.host_verify()
+    print(
+        f"ok {name}: {blocks} blocks pipelined == sequential, "
+        f"{rollbacks} forced rollbacks, checkpoints verified"
+    )
+    return 0
+
+
 def parity(args) -> int:
     from hyperdrive_tpu.exec import ExecutionConfig
 
@@ -113,6 +189,33 @@ def parity(args) -> int:
         (1, 0, 2, 0),
         args.blocks,
     )
+    if getattr(args, "pipelined", False):
+        rc |= _pipelined_leg(
+            "exec-pipelined",
+            ExecutionConfig(
+                accounts=args.accounts,
+                txs_per_block=args.txs,
+                stake_every=3,
+                stake_accounts=min(4, args.accounts),
+                seed=args.seed,
+            ),
+            (5, 9, 2, 7),
+            args.blocks,
+        )
+        rc |= _pipelined_leg(
+            "exec-pipelined-signed",
+            ExecutionConfig(
+                accounts=min(args.accounts, 16),
+                txs_per_block=min(args.txs, 24),
+                stake_every=4,
+                stake_accounts=4,
+                seed=args.seed + 1,
+                sign_txs=True,
+                bad_sig_every=8,
+            ),
+            (3, 3, 3, 3),
+            min(args.blocks, 3),
+        )
     return rc
 
 
@@ -128,6 +231,12 @@ def main(argv=None) -> int:
     p.add_argument("--accounts", type=int, default=32)
     p.add_argument("--txs", type=int, default=48)
     p.add_argument("--seed", type=int, default=7)
+    p.add_argument(
+        "--pipelined", action="store_true",
+        help="add the speculative-pipeline legs: forced-rollback "
+        "speculate/resolve chains must equal the sequential chains, "
+        "host_verify checkpoints included",
+    )
     p.set_defaults(fn=parity)
 
     args = ap.parse_args(argv)

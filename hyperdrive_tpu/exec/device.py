@@ -1,11 +1,20 @@
-"""The device executor: ledger state as int32 tensors, block apply as
-one jitted segment-sum/scatter-add launch (ops/ledger.py).
+"""The device executor: ledger state as int32 tensors, block apply +
+state digest + root fold as ONE jitted launch (ops/ledger.py
+``apply_block_chain_jax``).
 
 Digest-identical to :class:`~hyperdrive_tpu.exec.ledger
-.HostLedgerExecutor` by construction — the root chain hashes the same
-8-byte little-endian packing of the same int32 state — and enforced by
-``python -m hyperdrive_tpu.exec parity`` (CI: exec-parity smoke on
-forced CPU devices, HD_SANITIZE=1).
+.HostLedgerExecutor` by construction — the device chain fold is the
+bit-exact jnp twin of the numpy reduction in ops/rootmix.py — and
+enforced by ``python -m hyperdrive_tpu.exec parity`` (CI: exec-parity
+smoke on forced CPU devices, HD_SANITIZE=1, including the
+``--pipelined`` leg).
+
+Between heights NOTHING leaves the device: the running root rides as a
+uint32[8] tensor and per-height applied counts as int32 scalars, queued
+on ``_pending`` and materialized in one stacked fetch per pipeline
+window (:meth:`sync` — called by ``advance_to`` before a root is read,
+and by rollback before counters unwind). Speculation snapshots are
+immutable array refs, so snapshotting a height costs nothing.
 """
 
 from __future__ import annotations
@@ -15,22 +24,31 @@ import numpy as np
 import jax.numpy as jnp
 
 from hyperdrive_tpu.exec.ledger import HostLedgerExecutor, TxBlock
+from hyperdrive_tpu.obs.recorder import NULL_BOUND
 from hyperdrive_tpu.ops import ledger as ops_ledger
+from hyperdrive_tpu.ops.rootmix import mix_matrix, root_bytes
 
 __all__ = ["DeviceLedgerExecutor"]
 
 
 class DeviceLedgerExecutor(HostLedgerExecutor):
-    """Ledger state lives on device between blocks; each applied block
-    is one padded kernel call (pad rows inert), and only the root hash
-    pulls the state back to host — the per-block transfer both
-    executors pay, since the root is a host hash either way."""
+    """Ledger state lives on device between blocks; each height is one
+    fused padded kernel call (apply + digest + chain fold, pad rows
+    inert) whose outputs — new state, new root, applied count — stay on
+    device until :meth:`sync`."""
 
     device = True
 
     def _init_state(self, balances, stakes):
         self._dbal = jnp.asarray(np.asarray(balances, dtype=np.int32))
         self._dstk = jnp.asarray(np.asarray(stakes, dtype=np.int32))
+        #: Device-resident running root (uint32[8]); created lazily at
+        #: the first apply (genesis root is a host sha256).
+        self._droot = None
+        #: Heights applied but not yet materialized host-side:
+        #: (height, root_words_tensor, applied_count_scalar).
+        self._pending: list = []
+        self._dmix = None
 
     def _state_bytes(self) -> bytes:
         bal = np.asarray(self._dbal, dtype=np.int64)
@@ -41,37 +59,84 @@ class DeviceLedgerExecutor(HostLedgerExecutor):
 
     @staticmethod
     def _device_cols(blk: TxBlock):
-        # Padded device tensors, cached ON the block: the list->tensor
-        # conversion is block materialization (shared by every replica
-        # via the shared source, freed with the block by the source's
-        # LRU), so the per-apply cost is the kernel launch itself. The
-        # cached mask is the no-signature mask (real rows True, pad
-        # rows inert False); signed runs overwrite it per call.
+        # The block as ONE packed [5, bucket] int32 device tensor
+        # (kind/sender/recipient/amount/sig_ok rows), cached ON the
+        # block: the pack+transfer is block materialization (shared by
+        # every replica via the shared source, freed with the block by
+        # the source's LRU — speculation-epoch entries pinned so
+        # rollback replays hit this cache), so the per-apply cost is
+        # the kernel launch itself. One contiguous transfer instead of
+        # five: device_put dispatch is a fixed per-buffer cost that was
+        # a visible slice of the per-height bill. The cached sig_ok row
+        # is the no-signature mask (real rows 1, pad rows inert 0);
+        # signed runs repack per call.
         cols = blk._cols
         if cols is None:
-            k, s, r, a, m = ops_ledger.pad_block(
-                blk.kind, blk.sender, blk.recipient, blk.amount,
-                [True] * len(blk),
-            )
-            cols = blk._cols = (
-                jnp.asarray(k), jnp.asarray(s), jnp.asarray(r),
-                jnp.asarray(a), jnp.asarray(m),
+            cols = blk._cols = jnp.asarray(
+                ops_ledger.pack_block_cols(*blk._np)
             )
         return cols
 
-    def _apply_block(self, blk: TxBlock, ok) -> int:
-        n = len(blk)
-        k, s, r, a, m = self._device_cols(blk)
+    def _apply_chain(self, h: int, blk: TxBlock, ok):
         if ok is not None:
-            padded = np.zeros(len(m), dtype=bool)
-            padded[:n] = ok
-            m = jnp.asarray(padded)
-        self._dbal, self._dstk, applied = ops_ledger._jitted()(
-            self._dbal, self._dstk, k, s, r, a, m
+            cols = jnp.asarray(
+                ops_ledger.pack_block_cols(*blk._np, sig_ok=ok)
+            )
+        else:
+            cols = self._device_cols(blk)
+        if self._droot is None:
+            self._droot = jnp.asarray(self._root_words)
+        if self._dmix is None:
+            self._dmix = jnp.asarray(mix_matrix(4 * self.config.accounts))
+        self._dbal, self._dstk, count, self._droot = (
+            ops_ledger._jitted_chain_cols()(
+                self._dbal, self._dstk, self._droot,
+                jnp.uint32(h & 0xFFFFFFFF), cols, self._dmix,
+            )
         )
-        # Pad rows are inert (mask False), so the full-width sum is the
-        # true applied count.
-        return int(np.asarray(applied).sum())
+        self._pending.append((h, self._droot, count))
+        return None  # counters/roots materialize at sync()
+
+    # ---- speculation hooks: snapshots are array refs (free)
+
+    def _snapshot(self):
+        if self._droot is None:
+            self._droot = jnp.asarray(self._root_words)
+        return (self._dbal, self._dstk, self._droot)
+
+    def _restore(self, snap) -> None:
+        self._dbal, self._dstk, self._droot = snap
+
+    def sync(self) -> None:
+        """One fetch materializes every pending height's root and
+        applied count host-side — the only inter-height host hop the
+        device path pays, once per pipeline window. ``device_get`` on
+        the pytree copies leaves without staging an XLA program (a
+        ``jnp.stack`` here would compile once per distinct window
+        depth, which on a cold cache costs more than the window)."""
+        if not self._pending:
+            return
+        import jax
+
+        fetched = jax.device_get([(p[1], p[2]) for p in self._pending])
+        t = self.config.txs_per_block
+        for (h, _, _), (rw, c) in zip(self._pending, fetched):
+            rb = root_bytes(rw)
+            self.roots[h] = rb
+            c = int(c)
+            self.applied_total += c
+            self.rejected_total += t - c
+            if h in self._spec:
+                self._applied_at[h] = c
+            if self.obs is not NULL_BOUND:
+                self.obs.emit(
+                    "exec.apply", h, -1,
+                    "txs=%d applied=%d dev=1" % (t, c),
+                )
+                self.obs.emit("exec.root", h, -1, rb[:8].hex())
+        self._pending.clear()
+        self._root_words = np.asarray(fetched[-1][0], dtype=np.uint32)
+        self.root = root_bytes(self._root_words)
 
     # Host views for election_stakes / debugging: materialize on read.
     @property

@@ -9,13 +9,29 @@ ints alone (ScenarioRecord v7 stores no state).
 
 State roots chain like the commit chain itself:
 
-  root_0 = H("exec-genesis" || pack(balances) || pack(stakes))
-  root_h = H("exec-root" || root_{h-1} || state_digest_h)
+  root_0   = sha256("exec-genesis" || pack(balances) || pack(stakes))
+  root_h   = fold(root_{h-1}, h, digest(state_h))      (8 uint32 words)
 
-with ``pack`` fixed as 8-byte little-endian signed per account, so the
-host executor (Python ints) and the device executor (int32 tensors)
-hash identical bytes — the differential-parity contract the
-``python -m hyperdrive_tpu.exec parity`` smoke enforces.
+where ``digest`` is the fixed-shape uint32 reduction over the packed
+state leaves and ``fold`` the per-height chain mix — both defined ONCE
+in ops/ledger.py with bit-identical numpy (host) and jnp (device)
+twins, so the device executor keeps the running root ON DEVICE between
+heights (no per-block host hash hop) and still chains byte-equal to
+the host reference. ``pack`` stays 8-byte little-endian signed per
+account (the word split mirrors it lo/hi), the root stays 32 bytes,
+and the genesis root stays sha256. The reduction is linear-algebraic,
+not cryptographic: integrity of the running chain is re-derived
+host-side at checkpoints (``host_verify``) and in the parity CLIs —
+see ROBUSTNESS.md "State-root doctrine".
+
+Speculative pipelining (PR 16): ``speculate(h, guess)`` applies height
+``h`` under a guessed admission mask while the real verification is
+still in flight; ``resolve(h, true_mask)`` either confirms the height
+or ROLLS BACK — restoring state to the pre-speculation snapshot
+bit-identically, recording every discarded root (the chaos monitor's
+no-leak invariant reads ``discarded_roots``), and re-applying under
+the true mask. A rolled-back root can therefore never appear in a
+committed value: commits only read roots after resolution.
 
 Apply semantics are ORDER-INDEPENDENT and block-atomic per sender: a
 sender whose summed asks (balance asks for TRANSFER/STAKE, stake asks
@@ -28,11 +44,18 @@ equal to any serial schedule of the same block.
 from __future__ import annotations
 
 import hashlib
-import random
+
+import numpy as np
 
 from hyperdrive_tpu.devsched.queue import VerifyLauncher
 from hyperdrive_tpu.exec import ExecutionConfig
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
+from hyperdrive_tpu.ops.rootmix import (
+    fold_root_np,
+    root_bytes,
+    root_words,
+    state_digest_np,
+)
 
 __all__ = [
     "KIND_TRANSFER",
@@ -53,6 +76,10 @@ KIND_UNSTAKE = 2
 
 _INT32_MAX = 2**31 - 1
 
+#: "no mask supplied" sentinel for ``_step`` (None is a real mask value:
+#: the unsigned everything-admitted semantics).
+_UNSET = object()
+
 
 def pack_state(values) -> bytes:
     """Account vector -> bytes, 8-byte little-endian signed per entry.
@@ -61,101 +88,187 @@ def pack_state(values) -> bytes:
 
 
 class TxBlock:
-    """One height's transactions as dense columns (the device layout is
-    the native layout; the host executor just walks the columns)."""
+    """One height's transactions as dense columns. The NUMPY arrays are
+    the native layout (the device executor pads them straight into
+    tensors); the Python-list views the host executor walks are
+    materialized lazily on first access, so a device-executor run never
+    pays the array->list conversion at all."""
 
     __slots__ = (
-        "height", "kind", "sender", "recipient", "amount", "digest",
-        "_sig_items", "_cols",
+        "height", "digest", "_np", "_py", "_sig_items", "_cols",
     )
 
     def __init__(self, height, kind, sender, recipient, amount, digest):
         self.height = height
-        self.kind = kind
-        self.sender = sender
-        self.recipient = recipient
-        self.amount = amount
         #: Content digest: what the exec proposer's value commits to.
         self.digest = digest
+        #: (kind, sender, recipient, amount) as int32 numpy columns —
+        #: the device kernel's native dtype, so padding is a copy, not
+        #: a cast (accounts and amount_cap are int32-bounded by config
+        #: validation).
+        self._np = tuple(
+            np.asarray(c, dtype=np.int32)
+            for c in (kind, sender, recipient, amount)
+        )
+        self._py = None
         self._sig_items = None
         #: Device-padded column cache (DeviceLedgerExecutor): the
-        #: list->tensor conversion is block MATERIALIZATION, shared by
+        #: array->tensor conversion is block MATERIALIZATION, shared by
         #: every replica on the source like the columns themselves, and
         #: evicted with the block by the source's LRU.
         self._cols = None
 
+    def _lists(self):
+        py = self._py
+        if py is None:
+            py = self._py = tuple(c.tolist() for c in self._np)
+        return py
+
+    @property
+    def kind(self):
+        return self._lists()[0]
+
+    @property
+    def sender(self):
+        return self._lists()[1]
+
+    @property
+    def recipient(self):
+        return self._lists()[2]
+
+    @property
+    def amount(self):
+        return self._lists()[3]
+
     def __len__(self) -> int:
-        return len(self.kind)
+        return len(self._np[0])
+
+
+#: STAKE-vs-UNSTAKE split point on the stake lane: a uint32 draw below
+#: this threshold (~0.6 * 2^32) stakes, above it unstakes — biased
+#: toward STAKE so validator weights drift and elections have
+#: something to read.
+_STAKE_BIAS = int(0.6 * 2**32)
 
 
 class BlockSource:
     """Deterministic per-height workload, shared by every replica.
 
-    ``block(h)`` derives height h's transactions from a seeded RNG
-    keyed on ``(config.seed, h)``; every ``stake_every``-th tx is a
+    ``block(h)`` derives height h's transactions from a keyed
+    ``shake_256`` stream expanded into dense numpy columns in one pass
+    (the per-tx Python RNG loop this replaced was ~87% of pipelined
+    e2e wall time at 16k-tx blocks); every ``stake_every``-th tx is a
     STAKE/UNSTAKE on a validator stake account (``stake_accounts``
     wide, biased toward STAKE so validator weights drift and epoch
     elections have something to read). ``value(h)`` is the 32-byte
     proposal value committing to the block. With ``sign_txs`` each tx
     carries a real Ed25519 signature from its sender's deterministic
     account key; ``bad_sig_every`` corrupts every K-th one.
+
+    ``spec_epoch`` tags cache entries with the open speculation window
+    (the sim bumps it when a window closes): entries touched in the
+    CURRENT epoch are pinned against LRU eviction, so a rollback that
+    replays a window height hits the cached block — padded device
+    columns included — instead of re-materializing it. ``hits`` /
+    ``misses`` / ``evictions`` count the cache's behavior for tests
+    and the obs report.
     """
 
     #: Blocks cached per source; sim runs walk heights forward and
     #: bench blocks are large, so a short LRU covers re-reads (the n
     #: replicas' executors share one source) without pinning 64k-tx
-    #: columns for every committed height.
+    #: columns for every committed height. Entries of the open
+    #: speculation epoch are pinned (rollback replays them), so the
+    #: cache can transiently exceed this by the window depth.
     CACHE = 8
 
     def __init__(self, config: ExecutionConfig):
         self.config = config
-        self._cache: dict[int, TxBlock] = {}
+        #: height -> [spec_epoch_last_touched, TxBlock]
+        self._cache: dict[int, list] = {}
         self._values: dict[int, bytes] = {}
         self._ring = None
+        self.spec_epoch = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
 
-    def block(self, height: int) -> TxBlock:
-        blk = self._cache.get(height)
-        if blk is not None:
-            return blk
+    def _content_digest(self, height: int) -> bytes:
+        """The block's content digest WITHOUT materializing the block:
+        the columns are a pure function of ``(key, config shape)``, so
+        the digest binds the derivation key plus every config field
+        that shapes content — identical commitment, none of the
+        hash-over-columns cost (which proposal values for not-yet-
+        executed heights would otherwise pay in full)."""
         cfg = self.config
         key = hashlib.sha256(
             b"exec-block-%d-%d" % (cfg.seed, height)
         ).digest()
-        rnd = random.Random(int.from_bytes(key[:8], "little"))
-        kind, sender, recipient, amount = [], [], [], []
+        return hashlib.sha256(
+            b"exec-txs" + key
+            + b"%d-%d-%d-%d-%d" % (
+                cfg.accounts, cfg.txs_per_block, cfg.stake_every,
+                cfg.stake_accounts, cfg.amount_cap,
+            )
+        ).digest()
+
+    def block(self, height: int) -> TxBlock:
+        ent = self._cache.get(height)
+        if ent is not None:
+            ent[0] = self.spec_epoch
+            self.hits += 1
+            return ent[1]
+        self.misses += 1
+        cfg = self.config
+        key = hashlib.sha256(
+            b"exec-block-%d-%d" % (cfg.seed, height)
+        ).digest()
+        t = cfg.txs_per_block
+        w = np.frombuffer(
+            hashlib.shake_256(b"exec-cols" + key).digest(16 * t),
+            dtype="<u4",
+        ).reshape(t, 4)
         stake_lane = cfg.stake_every > 0 and cfg.stake_accounts > 0
-        for t in range(cfg.txs_per_block):
-            if stake_lane and t % cfg.stake_every == 0:
-                s = rnd.randrange(cfg.stake_accounts)
-                kind.append(
-                    KIND_STAKE if rnd.random() < 0.6 else KIND_UNSTAKE
-                )
-                sender.append(s)
-                recipient.append(s)
-            else:
-                kind.append(KIND_TRANSFER)
-                sender.append(rnd.randrange(cfg.accounts))
-                recipient.append(rnd.randrange(cfg.accounts))
-            amount.append(rnd.randint(1, cfg.amount_cap))
-        h = hashlib.sha256()
-        h.update(b"exec-txs")
-        h.update(key)
-        for col in (kind, sender, recipient, amount):
-            h.update(b"".join(v.to_bytes(4, "little") for v in col))
-        blk = TxBlock(height, kind, sender, recipient, amount, h.digest())
-        while len(self._cache) >= self.CACHE:
-            self._cache.pop(next(iter(self._cache)))
-        self._cache[height] = blk
+        kind = np.zeros(t, dtype=np.int64)
+        sender = w[:, 1].astype(np.int64) % cfg.accounts
+        recipient = w[:, 2].astype(np.int64) % cfg.accounts
+        if stake_lane:
+            lane = np.zeros(t, dtype=bool)
+            lane[:: cfg.stake_every] = True
+            kind[lane] = np.where(
+                w[lane, 0] < np.uint32(_STAKE_BIAS),
+                KIND_STAKE, KIND_UNSTAKE,
+            )
+            sender[lane] = w[lane, 1].astype(np.int64) % cfg.stake_accounts
+            recipient[lane] = sender[lane]
+        amount = 1 + w[:, 3].astype(np.int64) % cfg.amount_cap
+        blk = TxBlock(
+            height, kind, sender, recipient, amount,
+            self._content_digest(height),
+        )
+        if len(self._cache) >= self.CACHE:
+            # Evict oldest-first, but never an entry of the OPEN
+            # speculation epoch — a rollback may replay it.
+            for k in list(self._cache):
+                if self._cache[k][0] != self.spec_epoch:
+                    del self._cache[k]
+                    self.evictions += 1
+                    if len(self._cache) < self.CACHE:
+                        break
+        self._cache[height] = [self.spec_epoch, blk]
         return blk
 
     def value(self, height: int) -> bytes:
         """The proposal value for ``height`` — commits to the block
-        content (round-independent: retries re-propose the same
-        block)."""
+        content via its content digest (round-independent: retries
+        re-propose the same block). Derived WITHOUT materializing the
+        block: a pipelined proposer asks for values heights ahead of
+        execution, and at 64k-tx blocks each materialization is
+        milliseconds of column synthesis the value never needed."""
         v = self._values.get(height)
         if v is None:
             v = hashlib.sha256(
-                b"exec-value" + self.block(height).digest
+                b"exec-value" + self._content_digest(height)
             ).digest()
             while len(self._values) >= 4096:
                 self._values.pop(next(iter(self._values)))
@@ -180,9 +293,10 @@ class BlockSource:
         cfg = self.config
         ring = self.keyring()
         bad = cfg.bad_sig_every
+        sender = block.sender
         items = []
         for t in range(len(block)):
-            kp = ring[block.sender[t]]
+            kp = ring[sender[t]]
             digest = hashlib.sha256(
                 b"exec-tx" + block.digest
                 + t.to_bytes(4, "little")
@@ -241,6 +355,10 @@ class HostLedgerExecutor:
             b"exec-genesis" + self._state_bytes()
         ).digest()
         self.root = self.genesis_root
+        #: The running root as uint32 words — the chain-fold input form
+        #: (``root`` is its byte rendering; the device executor keeps
+        #: the live copy on device and mirrors here at sync).
+        self._root_words = root_words(self.genesis_root)
         #: height -> chained root, for every applied height.
         self.roots: dict[int, bytes] = {}
         self.applied_total = 0
@@ -251,6 +369,19 @@ class HostLedgerExecutor:
         # Cumulative int32 headroom: every block can move at most
         # txs_per_block * amount_cap units into one account.
         self._flow = cfg.initial_balance
+        #: Open speculative heights: height -> [guess_mask, snapshot].
+        #: Insertion order is height order (speculation only stacks).
+        self._spec: dict[int, list] = {}
+        #: Per-height applied counts for the OPEN window only, so a
+        #: rollback can unwind the counters exactly.
+        self._applied_at: dict[int, int] = {}
+        #: Every root a rollback ever discarded — the chaos monitor's
+        #: no-leak invariant asserts none appears in a committed value.
+        self.discarded_roots: set[bytes] = set()
+        self.spec_confirmed = 0
+        self.spec_rolled_back = 0
+        #: Deepest single rollback (heights unwound in one mismatch).
+        self.spec_rollback_depth = 0
 
     # ---- state representation (overridden by the device executor)
 
@@ -263,13 +394,14 @@ class HostLedgerExecutor:
 
     def _apply_block(self, blk: TxBlock, ok) -> int:
         bal, stk = self.balances, self.stakes
+        kind, sender, recipient, amount = blk._lists()
         out_bal: dict[int, int] = {}
         out_stk: dict[int, int] = {}
-        for t in range(len(blk)):
+        for t in range(len(kind)):
             if ok is not None and not ok[t]:
                 continue
-            s, a = blk.sender[t], blk.amount[t]
-            if blk.kind[t] == KIND_UNSTAKE:
+            s, a = sender[t], amount[t]
+            if kind[t] == KIND_UNSTAKE:
                 out_stk[s] = out_stk.get(s, 0) + a
             else:
                 out_bal[s] = out_bal.get(s, 0) + a
@@ -281,16 +413,16 @@ class HostLedgerExecutor:
             for s in set(out_bal) | set(out_stk)
         }
         applied = 0
-        for t in range(len(blk)):
+        for t in range(len(kind)):
             if ok is not None and not ok[t]:
                 continue
-            s = blk.sender[t]
+            s = sender[t]
             if not sender_ok.get(s, True):
                 continue
-            k, a = blk.kind[t], blk.amount[t]
+            k, a = kind[t], amount[t]
             if k == KIND_TRANSFER:
                 bal[s] -= a
-                bal[blk.recipient[t]] += a
+                bal[recipient[t]] += a
             elif k == KIND_STAKE:
                 bal[s] -= a
                 stk[s] += a
@@ -300,17 +432,60 @@ class HostLedgerExecutor:
             applied += 1
         return applied
 
+    # ---- speculation hooks (overridden by the device executor)
+
+    def _snapshot(self):
+        """Pre-height state capture for rollback. Host: list copies.
+        Device: immutable array refs (free)."""
+        return (list(self.balances), list(self.stakes),
+                self.root, self._root_words)
+
+    def _restore(self, snap) -> None:
+        self.balances = list(snap[0])
+        self.stakes = list(snap[1])
+        self.root = snap[2]
+        self._root_words = snap[3]
+
+    def sync(self) -> None:
+        """Materialize any device-pending roots/counters host-side.
+        No-op on the host executor."""
+
+    def _apply_chain(self, h: int, blk: TxBlock, ok):
+        """Apply one block AND fold the new state into the running
+        root. Returns the applied count, or None when the count is
+        device-pending (materialized at :meth:`sync`)."""
+        applied = self._apply_block(blk, ok)
+        d = state_digest_np(self.balances, self.stakes)
+        self._root_words = fold_root_np(self._root_words, h, d)
+        self.root = root_bytes(self._root_words)
+        self.roots[h] = self.root
+        return applied
+
     # ---- the public surface
 
     def advance_to(self, height: int) -> bytes:
-        """Root at ``height``, applying any missing blocks up to it."""
+        """Root at ``height``, applying any missing blocks up to it.
+
+        Crosses an open speculation window only if every window height
+        up to ``height`` is exact (unsigned guess): those are confirmed
+        in passing, while a still-guessed height raises — commits must
+        resolve speculation before they can read its root."""
+        if self._spec and height >= min(self._spec):
+            self.confirm_to(height)
         if height <= self.height:
-            return self.roots[height] if height > 0 else self.genesis_root
+            if height == 0:
+                return self.genesis_root
+            r = self.roots.get(height)
+            if r is None:
+                self.sync()
+                r = self.roots[height]
+            return r
         for h in range(self.height + 1, height + 1):
             self._step(h)
+        self.sync()
         return self.root
 
-    def _step(self, h: int) -> None:
+    def _step(self, h: int, ok=_UNSET) -> None:
         cfg = self.config
         self._flow += cfg.txs_per_block * cfg.amount_cap
         if self._flow > _INT32_MAX:
@@ -319,16 +494,16 @@ class HostLedgerExecutor:
                 "amount_cap/initial_balance or widen the kernel"
             )
         blk = self.source.block(h)
-        ok = self._mask_for(h, blk)
-        applied = self._apply_block(blk, ok)
+        if ok is _UNSET:
+            ok = self._mask_for(h, blk)
+        applied = self._apply_chain(h, blk, ok)
+        self.height = h
+        if applied is None:
+            return
         self.applied_total += applied
         self.rejected_total += len(blk) - applied
-        self.height = h
-        d = hashlib.sha256(
-            b"exec-state" + h.to_bytes(8, "little") + self._state_bytes()
-        ).digest()
-        self.root = hashlib.sha256(b"exec-root" + self.root + d).digest()
-        self.roots[h] = self.root
+        if h in self._spec:
+            self._applied_at[h] = applied
         if self.obs is not NULL_BOUND:
             self.obs.emit(
                 "exec.apply", h, -1,
@@ -336,6 +511,135 @@ class HostLedgerExecutor:
                 % (len(blk), applied, int(self.device)),
             )
             self.obs.emit("exec.root", h, -1, self.root[:8].hex())
+
+    # ---- speculative pipelining
+
+    def speculate(self, height: int, guess=None) -> None:
+        """Apply ``height`` NOW under a guessed admission mask (None =
+        exact: every tx admitted, the unsigned semantics), snapshotting
+        the pre-height state so :meth:`resolve` can roll back on a
+        mismatch. Speculation stacks strictly upward."""
+        if height != self.height + 1:
+            raise ValueError(
+                f"speculate({height}) out of order at height {self.height}"
+            )
+        snap = self._snapshot()
+        self._spec[height] = [guess, snap]
+        self._step(height, ok=guess)
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "exec.spec.speculate", height, -1,
+                "signed=%d" % int(guess is not None),
+            )
+
+    def resolve(self, height: int, true_mask) -> bool:
+        """Settle the LOWEST open speculation against the verified
+        mask: confirm if the guess was right, otherwise roll back and
+        re-apply (the later window heights re-speculate under their
+        original guesses). Returns True on confirm."""
+        ent = self._spec.get(height)
+        if ent is None:
+            raise KeyError(f"height {height} is not speculative")
+        if height != min(self._spec):
+            raise RuntimeError(
+                f"resolve({height}) below open speculation at "
+                f"{min(self._spec)}"
+            )
+        guess = ent[0]
+        if guess is None or list(guess) == list(true_mask):
+            self._confirm(height)
+            return True
+        self._rollback(height, true_mask)
+        return False
+
+    def confirm_to(self, height: int) -> None:
+        """Confirm every exact (unsigned-guess) speculation up to
+        ``height``; a still-guessed height in range raises."""
+        for h in sorted(self._spec):
+            if h > height:
+                break
+            if self._spec[h][0] is not None:
+                raise RuntimeError(
+                    f"confirm_to({height}): height {h} still awaits "
+                    "signature verification"
+                )
+            self._confirm(h)
+
+    def _confirm(self, height: int) -> None:
+        self._spec.pop(height)
+        self._applied_at.pop(height, None)
+        self.spec_confirmed += 1
+        if self.obs is not NULL_BOUND:
+            self.obs.emit("exec.spec.confirm", height, -1, "")
+
+    def _rollback(self, height: int, true_mask) -> None:
+        """The mismatch path: unwind state, root, and counters to the
+        pre-``height`` snapshot bit-identically, record every discarded
+        root, re-apply ``height`` under the TRUE mask (final), then
+        re-speculate the rest of the window. A discarded root can never
+        reach a committed value: commits only read roots through
+        :meth:`advance_to`/:meth:`resolve`, both of which refuse
+        unresolved guesses."""
+        cfg = self.config
+        self.sync()
+        top = self.height
+        depth = top - height + 1
+        later = [
+            (h, self._spec[h][0]) for h in sorted(self._spec) if h > height
+        ]
+        snap = self._spec.pop(height)[1]
+        popped = []
+        for h in range(height, top + 1):
+            rb = self.roots.pop(h, None)
+            if rb is not None:
+                popped.append((h, rb))
+            a = self._applied_at.pop(h, None)
+            if a is not None:
+                self.applied_total -= a
+                self.rejected_total -= cfg.txs_per_block - a
+            self._flow -= cfg.txs_per_block * cfg.amount_cap
+            self._spec.pop(h, None)
+        self._restore(snap)
+        self.height = height - 1
+        self.spec_rolled_back += 1
+        self.spec_rollback_depth = max(self.spec_rollback_depth, depth)
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "exec.spec.rollback", height, -1, "depth=%d" % depth
+            )
+        self._step(height, ok=[bool(v) for v in true_mask])
+        for h, g in later:
+            self.speculate(h, g)
+        # A guessed mask can differ from the true one yet settle to the
+        # IDENTICAL state (the mis-admitted lane died to block-atomic
+        # solvency either way): only a root the re-settled chain
+        # actually replaced counts as discarded — those are the bytes
+        # the no-leak invariant bans from every committed value.
+        self.sync()
+        for h, rb in popped:
+            if self.roots.get(h) != rb:
+                self.discarded_roots.add(rb)
+
+    def host_verify(self) -> bytes:
+        """Checkpoint re-derivation (ROBUSTNESS.md state-root
+        doctrine): fetch the live state host-side, recompute the last
+        chain fold with the numpy twin, and require it to equal the
+        running root. Raises on mismatch; returns the verified root."""
+        self.sync()
+        if self.height == 0:
+            want = self.genesis_root
+        else:
+            prev = (
+                self.roots[self.height - 1]
+                if self.height > 1 else self.genesis_root
+            )
+            d = state_digest_np(self.balances, self.stakes)
+            want = root_bytes(fold_root_np(root_words(prev), self.height, d))
+        if want != self.root:
+            raise AssertionError(
+                f"state-root checkpoint mismatch at height {self.height}"
+            )
+        return self.root
 
     def _mask_for(self, h: int, blk: TxBlock):
         if not self.config.sign_txs:

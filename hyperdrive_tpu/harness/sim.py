@@ -493,6 +493,9 @@ class Simulation:
         load=None,
         overlay=None,
         execution=None,
+        exec_speculate: Optional[bool] = None,
+        fused_exec_drain: Optional[bool] = None,
+        dedup_exec: Optional[bool] = None,
     ):
         """``sign=True`` gives every replica a deterministic Ed25519 keypair
         (identity = public key), signs every broadcast message, and installs
@@ -1194,6 +1197,12 @@ class Simulation:
         self._exec_masks: dict = {}
         self._exec_futs: dict = {}
         self._exec_launcher = None
+        #: Unique executor objects (dedup_exec aliases one across all
+        #: replicas) — the speculate/resolve fan-out target.
+        self._exec_unique: list = []
+        self._exec_spec_heights: set = set()
+        self._exec_speculate = False
+        self._exec_fused = False
         if execution is not None:
             if payload_bytes:
                 raise ValueError(
@@ -1234,8 +1243,22 @@ class Simulation:
                 from hyperdrive_tpu.exec.ledger import HostLedgerExecutor
 
                 exec_cls = HostLedgerExecutor
-            for i in range(n):
-                self.executors.append(
+            #: Executor dedup (pipelined default): executors are pure
+            #: functions of the committed height sequence, so in a run
+            #: where every replica commits every height the n per-
+            #: replica ledgers are n identical recomputations — alias
+            #: ONE executor across all replicas and a height's block is
+            #: applied once per NETWORK instead of once per replica.
+            #: Digest-neutral by the same purity (advance_to re-reads
+            #: cached roots); off by default outside pipelined runs so
+            #: the chaos monitor's cross-replica root agreement check
+            #: still compares independently-computed chains.
+            if dedup_exec is None:
+                dedup_exec = self._pipeline_heights
+            self._dedup_exec = bool(dedup_exec)
+            count = 1 if self._dedup_exec else n
+            for i in range(count):
+                self._exec_unique.append(
                     exec_cls(
                         cfg,
                         genesis_stakes,
@@ -1244,12 +1267,60 @@ class Simulation:
                         obs=self.obs.scoped(i) if observe else _OBS_NULL,
                     )
                 )
+            self.executors = (
+                self._exec_unique * n
+                if self._dedup_exec else list(self._exec_unique)
+            )
+            #: Speculative execution (PR 16 tentpole): apply height h's
+            #: block at PROPOSE time under the well-formedness guess
+            #: while the fused verify launch is in flight; the exec
+            #: future's resolution confirms or rolls back
+            #: (exec/ledger.py speculation API), and commit finalize
+            #: reads the already-settled root. Default: on exactly when
+            #: the run pipelines heights; the lock-step chaos seam opts
+            #: in explicitly (injected devsched).
+            if exec_speculate is None:
+                exec_speculate = self._pipeline_heights
+            elif exec_speculate and self._sched is None:
+                raise ValueError(
+                    "exec_speculate resolves speculation at queue "
+                    "drains — wire a devsched (pipeline_heights=True "
+                    "or devsched=)"
+                )
+            self._exec_speculate = bool(exec_speculate)
             if cfg.sign_txs and self._sched is not None:
-                from hyperdrive_tpu.exec.ledger import ExecApplyLauncher
-                from hyperdrive_tpu.verifier import HostVerifier
+                #: Fused drain (PR 16 tentpole): submit the block's tx-
+                #: signature triples through the SAME memoized launcher
+                #: that carries the vote verifies, so one drain cycle
+                #: issues ONE coalesced launch for votes + exec rows —
+                #: a height costs one launch bill, not two. The two-
+                #: kind path (ExecApplyLauncher, its own launch per
+                #: drain) remains for lock-step runs and as the
+                #: comparison baseline.
+                if fused_exec_drain is None:
+                    fused_exec_drain = self._pipeline_heights
+                self._exec_fused = bool(fused_exec_drain)
+                if self._exec_fused:
+                    bv = getattr(self, "batch_verifier", None)
+                    if bv is None:
+                        raise ValueError(
+                            "fused_exec_drain coalesces exec rows into "
+                            "the vote verify launch — requires a "
+                            "batch_verifier (burst mode)"
+                        )
+                    self._exec_launcher = self._sched.verify_launcher(bv)
+                else:
+                    from hyperdrive_tpu.exec.ledger import ExecApplyLauncher
+                    from hyperdrive_tpu.verifier import HostVerifier
 
-                self._exec_launcher = ExecApplyLauncher(
-                    getattr(self, "batch_verifier", None) or HostVerifier()
+                    self._exec_launcher = ExecApplyLauncher(
+                        getattr(self, "batch_verifier", None)
+                        or HostVerifier()
+                    )
+            elif fused_exec_drain:
+                raise ValueError(
+                    "fused_exec_drain requires sign_txs execution and "
+                    "a devsched queue"
                 )
             if self.epoch_schedule is not None:
                 from hyperdrive_tpu.exec.ledger import HostLedgerExecutor
@@ -1279,6 +1350,11 @@ class Simulation:
 
                 self.epoch_schedule.stake_source = _stake_source
             self.record.execution = cfg.as_ints()
+        elif exec_speculate or fused_exec_drain or dedup_exec:
+            raise ValueError(
+                "exec_speculate/fused_exec_drain/dedup_exec require "
+                "execution="
+            )
 
         byz_prop = byzantine_proposer or {}
         byz_val = byzantine_validator or {}
@@ -1410,22 +1486,61 @@ class Simulation:
     def _exec_value(self, height: Height, round_: int) -> Value:
         """Proposal value in execution mode: commits to the height's
         deterministic tx block. First proposal of a sign_txs height
-        also submits the block's signature triples as ONE
-        ``exec.apply`` command — the same drain that carries the vote
-        verifies resolves the admission mask into the shared
-        ``_exec_masks`` dict the executors read at commit time."""
+        also submits the block's signature triples through the device
+        queue — fused into the SAME launcher the vote verifies ride
+        (one coalesced launch per drain) or as a separate
+        ``exec.apply`` command on the two-kind path — resolving the
+        admission mask into the shared ``_exec_masks`` dict.
+
+        With ``exec_speculate`` the height is also APPLIED here, under
+        the well-formedness guess, while that launch is in flight: the
+        future's resolution confirms the guess or rolls the executor
+        back and re-applies under the true mask, so by the time the
+        covering drain finalizes the gated commit the root is already
+        settled (exec/ledger.py speculation API — a rolled-back root
+        can never reach a commit record)."""
         if (
-            self._exec_launcher is not None
+            (self._exec_launcher is not None or self._exec_speculate)
             and height not in self._exec_futs
         ):
-            blk = self._exec_source.block(height)
-            fut = self._sched.submit(
-                self._exec_launcher, self._exec_source.sig_items(blk)
-            )
-            self._exec_futs[height] = fut
-            fut.add_done_callback(
-                lambda f, h=height: self._exec_masks.setdefault(h, f._value)
-            )
+            self._exec_futs[height] = None
+            items = guess = None
+            if self._execution.sign_txs:
+                blk = self._exec_source.block(height)
+                items = self._exec_source.sig_items(blk)
+                guess = [
+                    s is not None and len(s) == 64 and len(p) == 32
+                    for (p, _, s) in items
+                ]
+            # Heights past the target are proposed (the pipeline runs
+            # ahead) but never finalized — don't burn an apply on them.
+            if self._exec_speculate and height <= self.target_height:
+                self._exec_spec_heights.add(height)
+                for ex in self._exec_unique:
+                    ex.speculate(height, guess)
+            if items is not None and self._exec_launcher is not None:
+                if self._exec_fused:
+                    # Fused rows count toward the row-aware slot close
+                    # (_settle_speculative's would_spill check): exec
+                    # rows share the vote launch's verify bucket.
+                    self._spec_rows += len(items)
+                from hyperdrive_tpu.obs.devtel import EXEC_ORIGIN
+
+                fut = self._sched.submit(
+                    self._exec_launcher, items,
+                    origin=EXEC_ORIGIN, rows=len(items),
+                )
+                self._exec_futs[height] = fut
+
+                def _resolve(f, h=height):
+                    verdicts = f.result()  # host list, settled future
+                    mask = [bool(b) for b in verdicts]
+                    self._exec_masks.setdefault(h, mask)
+                    if h in self._exec_spec_heights:
+                        for ex in self._exec_unique:
+                            ex.resolve(h, mask)
+
+                fut.add_done_callback(_resolve)
         return self._exec_source.value(height)
 
     def _exec_valid(self, height: Height, round_: int, value: Value) -> bool:
@@ -1876,6 +1991,16 @@ class Simulation:
         self._spec_inflight = 0
         self._spec_rows = 0
         self._spec_last_fut = None
+        if self._exec_speculate and self._exec_source is not None:
+            # The drain just resolved every exec speculation it
+            # covered: confirm any still-open exact windows so the
+            # gated finalizes below read settled roots, then close the
+            # speculation epoch — the block cache may evict the
+            # window's columns from here on (rollbacks can no longer
+            # replay them).
+            for ex in self._exec_unique:
+                ex.confirm_to(ex.height)
+            self._exec_source.spec_epoch += 1
         if not self._gated_commits:
             return
         gated = self._gated_commits
@@ -3089,7 +3214,12 @@ class Simulation:
         well-formed row that speculation admitted raises
         :class:`SpeculationMismatch` at drain, BEFORE any commit gated
         on it finalizes (_on_commit buffers while futures are in
-        flight) — loud failure, no rollback machinery.
+        flight) — loud failure here, because a vote verdict has no
+        snapshot to unwind to. The EXECUTION pipeline's speculative
+        apply (``exec_speculate`` -> exec/ledger.py) is the contrast:
+        ledger state DOES snapshot, so its mismatches roll back
+        bit-identically and re-apply under the true mask instead of
+        aborting.
 
         Dispatch runs on the host counters (the crossover router's
         sub-floor path), so under ``device_tally`` the grid gets the

@@ -43,7 +43,16 @@ __all__ = [
     "DeviceTelemetry",
     "NullDeviceTelemetry",
     "NULL_DEVTEL",
+    "EXEC_ORIGIN",
 ]
+
+#: The submitting-track id the sim stamps on execution-layer commands
+#: (tx-signature rows riding the fused drain). A launch whose metas
+#: include this origin is a FUSED drain — votes and exec rows in one
+#: coalesced device program — and its stage spans are double-booked
+#: under ``devtel.fused.*`` so the fused pipeline's pack/dispatch/
+#: sync/unpack economics are separable from pure vote drains.
+EXEC_ORIGIN = -3
 
 
 class CmdMeta:
@@ -74,8 +83,8 @@ class LaunchRecord:
     __slots__ = (
         "launch_id", "kind", "generation", "commands", "rows", "lanes",
         "occupancy_pct", "queue_wait_max", "queue_wait_sum", "origins",
-        "syncs", "t_pack", "t_dispatch", "t_sync", "t_unpack", "wall",
-        "_t_begin", "_t_last",
+        "syncs", "exec_rows", "t_pack", "t_dispatch", "t_sync",
+        "t_unpack", "wall", "_t_begin", "_t_last",
     )
 
     def __init__(self, launch_id, kind, generation, metas, now):
@@ -91,6 +100,11 @@ class LaunchRecord:
         self.queue_wait_sum = sum(waits)
         self.origins = tuple(m.origin for m in metas)
         self.syncs = 0
+        #: Rows submitted by the execution layer (origin EXEC_ORIGIN):
+        #: nonzero marks this launch as a fused drain.
+        self.exec_rows = sum(
+            m.rows for m in metas if m.origin == EXEC_ORIGIN
+        )
         self.t_pack = 0.0
         self.t_dispatch = 0.0
         self.t_sync = 0.0
@@ -116,6 +130,7 @@ class LaunchRecord:
             "queue_wait_sum": self.queue_wait_sum,
             "origins": list(self.origins),
             "syncs": self.syncs,
+            "exec_rows": self.exec_rows,
             "t_pack": self.t_pack,
             "t_dispatch": self.t_dispatch,
             "t_sync": self.t_sync,
@@ -253,6 +268,20 @@ class DeviceTelemetry:
         reg.observe("devtel.launch.sync.latency", rec.t_sync)
         reg.observe("devtel.launch.unpack.latency", rec.t_unpack)
         reg.observe("devtel.launch.wall.latency", rec.wall)
+        if rec.exec_rows:
+            # Fused-drain stage spans (PR 16): this launch carried
+            # exec-layer signature rows coalesced with vote verifies,
+            # so its per-stage latencies are double-booked under the
+            # fused series — `obs report` and the exporter can show
+            # what the speculative pipeline's shared launches cost at
+            # each stage without disentangling mixed histograms.
+            reg.count("devtel.fused.launches")
+            reg.count("devtel.fused.exec_rows", rec.exec_rows)
+            reg.observe("devtel.fused.pack.latency", rec.t_pack)
+            reg.observe("devtel.fused.dispatch.latency", rec.t_dispatch)
+            reg.observe("devtel.fused.sync.latency", rec.t_sync)
+            reg.observe("devtel.fused.unpack.latency", rec.t_unpack)
+            reg.observe("devtel.fused.wall.latency", rec.wall)
 
     # ------------------------------------------- device_fetch probe taps
 

@@ -162,6 +162,15 @@ EVENT_KINDS = frozenset(
         "exec.apply",
         "exec.root",
         "exec.stake",
+        # Speculative execution pipeline (exec/ledger.py speculate/
+        # resolve): one mark per speculative apply (detail: signed
+        # guess or exact), one per confirmed height, one per rollback
+        # (detail: heights unwound). Closed family — the lint (HD005),
+        # the --exec report's speculation-outcome table, and
+        # OBSERVABILITY.md enumerate exactly these.
+        "exec.spec.speculate",
+        "exec.spec.confirm",
+        "exec.spec.rollback",
     }
 )
 

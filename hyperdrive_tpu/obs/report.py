@@ -550,7 +550,19 @@ def exec_summary(events):
         "roots": {},  # height -> {root8 -> [replicas]}
         "root_forks": [],  # heights where >1 distinct root was reported
         "stake_marks": [],  # (height, detail) epoch stake snapshots
+        # Speculation outcomes (exec.spec.* — the pipelined path):
+        # replica -> {speculated, signed, confirmed, rolled_back,
+        # max_depth}. A replica absent here ran strictly sequential.
+        "spec_per_replica": {},
     }
+
+    def spec_rep(replica):
+        return out["spec_per_replica"].setdefault(
+            replica,
+            {"speculated": 0, "signed": 0, "confirmed": 0,
+             "rolled_back": 0, "max_depth": 0},
+        )
+
     for ev in events:
         replica, height, kind, detail = ev[1], ev[2], ev[4], ev[5]
         if kind == "exec.apply":
@@ -585,6 +597,19 @@ def exec_summary(events):
             by_root.setdefault(root8, []).append(replica)
         elif kind == "exec.stake":
             out["stake_marks"].append((height, str(detail or "")))
+        elif kind == "exec.spec.speculate":
+            rep = spec_rep(replica)
+            rep["speculated"] += 1
+            if str(detail or "") == "signed=1":
+                rep["signed"] += 1
+        elif kind == "exec.spec.confirm":
+            spec_rep(replica)["confirmed"] += 1
+        elif kind == "exec.spec.rollback":
+            rep = spec_rep(replica)
+            rep["rolled_back"] += 1
+            d = str(detail or "")
+            if d.startswith("depth="):
+                rep["max_depth"] = max(rep["max_depth"], int(d[6:]))
     out["root_forks"] = sorted(
         h for h, by_root in out["roots"].items() if len(by_root) > 1
     )
@@ -610,6 +635,23 @@ def render_exec_table(summary):
                  str(s["applied"])]
             )
         widths = [max(len(r[i]) for r in rows) for i in range(4)]
+        for i, r in enumerate(rows):
+            lines.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+    spec = summary.get("spec_per_replica") or {}
+    if spec:
+        lines.append("speculation outcomes:")
+        rows = [["replica", "speculated", "signed", "confirmed",
+                 "rolled back", "max depth"]]
+        for rep in sorted(spec):
+            s = spec[rep]
+            rows.append(
+                [str(rep), str(s["speculated"]), str(s["signed"]),
+                 str(s["confirmed"]), str(s["rolled_back"]),
+                 str(s["max_depth"])]
+            )
+        widths = [max(len(r[i]) for r in rows) for i in range(6)]
         for i, r in enumerate(rows):
             lines.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
             if i == 0:

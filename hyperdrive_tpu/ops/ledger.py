@@ -33,22 +33,39 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from hyperdrive_tpu.ops import rootmix
 from hyperdrive_tpu.ops.bucketing import bucket_for
+from hyperdrive_tpu.ops.rootmix import (
+    ROOT_WORDS,
+    fold_root_np,
+    mix_matrix,
+    state_digest_np,
+)
 
 __all__ = [
     "TX_BUCKETS",
     "KIND_TRANSFER",
     "KIND_STAKE",
     "KIND_UNSTAKE",
+    "ROOT_WORDS",
     "apply_block_jax",
     "apply_block",
     "pad_block",
+    "mix_matrix",
+    "state_digest_np",
+    "fold_root_np",
+    "apply_block_chain_jax",
+    "apply_block_chain_cols_jax",
+    "pack_block_cols",
 ]
 
 #: Padded-launch ladder for the tx axis. Same doctrine as the Ed25519
 #: packer: one executable per bucket, beyond the top round to its
-#: multiple (bench runs 1k/16k/64k blocks, so the ladder tops at 64k).
-TX_BUCKETS = (256, 1024, 4096, 16384, 65536)
+#: multiple. Every power of four plus the 32k rung: a 32k-tx block is
+#: the e2e bench's mid size, and without its own rung it would run the
+#: 64k-shaped kernel — double the scatter work for padding that is
+#: algebraically inert but not free.
+TX_BUCKETS = (256, 1024, 4096, 16384, 32768, 65536)
 
 #: Transaction kinds. TRANSFER moves balance sender->recipient; STAKE
 #: converts sender balance into sender stake; UNSTAKE converts sender
@@ -70,43 +87,153 @@ def apply_block_jax(balances, stakes, kind, sender, recipient, amount, sig_ok):
     the [T] bool mask of transactions that actually executed (signature
     good AND the sender could cover its block-total outflows).
     """
+    a = balances.shape[0]
     ok_i = sig_ok.astype(jnp.int32)
     amt = amount * ok_i
     is_transfer = (kind == KIND_TRANSFER).astype(jnp.int32)
     is_stake = (kind == KIND_STAKE).astype(jnp.int32)
     is_unstake = (kind == KIND_UNSTAKE).astype(jnp.int32)
 
+    # The scatters ARE the serial part of the CPU lowering, so both
+    # passes run as ONE scatter each over a concatenated [2A] account
+    # axis (balances in [:A], stakes in [A:]) instead of one scatter
+    # per (state, index) pair — five scatters become two, measurably
+    # faster at every bucket.
+
     # 1. Per-sender asks, summed over the whole block (segment-sum as a
-    #    scatter-add over the account axis).
-    zero = jnp.zeros_like(balances)
-    out_bal = zero.at[sender].add(amt * (is_transfer + is_stake))
-    out_stk = zero.at[sender].add(amt * is_unstake)
+    #    scatter-add): a tx asks from its sender's balance for
+    #    TRANSFER/STAKE and from its sender's stake for UNSTAKE.
+    asks = jnp.zeros(2 * a, dtype=balances.dtype).at[
+        sender + a * is_unstake
+    ].add(amt)
 
     # 2. Block-atomic solvency: every tx of an overdrawn sender dies.
-    sender_ok = (balances >= out_bal) & (stakes >= out_stk)
+    sender_ok = (balances >= asks[:a]) & (stakes >= asks[a:])
     applied = sig_ok & sender_ok[sender]
     aamt = amount * applied.astype(jnp.int32)
 
-    # 3. Applied deltas, one signed scatter per (state, index) pair:
-    #    the sender's balance move is -a for TRANSFER/STAKE and +a for
-    #    UNSTAKE, its stake move is +a for STAKE and -a for UNSTAKE,
-    #    and only TRANSFER credits the recipient — three scatters
-    #    total instead of one per kind-axis combination (the scatter
-    #    is the serial part of the CPU lowering, so fusing the deltas
-    #    is most of the large-block win).
-    new_bal = (
-        balances
-        .at[sender].add(aamt * (is_unstake - is_transfer - is_stake))
-        .at[recipient].add(aamt * is_transfer)
-    )
-    new_stk = stakes.at[sender].add(aamt * (is_stake - is_unstake))
-    return new_bal, new_stk, applied
+    # 3. Applied deltas: the sender's balance move is -a for TRANSFER/
+    #    STAKE and +a for UNSTAKE, its stake move is +a for STAKE and
+    #    -a for UNSTAKE, and only TRANSFER credits the recipient —
+    #    three index lanes concatenated into the one [2A] scatter.
+    state = jnp.concatenate([balances, stakes])
+    new = state.at[
+        jnp.concatenate([sender, recipient, a + sender])
+    ].add(jnp.concatenate([
+        aamt * (is_unstake - is_transfer - is_stake),
+        aamt * is_transfer,
+        aamt * (is_stake - is_unstake),
+    ]))
+    return new[:a], new[a:], applied
 
 
 @functools.cache
 def _jitted():
     # No donation: the CPU backend can't honor it and warns per compile.
     return jax.jit(apply_block_jax)
+
+
+# --------------------------------------------------------------------------
+# Device-resident state root (PR 16): the jnp twin of ops/rootmix.py,
+# fused into the apply launch — state words, digest reduction, and the
+# chain fold all wrap mod 2^32 exactly as the numpy host twin does, so
+# the running root never leaves the device between heights and still
+# chains byte-equal to the host reference.
+
+
+def _state_words_jax(balances, stakes):
+    def words(v):
+        lo = v.astype(jnp.uint32)
+        hi = jnp.right_shift(v, 31).astype(jnp.uint32)
+        return jnp.stack([lo, hi], axis=1).reshape(-1)
+
+    return jnp.concatenate([words(balances), words(stakes)])
+
+
+def _fold_root_jax(root_words, height_u32, digest_words):
+    k = jnp.arange(rootmix.ROOT_WORDS, dtype=jnp.uint32)
+    x = (
+        root_words * jnp.uint32(rootmix.FOLD_PREV)
+        + digest_words
+        + height_u32 * jnp.uint32(rootmix.FOLD_HEIGHT)
+        + k
+    )
+    x = x ^ jnp.right_shift(x, 16)
+    x = x * jnp.uint32(rootmix.FMIX_A)
+    x = x ^ jnp.right_shift(x, 15)
+    x = x * jnp.uint32(rootmix.FMIX_B)
+    x = x ^ jnp.right_shift(x, 16)
+    return x
+
+
+def apply_block_chain_jax(
+    balances, stakes, root_words, height_u32,
+    kind, sender, recipient, amount, sig_ok, mix,
+):
+    """The fused pipeline step: apply one block AND fold the new state
+    into the running root, all on device — the inter-height host hop of
+    the sha256 chain becomes one extra reduction inside the same launch.
+
+    Args beyond :func:`apply_block_jax`:
+      root_words: [ROOT_WORDS] uint32 — the running chained root.
+      height_u32: uint32 scalar — the height being applied.
+      mix: [4*A, ROOT_WORDS] uint32 — :func:`mix_matrix` for this width.
+
+    Returns ``(new_balances, new_stakes, applied_count, new_root)``
+    where ``applied_count`` is a device int32 scalar (NOT fetched here:
+    the executor accumulates it and materializes per window flush).
+    """
+    new_bal, new_stk, applied = apply_block_jax(
+        balances, stakes, kind, sender, recipient, amount, sig_ok
+    )
+    w = _state_words_jax(new_bal, new_stk)
+    digest = (w[:, None] * mix).sum(axis=0, dtype=jnp.uint32)
+    new_root = _fold_root_jax(root_words, height_u32, digest)
+    count = applied.astype(jnp.int32).sum()
+    return new_bal, new_stk, count, new_root
+
+
+@functools.cache
+def _jitted_chain():
+    return jax.jit(apply_block_chain_jax)
+
+
+def apply_block_chain_cols_jax(balances, stakes, root_words, height_u32, cols, mix):
+    """:func:`apply_block_chain_jax` taking the block as ONE packed
+    [5, T] int32 matrix (kind, sender, recipient, amount, sig_ok rows —
+    :func:`pack_block_cols`). Five separate host->device transfers per
+    height cost ~1ms of fixed ``device_put`` dispatch on the CPU
+    backend; one contiguous buffer costs one."""
+    return apply_block_chain_jax(
+        balances, stakes, root_words, height_u32,
+        cols[0], cols[1], cols[2], cols[3], cols[4].astype(bool), mix,
+    )
+
+
+@functools.cache
+def _jitted_chain_cols():
+    return jax.jit(apply_block_chain_cols_jax)
+
+
+def pack_block_cols(kind, sender, recipient, amount, sig_ok=None,
+                    bucket: int | None = None) -> np.ndarray:
+    """Pack a block into the [5, bucket] int32 matrix
+    :func:`apply_block_chain_cols_jax` consumes — rows (kind, sender,
+    recipient, amount, sig_ok as 0/1), pad columns inert (sig_ok=0,
+    amount=0). ``sig_ok=None`` admits every real row (the unsigned
+    semantics)."""
+    n = len(kind)
+    b = bucket if bucket is not None else bucket_for(max(n, 1), TX_BUCKETS)
+    out = np.zeros((5, b), dtype=np.int32)
+    out[0, :n] = kind
+    out[1, :n] = sender
+    out[2, :n] = recipient
+    out[3, :n] = amount
+    if sig_ok is None:
+        out[4, :n] = 1
+    else:
+        out[4, :n] = np.asarray(sig_ok, dtype=np.int32)
+    return out
 
 
 def pad_block(kind, sender, recipient, amount, sig_ok, bucket: int | None = None):

@@ -314,6 +314,29 @@ class ShardVerifyService:
         self.state_roots[tenant] = {}
         return ex
 
+    def speculate_height(self, tenant, height: int) -> bool:
+        """Tenant windows ride the speculative pipeline (PR 16): apply
+        ``height``'s block at SUBMIT time under the exact unsigned
+        guess, so by the time the quorum certificate lands,
+        :meth:`accept_certificate`'s ``advance_to`` is a cached read —
+        the window's verify latency and its block apply overlap instead
+        of stacking. Exact speculation cannot mismatch (there is no
+        guessed mask to be wrong), so the rollback machinery stays out
+        of the serving path; signed-tx configs are excluded because
+        their admission mask is only known after verification. Only the
+        strictly-next height speculates — out-of-order or duplicate
+        submits are a no-op (``advance_to`` still catches any gap).
+        Returns True when the height was speculatively applied."""
+        ex = self.executors.get(tenant)
+        if (
+            ex is None
+            or ex.config.sign_txs
+            or height != ex.height + 1
+        ):
+            return False
+        ex.speculate(height, None)
+        return True
+
     def accept_certificate(self, tenant, certifier, cert) -> bool:
         """Cross-tenant commit-proof exchange: re-verify ``cert`` in
         O(1) against ``certifier`` (quorum weight + binding; no
@@ -342,9 +365,11 @@ class ShardVerifyService:
         certs[cert.height] = cert
         ex = self.executors.get(tenant)
         if ex is not None:
-            # Advance the tenant's ledger to the certified height (the
-            # executor catches up any gap deterministically from its
-            # block source) and pin the root the frame will carry.
+            # Pin the root the frame will carry. When the height rode
+            # the speculative pipeline (speculate_height at submit),
+            # this confirms-in-passing and reads the cached root; a gap
+            # or a non-speculative tenant is caught up deterministically
+            # from the block source.
             self.state_roots[tenant][cert.height] = ex.advance_to(
                 cert.height
             )
@@ -569,6 +594,10 @@ class TenantShard:
             items = [(pc.sender, pc.digest(), pc.signature) for pc in rows]
             t0 = self.time_fn()
             fut = self.service.submit(self.name, items, self.generation)
+            # Execution-attached tenants ride the speculative pipeline:
+            # the height's block applies now, overlapping the window's
+            # verify, and the certificate accept reads the cached root.
+            self.service.speculate_height(self.name, height)
             fut.add_done_callback(
                 lambda f, height=height, value=value, rows=rows, t0=t0:
                 self._finalize(f, height, value, rows, t0)

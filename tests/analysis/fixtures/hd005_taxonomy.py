@@ -35,6 +35,9 @@ class Pipeline:
     def bad_unknown_exec(self, h):
         self.obs.emit("exec.applied", -1, h, -1, 0)  # BAD: fork
 
+    def bad_unknown_spec(self, h):
+        self.obs.emit("exec.spec.commit", -1, h, -1, 0)  # BAD: fork
+
     def good_taxonomy_members(self, lid, pct):
         self.obs.emit("sched.launch.begin", -2, -1, -1, lid)
         self.obs.emit("verify.occupancy.pct", -1, -1, -1, pct)
@@ -46,6 +49,9 @@ class Pipeline:
         self.obs.emit("exec.apply", -1, -1, -1, 0)
         self.obs.emit("exec.root", -1, -1, -1, 0)
         self.obs.emit("exec.stake", -1, -1, -1, 0)
+        self.obs.emit("exec.spec.speculate", -1, -1, -1, 0)
+        self.obs.emit("exec.spec.confirm", -1, -1, -1, 0)
+        self.obs.emit("exec.spec.rollback", -1, -1, -1, 0)
 
     def good_open_family(self):
         # Families outside the closed prefixes stay grep-audited only:

@@ -391,3 +391,165 @@ def test_rekey_across_stake_changing_boundary():
         if sim.epoch_schedule.transition(e).rekeyed
     ]
     assert retired_epochs, "no transition rotated a key"
+
+
+# ------------------------------------------------------ speculative pipeline
+
+
+def test_speculation_mismatch_rolls_back_bit_identically():
+    # A wrong admission guess must unwind state, root, and counters to
+    # EXACTLY what a never-speculated executor derives — and the roots
+    # computed under the wrong guess must land in discarded_roots,
+    # disjoint from the settled chain.
+    from hyperdrive_tpu.verifier import HostVerifier
+
+    cfg = _cfg(sign_txs=True, bad_sig_every=4, txs_per_block=12)
+    src = BlockSource(cfg)
+    ref = HostLedgerExecutor(cfg, source=src)
+    ref.advance_to(3)
+
+    ex = HostLedgerExecutor(cfg, source=src)
+    guess = [True] * cfg.txs_per_block  # forged lanes look well-formed
+    for h in (1, 2, 3):
+        ex.speculate(h, list(guess))
+    verifier = HostVerifier()
+    for h in (1, 2, 3):
+        mask = [
+            bool(v)
+            for v in verifier.verify_signatures(src.sig_items(src.block(h)))
+        ]
+        ex.resolve(h, mask)
+    assert ex.spec_rolled_back >= 1
+    assert not ex._spec  # every window settled
+    assert ex.balances == ref.balances
+    assert ex.stakes == ref.stakes
+    assert ex.root == ref.root
+    assert ex.roots == ref.roots
+    assert ex.applied_total == ref.applied_total
+    assert ex.rejected_total == ref.rejected_total
+    assert ex.discarded_roots
+    assert not ex.discarded_roots & set(ex.roots.values())
+    ex.host_verify()
+
+
+def test_speculation_confirm_path_and_ordering_guards():
+    cfg = _cfg(txs_per_block=12)
+    src = BlockSource(cfg)
+    ex = HostLedgerExecutor(cfg, source=src)
+    ex.speculate(1, None)
+    with pytest.raises(ValueError):
+        ex.speculate(3, None)  # strictly upward
+    ex.speculate(2, None)
+    # advance_to confirms exact windows in passing (the commit seam).
+    ref = HostLedgerExecutor(cfg, source=src)
+    assert ex.advance_to(2) == ref.advance_to(2)
+    assert ex.spec_confirmed == 2 and ex.spec_rolled_back == 0
+    # A signed guess cannot be confirmed blind: commits must wait for
+    # the verify verdict.
+    sx = HostLedgerExecutor(
+        _cfg(sign_txs=True, txs_per_block=12),
+        source=BlockSource(_cfg(sign_txs=True, txs_per_block=12)),
+    )
+    sx.speculate(1, [True] * 12)
+    with pytest.raises(RuntimeError):
+        sx.confirm_to(1)
+
+
+def test_fused_drain_matches_two_kind_drain_and_saves_launches():
+    # The fused drain coalesces exec signature rows into the SAME
+    # launch as the vote verifies; the two-kind path gives exec rows
+    # their own launch per drain. Same chain either way, fewer
+    # launches fused.
+    cfg = _cfg(seed=11, sign_txs=True, txs_per_block=12)
+    kw = dict(
+        n=4, target_height=5, seed=11, sign=True, burst=True,
+        pipeline_heights=True, execution=cfg,
+    )
+    fused = Simulation(fused_exec_drain=True, **kw)
+    rf = fused.run()
+    two = Simulation(fused_exec_drain=False, **kw)
+    rt = two.run()
+    assert rf.commits == rt.commits
+    assert fused._sched.launches < two._sched.launches
+
+
+def test_pipelined_matches_sequential_under_drr_drain_policy():
+    # Deferrals from a row-capped DeficitRoundRobin reorder WHEN exec
+    # rows verify, never what the chain settles to: the pipelined run
+    # must agree with the plain sequential settle-then-execute run on
+    # every common height.
+    from hyperdrive_tpu.devsched import DeficitRoundRobin, DeviceWorkQueue
+
+    cfg = _cfg(seed=19, sign_txs=True, txs_per_block=12)
+    queue = DeviceWorkQueue(
+        max_depth=4,
+        policy=DeficitRoundRobin(
+            capacity_rows=32, quantum_rows=8, starve_after=3
+        ),
+    )
+    pip = Simulation(
+        n=4, target_height=5, seed=19, sign=True, burst=True,
+        pipeline_heights=True, devsched=queue, execution=cfg,
+    )
+    rp = pip.run()
+    seq = Simulation(
+        n=4, target_height=5, seed=19, sign=True, burst=True,
+        pipeline_heights=False, execution=cfg,
+    )
+    rs = seq.run()
+    for i in range(4):
+        common = set(rp.commits[i]) & set(rs.commits[i])
+        assert common
+        for h in common:
+            assert rp.commits[i][h] == rs.commits[i][h]
+
+
+def test_block_source_cache_pins_open_epoch_and_counts():
+    # The LRU never evicts an entry touched in the OPEN speculation
+    # epoch (a rollback may replay it); closing the window (epoch
+    # bump) releases the pins. hits/misses/evictions make the policy
+    # observable.
+    cfg = _cfg(txs_per_block=8)
+    src = BlockSource(cfg)
+    cap = BlockSource.CACHE
+    for h in range(1, cap + 3):
+        src.block(h)
+    # Every entry belongs to the open epoch: pinned, so the cache grew
+    # past capacity rather than evicting.
+    assert src.misses == cap + 2
+    assert src.evictions == 0
+    assert len(src._cache) == cap + 2
+    src.block(1)
+    assert src.hits == 1  # still resident
+    # Close the window: the next insert may evict the stale epoch.
+    src.spec_epoch += 1
+    src.block(cap + 3)
+    assert src.evictions > 0
+    assert len(src._cache) <= cap
+    # An entry re-touched in the new epoch is pinned again.
+    src.block(cap + 3)
+    assert src.hits == 2
+
+
+def test_exec_report_renders_speculation_outcome_table():
+    from hyperdrive_tpu.obs.report import exec_summary, render_exec_table
+
+    cfg = _cfg(seed=29, txs_per_block=12)
+    sim = Simulation(
+        n=4, target_height=4, seed=29, sign=True, burst=True,
+        pipeline_heights=True, execution=cfg, observe=True,
+    )
+    sim.run()
+    summary = exec_summary(sim.obs.snapshot())
+    spec = summary["spec_per_replica"]
+    assert spec, "pipelined run journalled no speculation events"
+    totals = {k: sum(s[k] for s in spec.values()) for k in
+              ("speculated", "confirmed", "rolled_back")}
+    assert totals["speculated"] >= 4
+    assert totals["confirmed"] + totals["rolled_back"] == totals["speculated"]
+    ex = sim._exec_unique[0]
+    assert totals["confirmed"] == ex.spec_confirmed
+    assert totals["rolled_back"] == ex.spec_rolled_back
+    text = render_exec_table(summary)
+    assert "speculation outcomes:" in text
+    assert "rolled back" in text

@@ -483,6 +483,40 @@ def test_remote_tenant_frames_carry_state_roots():
         svc.close()
 
 
+def test_tenant_windows_ride_the_speculative_pipeline():
+    # Execution-attached tenants apply each height's block at SUBMIT
+    # time (exact unsigned speculation — no guessed mask, so no
+    # rollback machinery on the serving path); the certificate accept
+    # confirms-in-passing and reads the cached root. Digest-neutral:
+    # the chain equals the non-speculative reference exactly.
+    svc = _service()
+    shard = TenantShard(
+        "led", target_height=4, sign=False, execution=_exec_cfg()
+    ).attach_local(svc)
+    _drive(svc, [shard])
+    assert shard.done and shard.rejected == 0
+    ex = svc.executors["led"]
+    assert ex.spec_confirmed == 4
+    assert ex.spec_rolled_back == 0
+    assert not ex._spec  # every window settled by commit time
+    from hyperdrive_tpu.exec.ledger import HostLedgerExecutor
+
+    ref = HostLedgerExecutor(_exec_cfg())
+    for h in range(1, 5):
+        assert shard.state_roots[h] == ref.advance_to(h)
+    # Signed-tx configs are excluded: their admission mask is only
+    # known post-verify, so submit-time speculation must decline.
+    from hyperdrive_tpu.exec import ExecutionConfig
+
+    signed = ExecutionConfig(
+        accounts=16, txs_per_block=8, stake_every=3, stake_accounts=4,
+        sign_txs=True,
+    )
+    svc.attach_execution("signed", signed)
+    assert svc.speculate_height("signed", 1) is False
+    assert svc.executors["signed"].spec_confirmed == 0
+
+
 def test_rootless_tenant_unaffected_by_neighbors_ledger():
     # A tenant WITHOUT execution attached must see no root on its
     # frames and commit the byte-identical chain it commits solo —
