@@ -1,0 +1,451 @@
+"""Merkle proof bench: incremental device hash-tree update vs full
+rebuild, plus O(log n) proof-serving throughput for light clients.
+
+Produces the BENCH_r14 artifact (the perf evidence for the
+device-Merkleized state, README "Trustless reads"):
+
+- **merkle.update_speedup** (gated) — incremental O(k log n) update
+  (``update_tree_np``: one [K] leaf recompute plus one [K]
+  gather-combine-scatter per level) against the full O(n) rebuild
+  (``build_tree_np``) at n = 2^16 leaves across dirty fractions, on
+  the host twin — the path the proof-serving replica and host
+  executor actually pay per block. Every leg asserts the incremental
+  tree is BIT-IDENTICAL to a rebuild of the same state — a speedup
+  that drifts the root is a bug, not a result. Acceptance floor:
+  >= 5x at <= 1% dirty. The jitted device twin rides along as
+  ``update_speedup_device`` (informational): on CPU-emulated devices
+  XLA's full rebuild is a single streamed pass whose constant factor
+  beats log-n dependent scatter launches, so the asymptotic win only
+  shows on the device series for sub-0.1% dirty sets; the fused
+  drain (exec/device.py) already picks full-vs-incremental per block
+  on exactly that tradeoff.
+
+- **proof.serve_per_s** (gated) — ``ProofBasis.prove`` +
+  ``encode_proof`` throughput on the frozen O(n) snapshot the serving
+  replica answers from (pure numpy indexing, no tree hashing on the
+  read path), over a Poisson-sized request batch with seeded account
+  draws. Acceptance floor: >= 10k proofs/s at n = 2^16. Absolute
+  rows gate by benchdiff's noise bound against the committed
+  artifact, so this series assumes CI runners of the same class.
+
+- **proof_bytes / verify_us** (informational) — wire frame size and
+  client-side ``verify_inclusion`` cost vs n in {2^10, 2^13, 2^16}:
+  both must grow with depth (log n), not n.
+
+- **consensus_p99_ratio_shed** (floored in-script, not
+  benchdiff-gated: p99 of a timing loop is too noisy for an 8% drift
+  bound) — p99 commit-to-commit interval of a jax-free TenantShard
+  consensus loop with an open-loop Poisson query storm riding the
+  same thread THROUGH the AdmissionGate pinned at its shed floor,
+  over the storm-free baseline. This is the overload doctrine's
+  promise measured directly: when pressure rises, reads are the
+  first prey and consensus p99 must not move (floor <= 2x, which is
+  microseconds of classify-and-drop per gap). The always-serve ratio
+  (gate at ACCEPT, every query answered inline on the consensus
+  thread — the single-core worst case a real deployment avoids by
+  shedding exactly as the gated row does) rides along as
+  ``consensus_p99_ratio_serve``, informational.
+
+Every timed wall is a best-of-``reps`` minimum: the measurement boxes
+are single-core and preemption inflates individual runs by 2-3x, and
+the minimum is the run the machine actually executed without
+interference.
+
+Usage::
+
+    python benches/proof_bench.py [-o BENCH_r14.json] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", ".jax_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2.0")
+
+from hyperdrive_tpu.exec import ExecutionConfig  # noqa: E402
+from hyperdrive_tpu.exec.ledger import (  # noqa: E402
+    BlockSource,
+    HostLedgerExecutor,
+)
+from hyperdrive_tpu.load import LoadProfile, PoissonSchedule  # noqa: E402
+from hyperdrive_tpu.load.generator import LoadRuntime  # noqa: E402
+from hyperdrive_tpu.ops.merkle import verify_inclusion  # noqa: E402
+from hyperdrive_tpu.parallel.service import (  # noqa: E402
+    STATUS_COMMITTED,
+    encode_proof,
+)
+
+SEED = 31
+
+#: Update-leg tree size (leaves) and dirty fractions. 2^16 is the
+#: acceptance-criterion size; the fractions bracket the <= 1% floor.
+UPDATE_LEAVES = 65536
+DIRTY_FRACS = (0.0005, 0.01, 0.05)
+
+#: Proof-size/verify-cost/serving sweep (accounts).
+PROOF_SIZES = (1024, 8192, 65536)
+
+#: Consensus-interference leg: committed heights per run and the
+#: open-loop proof-request rate ridden on the consensus thread.
+CONSENSUS_HEIGHTS = 80
+SERVE_STORM_RATE = 20_000.0
+
+
+def bench_update(frac: float, reps: int, iters: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hyperdrive_tpu.ops.merkle import (
+        build_tree_jax,
+        build_tree_np,
+        update_tree_jax,
+        update_tree_np,
+    )
+
+    n = UPDATE_LEAVES
+    rng = np.random.default_rng(SEED)
+    bal = rng.integers(0, 1 << 30, size=n, dtype=np.int32)
+    stk = rng.integers(0, 1 << 20, size=n, dtype=np.int32)
+    k = max(1, int(n * frac))
+    dirty = np.sort(rng.choice(n, size=k, replace=False)).astype(np.int32)
+    bal2 = bal.copy()
+    bal2[dirty] += 1
+
+    # Parity before timing: the incremental tree must be bit-identical
+    # to a full rebuild of the post-update state, on both twins.
+    ref = build_tree_np(bal2, stk)
+    host_tree = build_tree_np(bal, stk)
+    update_tree_np(host_tree, bal2, stk, dirty)
+    build_j = jax.jit(build_tree_jax)
+    update_j = jax.jit(update_tree_jax)
+    db, db2 = jnp.asarray(bal), jnp.asarray(bal2)
+    ds, di = jnp.asarray(stk), jnp.asarray(dirty)
+    tree = build_j(db, ds)
+    updated = update_j(tree, db2, ds, di)
+    for twin, got_tree in (("host", host_tree), ("device", updated)):
+        for got, want in zip(got_tree, ref):
+            if not np.array_equal(np.asarray(got), want):
+                raise SystemExit(
+                    f"UPDATE PARITY BROKEN at frac={frac}: {twin} "
+                    f"incremental tree diverges from a full rebuild"
+                )
+
+    walls = {}
+    # Host twin: re-updating with the already-applied state recomputes
+    # identical nodes (clean-leaf idempotency), so iterating in place
+    # is sound for timing.
+    for label, fn in (
+        ("full", lambda: build_tree_np(bal2, stk)),
+        ("incremental",
+         lambda: update_tree_np(host_tree, bal2, stk, dirty)),
+    ):
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            wall = (time.perf_counter() - t0) / iters
+            best = wall if best is None else min(best, wall)
+        walls[label] = best
+    for label, fn in (
+        ("full_dev", lambda: build_j(db2, ds)),
+        ("incremental_dev", lambda: update_j(tree, db2, ds, di)),
+    ):
+        fn()[-1].block_until_ready()  # compiled + warm
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn()
+            out[-1].block_until_ready()
+            wall = (time.perf_counter() - t0) / iters
+            best = wall if best is None else min(best, wall)
+        walls[label] = best
+    return {
+        "dirty_frac": frac,
+        "dirty_leaves": k,
+        "full_us": round(walls["full"] * 1e6, 1),
+        "incremental_us": round(walls["incremental"] * 1e6, 1),
+        "speedup": round(walls["full"] / walls["incremental"], 3),
+        "device_speedup": round(
+            walls["full_dev"] / walls["incremental_dev"], 3
+        ),
+    }
+
+
+def _basis(accounts: int):
+    cfg = ExecutionConfig(
+        accounts=accounts,
+        txs_per_block=256,
+        stake_every=4,
+        stake_accounts=min(64, accounts // 4),
+        seed=SEED,
+        amount_cap=64,
+        initial_balance=1_000_000,
+    )
+    ex = HostLedgerExecutor(cfg, source=BlockSource(cfg))
+    ex.advance_to(2)
+    return ex, ex.proof_basis()
+
+
+def bench_proof_cost(accounts: int, reps: int, iters: int) -> dict:
+    ex, basis = _basis(accounts)
+    proof = basis.prove(accounts // 2)
+    frame = encode_proof(1, STATUS_COMMITTED, proof)
+    root = ex.roots[basis.height]
+    assert verify_inclusion(
+        root, proof.account, proof.balance, proof.stake, proof
+    )
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            verify_inclusion(
+                root, proof.account, proof.balance, proof.stake, proof
+            )
+        wall = (time.perf_counter() - t0) / iters
+        best = wall if best is None else min(best, wall)
+    return {
+        "accounts": accounts,
+        "depth": len(proof.siblings),
+        "proof_bytes": len(frame),
+        "verify_us": round(best * 1e6, 2),
+    }
+
+
+def bench_serve(accounts: int, reps: int, horizon: float) -> dict:
+    import random
+
+    _, basis = _basis(accounts)
+    # Poisson-sized batch: the open-loop arrival process fixes the
+    # request count; seeded draws pick the accounts. Serving is
+    # CPU-bound, so the wall measures replica capacity.
+    count = len(PoissonSchedule(40_000.0, seed=SEED).arrivals(horizon))
+    rng = random.Random(SEED)
+    targets = [rng.randrange(accounts) for _ in range(count)]
+    best = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for rid, account in enumerate(targets):
+            encode_proof(rid, STATUS_COMMITTED, basis.prove(account))
+        wall = time.perf_counter() - t0
+        best = wall if best is None else min(best, wall)
+    return {
+        "accounts": accounts,
+        "requests": count,
+        "serve_per_s": round(count / best, 1),
+    }
+
+
+def _consensus_run(heights: int, basis, storm: str | None) -> tuple:
+    from hyperdrive_tpu.load import (
+        SHED_LOW_PRIORITY,
+        AdmissionGate,
+        BackpressureController,
+    )
+    from hyperdrive_tpu.load.frames import QueryFrame
+    from hyperdrive_tpu.parallel.service import (
+        ShardVerifyService,
+        TenantShard,
+    )
+    from hyperdrive_tpu.verifier import NullVerifier
+
+    svc = ShardVerifyService(NullVerifier(), max_depth=0)
+    shard = TenantShard(
+        "bench", n_validators=4, target_height=heights, sign=False
+    ).attach_local(svc)
+    rt = gate = None
+    if storm is not None:
+        rt = LoadRuntime(LoadProfile(rate=SERVE_STORM_RATE, seed=SEED))
+        ctrl = BackpressureController()
+        if storm == "shed":
+            ctrl.floor = SHED_LOW_PRIORITY
+        ctrl.poll()
+        gate = AdmissionGate(ctrl)
+    commit_t = []
+    ncommits = served = 0
+    t0 = time.perf_counter()
+    while not shard.done:
+        shard.pump(max_inflight=2)
+        svc.drain()
+        if rt is not None:
+            for _ in range(rt.due(time.perf_counter() - t0)):
+                account = served * 7919 % basis.accounts
+                if gate.admit(QueryFrame(account=account)):
+                    encode_proof(
+                        served, STATUS_COMMITTED, basis.prove(account)
+                    )
+                    served += 1
+        if len(shard.commits) > ncommits:
+            now = time.perf_counter()
+            commit_t.extend([now] * (len(shard.commits) - ncommits))
+            ncommits = len(shard.commits)
+    gaps = sorted(b - a for a, b in zip(commit_t, commit_t[1:]))
+    p99 = gaps[min(len(gaps) - 1, int(0.99 * len(gaps)))]
+    shed = gate.shed.get("query", 0) if gate is not None else 0
+    return p99, served, shed
+
+
+def bench_consensus(heights: int, reps: int) -> dict:
+    _, basis = _basis(PROOF_SIZES[-1])
+    p99 = {}
+    served = shed = 0
+    for storm in (None, "shed", "serve"):
+        best = None
+        for _ in range(reps):
+            p, s, d = _consensus_run(heights, basis, storm)
+            best = p if best is None else min(best, p)
+            served = max(served, s)
+            shed = max(shed, d)
+        p99[storm] = best
+    return {
+        "heights": heights,
+        "baseline_p99_us": round(p99[None] * 1e6, 1),
+        "shed_p99_us": round(p99["shed"] * 1e6, 1),
+        "serve_p99_us": round(p99["serve"] * 1e6, 1),
+        "proofs_served": served,
+        "queries_shed": shed,
+        "p99_ratio_shed": round(p99["shed"] / p99[None], 3),
+        "p99_ratio_serve": round(p99["serve"] / p99[None], 3),
+    }
+
+
+def run_bench(quick: bool) -> dict:
+    reps = 2 if quick else 3
+    iters = 5 if quick else 20
+    verify_iters = 200 if quick else 2000
+    horizon = 0.1 if quick else 1.0
+    heights = 24 if quick else CONSENSUS_HEIGHTS
+
+    update_rows = []
+    for frac in DIRTY_FRACS:
+        row = bench_update(frac, reps, iters)
+        print(
+            f"update n={UPDATE_LEAVES} frac={frac:<7g} "
+            f"k={row['dirty_leaves']:5d} full={row['full_us']:9.1f}us "
+            f"incr={row['incremental_us']:8.1f}us "
+            f"speedup={row['speedup']:.2f}x "
+            f"(device {row['device_speedup']:.2f}x)"
+        )
+        update_rows.append(row)
+    for row in update_rows:
+        if row["dirty_frac"] <= 0.01 and row["speedup"] < 5.0:
+            raise SystemExit(
+                f"incremental update speedup {row['speedup']}x at "
+                f"{row['dirty_frac'] * 100:g}% dirty is below the 5x "
+                f"acceptance floor (n={UPDATE_LEAVES})"
+            )
+
+    cost_rows = []
+    for accounts in PROOF_SIZES:
+        row = bench_proof_cost(accounts, reps, verify_iters)
+        print(
+            f"proof  n={accounts:6d} depth={row['depth']:2d} "
+            f"bytes={row['proof_bytes']:4d} "
+            f"verify={row['verify_us']:.2f}us"
+        )
+        cost_rows.append(row)
+
+    serve_rows = []
+    for accounts in PROOF_SIZES:
+        row = bench_serve(accounts, reps, horizon)
+        print(
+            f"serve  n={accounts:6d} requests={row['requests']:6d} "
+            f"rate={row['serve_per_s']:12.1f}/s"
+        )
+        serve_rows.append(row)
+    for row in serve_rows:
+        if row["serve_per_s"] < 10_000:
+            raise SystemExit(
+                f"proof serving {row['serve_per_s']}/s at "
+                f"n={row['accounts']} is below the 10k proofs/s "
+                f"acceptance floor"
+            )
+
+    consensus = bench_consensus(heights, reps)
+    print(
+        f"consensus p99 baseline={consensus['baseline_p99_us']:.1f}us "
+        f"shed-storm={consensus['shed_p99_us']:.1f}us "
+        f"(ratio {consensus['p99_ratio_shed']:.2f}x, "
+        f"{consensus['queries_shed']} shed) "
+        f"serve-inline={consensus['serve_p99_us']:.1f}us "
+        f"(ratio {consensus['p99_ratio_serve']:.2f}x, "
+        f"{consensus['proofs_served']} served)"
+    )
+    if consensus["p99_ratio_shed"] > 2.0:
+        raise SystemExit(
+            f"consensus p99 ratio {consensus['p99_ratio_shed']}x under "
+            f"a SHED query storm exceeds the 2x acceptance ceiling — "
+            f"the gate is not protecting the consensus path"
+        )
+
+    return {
+        "benchdiff_gate": [
+            "merkle.update_speedup",
+            "proof.serve_per_s",
+        ],
+        "measured_at": datetime.datetime.now().strftime(
+            "%Y-%m-%d %H:%M:%S"
+        ),
+        "merkle": {
+            "seed": SEED,
+            "leaves": UPDATE_LEAVES,
+            "dirty_fracs": list(DIRTY_FRACS),
+            "update_speedup": [r["speedup"] for r in update_rows],
+            "update_speedup_device": [
+                r["device_speedup"] for r in update_rows
+            ],
+            "update_full_us": [r["full_us"] for r in update_rows],
+            "update_incremental_us": [
+                r["incremental_us"] for r in update_rows
+            ],
+        },
+        "proof": {
+            "sizes": list(PROOF_SIZES),
+            "depth": [r["depth"] for r in cost_rows],
+            "proof_bytes": [r["proof_bytes"] for r in cost_rows],
+            "verify_us": [r["verify_us"] for r in cost_rows],
+            "serve_per_s": [r["serve_per_s"] for r in serve_rows],
+            "serve_requests": [r["requests"] for r in serve_rows],
+            "consensus_heights": consensus["heights"],
+            "consensus_baseline_p99_us": consensus["baseline_p99_us"],
+            "consensus_shed_p99_us": consensus["shed_p99_us"],
+            "consensus_serve_p99_us": consensus["serve_p99_us"],
+            "consensus_p99_ratio_shed": consensus["p99_ratio_shed"],
+            "consensus_p99_ratio_serve": consensus["p99_ratio_serve"],
+            "consensus_proofs_served": consensus["proofs_served"],
+            "consensus_queries_shed": consensus["queries_shed"],
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output", default="BENCH_r14.json")
+    ap.add_argument(
+        "--quick",
+        action="store_true",
+        help="CI mode: fewer iters and best-of-2 walls (series shapes "
+        "unchanged, so benchdiff compares cleanly)",
+    )
+    ns = ap.parse_args(argv)
+    doc = run_bench(ns.quick)
+    with open(ns.output, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"wrote {ns.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -687,7 +687,7 @@ class MetricNameRule:
     #: literal under one of these must appear in EVENT_KINDS verbatim.
     _CLOSED_PREFIXES = ("sched.launch.", "verify.occupancy.", "metrics.",
                         "load.", "admission.", "bls.", "tenant.drain.",
-                        "service.", "exec.")
+                        "service.", "exec.", "merkle.", "proof.")
 
     def check(self, ctx):
         findings: list = []

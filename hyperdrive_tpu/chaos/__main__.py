@@ -7,7 +7,7 @@ Usage::
         [--pipelined-every K] [--certs-every K] [--bls-certs-every K]
         [--churn-every K] [--overload-every K] [--overlay-every K]
         [--tenants-every K] [--exec-every K] [--exec-pipeline-every K]
-        [--dump-ok DIR]
+        [--proofs-every K] [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -411,6 +411,98 @@ def _tenant_service_probe(scen_seed: int) -> dict:
     }
 
 
+def _proof_probe(scen_seed: int) -> dict:
+    """The proof-serving fault family (jax-free): a seeded
+    HostLedgerExecutor advances a short chain, then
+
+    - a handful of inclusion proofs must survive the wire codec
+      byte-for-byte AND verify against the chained root a light client
+      already trusts (an honest proof that fails to verify is a
+      liveness violation for every reader);
+    - all four adversarial mutations — stale previous root, forged
+      sibling, truncated path, wrong leaf value — must FAIL
+      verification. A forgery that verifies is the one violation the
+      trustless-read doctrine can never absorb.
+    """
+    import dataclasses
+
+    from hyperdrive_tpu.exec import (
+        BlockSource,
+        ExecutionConfig,
+        HostLedgerExecutor,
+    )
+    from hyperdrive_tpu.parallel.service import (
+        STATUS_COMMITTED,
+        decode_proof,
+        encode_proof,
+    )
+
+    rng = random.Random(scen_seed * _SEED_STRIDE + 13)
+    accounts = rng.choice((16, 32, 64))
+    target = rng.randrange(3, 7)
+    cfg = ExecutionConfig(
+        accounts=accounts, txs_per_block=16, stake_every=3,
+        stake_accounts=accounts // 4, seed=scen_seed % 10_000,
+        amount_cap=16, initial_balance=500,
+    )
+    ex = HostLedgerExecutor(cfg, source=BlockSource(cfg))
+    ex.advance_to(target)
+    basis = ex.proof_basis()
+    root = ex.roots[target]
+    served = 0
+    for account in sorted(rng.sample(range(accounts), 5)):
+        proof = basis.prove(account)
+        rid, status, wired = decode_proof(
+            encode_proof(served + 1, STATUS_COMMITTED, proof)
+        )
+        if wired != proof or rid != served + 1:
+            raise InvariantViolation(
+                "proof-codec",
+                f"proof frame for account {account} did not roundtrip "
+                f"the wire codec losslessly",
+            )
+        if not ex.verify_inclusion(
+            root, account, wired.balance, wired.stake, wired
+        ):
+            raise InvariantViolation(
+                "proof-serve",
+                f"honest proof for account {account} failed "
+                f"verification at height {target}",
+            )
+        served += 1
+    victim = basis.prove(rng.randrange(accounts))
+    forgeries = {
+        "stale-root": dataclasses.replace(
+            victim, prev_root=b"\x01" * 32
+        ),
+        "forged-sibling": dataclasses.replace(
+            victim, siblings=((1, 2, 3, 4),) + victim.siblings[1:]
+        ),
+        "truncated-path": dataclasses.replace(
+            victim, siblings=victim.siblings[:-1]
+        ),
+        "wrong-leaf": dataclasses.replace(
+            victim, balance=victim.balance + 1
+        ),
+    }
+    for name, bad in forgeries.items():
+        if ex.verify_inclusion(
+            root, bad.account, bad.balance, bad.stake, bad
+        ):
+            raise InvariantViolation(
+                "proof-forgery",
+                f"{name} forgery VERIFIED at height {target} "
+                f"(account {bad.account}, {accounts} accounts)",
+            )
+    return {
+        "height": target,
+        "accounts": accounts,
+        "served": served,
+        "depth": len(victim.siblings),
+        "forgeries": len(forgeries),
+    }
+
+
 def _dump_failure(out: str, scen_seed: int, sim, err) -> str:
     os.makedirs(out, exist_ok=True)
     base = os.path.join(out, f"chaos_seed_{scen_seed}")
@@ -619,6 +711,20 @@ def soak(args) -> int:
                     f"launches={tstats['launches']} "
                     f"partition={tstats['partition'][0]}.."
                     f"{tstats['partition'][1]}"
+                )
+            if args.proofs_every and k % args.proofs_every == 0:
+                # The proof-serving fault family (ISSUE 17): honest
+                # proofs must roundtrip the wire codec and verify
+                # against the chained root; the four forged-proof
+                # variants must all fail verification.
+                pstats = _proof_probe(scen_seed)
+                print(
+                    f"ok proofs seed={scen_seed} "
+                    f"height={pstats['height']} "
+                    f"accounts={pstats['accounts']} "
+                    f"served={pstats['served']} "
+                    f"depth={pstats['depth']} "
+                    f"forgeries-rejected={pstats['forgeries']}"
                 )
         except (InvariantViolation, AssertionError) as err:
             failures += 1
@@ -1027,6 +1133,16 @@ def main(argv=None) -> int:
         "no-rolled-back-root-committed invariant armed, digest parity "
         "with the sequential twin, and a record-replay self-check; "
         "0 = off)",
+    )
+    p.add_argument(
+        "--proofs-every",
+        type=int,
+        default=0,
+        help="additionally run every Kth seed as a proof-serving "
+        "probe (jax-free host executor: honest inclusion proofs must "
+        "roundtrip the wire codec and verify against the chained "
+        "root, and all four forged-proof variants must fail "
+        "verification; 0 = off)",
     )
     p.add_argument(
         "--dump-ok",

@@ -4,6 +4,8 @@ Usage::
 
     python -m hyperdrive_tpu.exec parity [--blocks H] [--accounts A]
         [--txs T] [--seed S] [--pipelined]
+    python -m hyperdrive_tpu.exec prove [--blocks H] [--accounts A]
+        [--txs T] [--seed S]
 
 Runs the SAME deterministic block workload through
 :class:`~hyperdrive_tpu.exec.ledger.HostLedgerExecutor` (pure-Python
@@ -18,6 +20,13 @@ EVERY height — three legs:
   3. an insolvency-heavy leg (tiny balances) hammering the
      block-atomic sender-solvency rule where vectorized and serial
      semantics would first diverge if they could.
+
+``prove`` is the Merkle proof-serving smoke: both executor classes
+advance the same chain, every sampled account's inclusion proof must be
+bit-identical across host and device, survive the wire codec
+byte-for-byte, and verify against the chained root — and all four
+forged-proof variants (stale previous root, forged sibling, truncated
+path, wrong leaf) must fail verification on both.
 
 ``--pipelined`` adds a fourth leg exercising the speculative pipeline
 end to end: every leg's config is replayed through speculate/resolve —
@@ -219,6 +228,98 @@ def parity(args) -> int:
     return rc
 
 
+def prove(args) -> int:
+    """Proof-serving smoke: host/device proof parity, codec roundtrip,
+    chained-root verification, and the four forged variants all
+    rejected — the CI vehicle for the trustless-read surface."""
+    import dataclasses
+
+    from hyperdrive_tpu.exec import ExecutionConfig
+    from hyperdrive_tpu.exec.device import DeviceLedgerExecutor
+    from hyperdrive_tpu.exec.ledger import BlockSource, HostLedgerExecutor
+    from hyperdrive_tpu.parallel.service import (
+        STATUS_COMMITTED,
+        decode_proof,
+        encode_proof,
+    )
+
+    cfg = ExecutionConfig(
+        accounts=args.accounts,
+        txs_per_block=args.txs,
+        stake_every=3,
+        stake_accounts=min(4, args.accounts),
+        seed=args.seed,
+    )
+    src = BlockSource(cfg)
+    host = HostLedgerExecutor(cfg, source=src)
+    dev = DeviceLedgerExecutor(cfg, source=src)
+    for ex in (host, dev):
+        ex.advance_to(args.blocks)
+    if host.root != dev.root:
+        print("FAIL prove: host/device root mismatch", file=sys.stderr)
+        return 1
+    root = host.roots[args.blocks]
+    sample = sorted({0, args.accounts // 2, args.accounts - 1})
+    for account in sample:
+        hp, dp = host.prove(account), dev.prove(account)
+        if hp != dp:
+            print(
+                f"FAIL prove: host/device proof mismatch for account "
+                f"{account}",
+                file=sys.stderr,
+            )
+            return 1
+        _, _, wired = decode_proof(
+            encode_proof(1, STATUS_COMMITTED, hp)
+        )
+        if wired != hp:
+            print(
+                f"FAIL prove: proof frame for account {account} did "
+                f"not roundtrip the wire codec",
+                file=sys.stderr,
+            )
+            return 1
+        if not host.verify_inclusion(
+            root, account, wired.balance, wired.stake, wired
+        ):
+            print(
+                f"FAIL prove: honest proof for account {account} "
+                f"failed verification",
+                file=sys.stderr,
+            )
+            return 1
+    victim = host.prove(sample[-1])
+    forgeries = {
+        "stale-root": dataclasses.replace(
+            victim, prev_root=b"\x01" * 32
+        ),
+        "forged-sibling": dataclasses.replace(
+            victim, siblings=((1, 2, 3, 4),) + victim.siblings[1:]
+        ),
+        "truncated-path": dataclasses.replace(
+            victim, siblings=victim.siblings[:-1]
+        ),
+        "wrong-leaf": dataclasses.replace(
+            victim, balance=victim.balance + 1
+        ),
+    }
+    for name, bad in forgeries.items():
+        if host.verify_inclusion(
+            root, bad.account, bad.balance, bad.stake, bad
+        ):
+            print(
+                f"FAIL prove: {name} forgery verified", file=sys.stderr
+            )
+            return 1
+    print(
+        f"ok prove: {args.blocks} blocks, {len(sample)} accounts "
+        f"host==device, codec roundtrip, root verification, "
+        f"{len(forgeries)} forgeries rejected "
+        f"(depth={len(victim.siblings)})"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="python -m hyperdrive_tpu.exec")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -237,14 +338,26 @@ def main(argv=None) -> int:
         "speculate/resolve chains must equal the sequential chains, "
         "host_verify checkpoints included",
     )
-    p.set_defaults(fn=parity)
+    p.set_defaults(fn=parity, label="parity")
+
+    p = sub.add_parser(
+        "prove",
+        help="Merkle proof-serving smoke: host/device proof parity, "
+        "wire-codec roundtrip, chained-root verification, all four "
+        "forged variants rejected",
+    )
+    p.add_argument("--blocks", type=int, default=4)
+    p.add_argument("--accounts", type=int, default=32)
+    p.add_argument("--txs", type=int, default=24)
+    p.add_argument("--seed", type=int, default=7)
+    p.set_defaults(fn=prove, label="prove")
 
     args = ap.parse_args(argv)
     rc = args.fn(args)
     if rc == 0:
-        print("exec parity ok")
+        print(f"exec {args.label} ok")
     else:
-        print("exec parity FAILED", file=sys.stderr)
+        print(f"exec {args.label} FAILED", file=sys.stderr)
     return rc
 
 

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from hyperdrive_tpu.exec.ledger import HostLedgerExecutor, TxBlock
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
 from hyperdrive_tpu.ops import ledger as ops_ledger
+from hyperdrive_tpu.ops import merkle
 from hyperdrive_tpu.ops.rootmix import mix_matrix, root_bytes
 
 __all__ = ["DeviceLedgerExecutor"]
@@ -46,9 +47,16 @@ class DeviceLedgerExecutor(HostLedgerExecutor):
         #: the first apply (genesis root is a host sha256).
         self._droot = None
         #: Heights applied but not yet materialized host-side:
-        #: (height, root_words_tensor, applied_count_scalar).
+        #: (height, root_words_tensor, applied_count_scalar,
+        #: merkle_root_tensor, full_rebuild_flag).
         self._pending: list = []
         self._dmix = None
+        #: Device-resident account hash tree (tuple of uint32 levels,
+        #: ops/merkle.py ``build_tree_jax``) and last post-block state
+        #: digest — both created lazily like ``_droot`` and updated
+        #: inside the same fused launch as the apply.
+        self._dtree = None
+        self._ddigest = None
 
     def _state_bytes(self) -> bytes:
         bal = np.asarray(self._dbal, dtype=np.int64)
@@ -88,13 +96,19 @@ class DeviceLedgerExecutor(HostLedgerExecutor):
             self._droot = jnp.asarray(self._root_words)
         if self._dmix is None:
             self._dmix = jnp.asarray(mix_matrix(4 * self.config.accounts))
-        self._dbal, self._dstk, count, self._droot = (
-            ops_ledger._jitted_chain_cols()(
-                self._dbal, self._dstk, self._droot,
-                jnp.uint32(h & 0xFFFFFFFF), cols, self._dmix,
-            )
+        if self._dtree is None:
+            self._dtree = merkle.build_tree_jax(self._dbal, self._dstk)
+        full = 2 * cols.shape[1] >= self._dtree[0].shape[0]
+        (
+            self._dbal, self._dstk, count, self._droot,
+            self._ddigest, self._dtree,
+        ) = ops_ledger._jitted_chain_merkle_cols()(
+            self._dbal, self._dstk, self._droot, self._dtree,
+            jnp.uint32(h & 0xFFFFFFFF), cols, self._dmix,
         )
-        self._pending.append((h, self._droot, count))
+        self._pending.append(
+            (h, self._droot, count, self._dtree[-1][0], full)
+        )
         return None  # counters/roots materialize at sync()
 
     # ---- speculation hooks: snapshots are array refs (free)
@@ -102,10 +116,14 @@ class DeviceLedgerExecutor(HostLedgerExecutor):
     def _snapshot(self):
         if self._droot is None:
             self._droot = jnp.asarray(self._root_words)
-        return (self._dbal, self._dstk, self._droot)
+        if self._dtree is None:
+            self._dtree = merkle.build_tree_jax(self._dbal, self._dstk)
+        return (self._dbal, self._dstk, self._droot,
+                self._dtree, self._ddigest)
 
     def _restore(self, snap) -> None:
-        self._dbal, self._dstk, self._droot = snap
+        (self._dbal, self._dstk, self._droot,
+         self._dtree, self._ddigest) = snap
 
     def sync(self) -> None:
         """One fetch materializes every pending height's root and
@@ -118,9 +136,14 @@ class DeviceLedgerExecutor(HostLedgerExecutor):
             return
         import jax
 
-        fetched = jax.device_get([(p[1], p[2]) for p in self._pending])
+        fetched = jax.device_get(
+            [(p[1], p[2], p[3]) for p in self._pending]
+        )
         t = self.config.txs_per_block
-        for (h, _, _), (rw, c) in zip(self._pending, fetched):
+        depth = merkle.tree_depth(self.config.accounts)
+        for (h, _, _, _, full), (rw, c, mw) in zip(
+            self._pending, fetched
+        ):
             rb = root_bytes(rw)
             self.roots[h] = rb
             c = int(c)
@@ -134,9 +157,26 @@ class DeviceLedgerExecutor(HostLedgerExecutor):
                     "txs=%d applied=%d dev=1" % (t, c),
                 )
                 self.obs.emit("exec.root", h, -1, rb[:8].hex())
+                self.obs.emit(
+                    "merkle.root", h, -1,
+                    merkle.merkle_bytes(mw)[:8].hex(),
+                )
+                self.obs.emit(
+                    "merkle.update", h, -1,
+                    "targets=%d depth=%d full=%d"
+                    % (2 * t, depth, int(full)),
+                )
         self._pending.clear()
         self._root_words = np.asarray(fetched[-1][0], dtype=np.uint32)
         self.root = root_bytes(self._root_words)
+
+    def _proof_materials(self):
+        """Materialize the on-device tree and digest for proof
+        serving — a read-path fetch, never on the apply hot path."""
+        return (
+            [np.asarray(lvl) for lvl in self._dtree],
+            np.asarray(self._ddigest),
+        )
 
     # Host views for election_stakes / debugging: materialize on read.
     @property

@@ -10,14 +10,20 @@ ints alone (ScenarioRecord v7 stores no state).
 State roots chain like the commit chain itself:
 
   root_0   = sha256("exec-genesis" || pack(balances) || pack(stakes))
-  root_h   = fold(root_{h-1}, h, digest(state_h))      (8 uint32 words)
+  root_h   = fold(root_{h-1}, h,
+                  fold_merkle(digest(state_h), merkle(state_h)))
 
 where ``digest`` is the fixed-shape uint32 reduction over the packed
-state leaves and ``fold`` the per-height chain mix — both defined ONCE
-in ops/ledger.py with bit-identical numpy (host) and jnp (device)
-twins, so the device executor keeps the running root ON DEVICE between
+state leaves, ``merkle`` the account hash tree's root (ops/merkle.py,
+updated incrementally from the block's own scatter targets — PR 17),
+and ``fold`` the per-height chain mix — all defined ONCE in ops/ with
+bit-identical numpy (host) and jnp (device) twins, so the device
+executor keeps the running root AND the hash tree ON DEVICE between
 heights (no per-block host hash hop) and still chains byte-equal to
-the host reference. ``pack`` stays 8-byte little-endian signed per
+the host reference. Because ``root_h`` commits the Merkle root,
+``prove(account)`` yields an O(log n) inclusion proof any stateless
+client can check against the certificate chain
+(``verify_inclusion``). ``pack`` stays 8-byte little-endian signed per
 account (the word split mirrors it lo/hi), the root stays 32 bytes,
 and the genesis root stays sha256. The reduction is linear-algebraic,
 not cryptographic: integrity of the running chain is re-derived
@@ -50,6 +56,17 @@ import numpy as np
 from hyperdrive_tpu.devsched.queue import VerifyLauncher
 from hyperdrive_tpu.exec import ExecutionConfig
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
+from hyperdrive_tpu.ops.merkle import (
+    MerkleProof,
+    build_tree_np,
+    fold_merkle_np,
+    merkle_bytes,
+    merkle_root_np,
+    prove_np,
+    tree_depth,
+    update_tree_np,
+    verify_inclusion,
+)
 from hyperdrive_tpu.ops.rootmix import (
     fold_root_np,
     root_bytes,
@@ -64,6 +81,7 @@ __all__ = [
     "TxBlock",
     "BlockSource",
     "HostLedgerExecutor",
+    "ProofBasis",
     "ExecApplyLauncher",
 ]
 
@@ -388,6 +406,12 @@ class HostLedgerExecutor:
     def _init_state(self, balances, stakes):
         self.balances = balances
         self.stakes = stakes
+        #: The account hash tree (ops/merkle.py numpy twin), updated
+        #: in place along the dirty root-paths each block.
+        self._tree = build_tree_np(balances, stakes)
+        #: Post-block state digest of the last applied height — the
+        #: O(1) witness words a proof carries (None until height 1).
+        self._last_digest = None
 
     def _state_bytes(self) -> bytes:
         return pack_state(self.balances) + pack_state(self.stakes)
@@ -435,16 +459,23 @@ class HostLedgerExecutor:
     # ---- speculation hooks (overridden by the device executor)
 
     def _snapshot(self):
-        """Pre-height state capture for rollback. Host: list copies.
+        """Pre-height state capture for rollback. Host: list copies
+        (the tree's dirty-set snapshot rides along level by level).
         Device: immutable array refs (free)."""
         return (list(self.balances), list(self.stakes),
-                self.root, self._root_words)
+                self.root, self._root_words,
+                [lvl.copy() for lvl in self._tree], self._last_digest)
 
     def _restore(self, snap) -> None:
         self.balances = list(snap[0])
         self.stakes = list(snap[1])
         self.root = snap[2]
         self._root_words = snap[3]
+        # Copy again: the restored tree mutates in place from here, and
+        # the snapshot may be re-read (a re-speculated window can roll
+        # back twice against the same capture).
+        self._tree = [lvl.copy() for lvl in snap[4]]
+        self._last_digest = snap[5]
 
     def sync(self) -> None:
         """Materialize any device-pending roots/counters host-side.
@@ -456,9 +487,16 @@ class HostLedgerExecutor:
         device-pending (materialized at :meth:`sync`)."""
         applied = self._apply_block(blk, ok)
         d = state_digest_np(self.balances, self.stakes)
-        self._root_words = fold_root_np(self._root_words, h, d)
+        # Dirty set = the block's scatter targets verbatim (rejected
+        # rows recompute clean leaves idempotently — same rule as the
+        # fused device kernel, so the trees stay bit-identical).
+        dirty = np.concatenate([blk._np[1], blk._np[2]])
+        update_tree_np(self._tree, self.balances, self.stakes, dirty)
+        folded = fold_merkle_np(d, merkle_root_np(self._tree))
+        self._root_words = fold_root_np(self._root_words, h, folded)
         self.root = root_bytes(self._root_words)
         self.roots[h] = self.root
+        self._last_digest = d
         return applied
 
     # ---- the public surface
@@ -511,6 +549,15 @@ class HostLedgerExecutor:
                 % (len(blk), applied, int(self.device)),
             )
             self.obs.emit("exec.root", h, -1, self.root[:8].hex())
+            self.obs.emit(
+                "merkle.root", h, -1,
+                merkle_bytes(merkle_root_np(self._tree))[:8].hex(),
+            )
+            self.obs.emit(
+                "merkle.update", h, -1,
+                "targets=%d depth=%d full=0"
+                % (2 * len(blk), tree_depth(self.config.accounts)),
+            )
 
     # ---- speculative pipelining
 
@@ -634,12 +681,97 @@ class HostLedgerExecutor:
                 if self.height > 1 else self.genesis_root
             )
             d = state_digest_np(self.balances, self.stakes)
-            want = root_bytes(fold_root_np(root_words(prev), self.height, d))
+            # Full O(n) tree rebuild from fetched state: the
+            # incrementally-maintained Merkle root must equal it, and
+            # the chain fold must re-derive byte-for-byte.
+            full = merkle_root_np(build_tree_np(self.balances, self.stakes))
+            tree, _ = self._proof_materials()
+            if merkle_bytes(full) != merkle_bytes(merkle_root_np(tree)):
+                raise AssertionError(
+                    f"incremental Merkle root diverged from full rebuild "
+                    f"at height {self.height}"
+                )
+            want = root_bytes(
+                fold_root_np(
+                    root_words(prev), self.height, fold_merkle_np(d, full)
+                )
+            )
         if want != self.root:
             raise AssertionError(
                 f"state-root checkpoint mismatch at height {self.height}"
             )
         return self.root
+
+    # ---- inclusion proofs (the trustless read path)
+
+    def _proof_materials(self):
+        """(tree levels as numpy, last digest as numpy) — the device
+        executor overrides to materialize its on-device copies."""
+        return self._tree, self._last_digest
+
+    def prove(self, account: int) -> MerkleProof:
+        """O(log n) inclusion proof for ``account`` at the current
+        settled height: leaf values, sibling path, and the O(1) chain
+        witness (previous root + state digest) a stateless client
+        needs to check it against a certificate-chain root with
+        :meth:`verify_inclusion`. Proofs serve SETTLED chain only —
+        an open speculation window refuses (its root could roll
+        back)."""
+        self.sync()
+        if self._spec:
+            raise RuntimeError(
+                "prove() with an open speculation window — resolve "
+                "speculation first (a speculative root may roll back)"
+            )
+        h = self.height
+        if h < 1:
+            raise ValueError("no applied height to prove against")
+        if not 0 <= account < self.config.accounts:
+            raise ValueError(
+                f"account {account} outside 0..{self.config.accounts - 1}"
+            )
+        tree, digest = self._proof_materials()
+        prev = self.roots[h - 1] if h > 1 else self.genesis_root
+        return MerkleProof(
+            height=h,
+            account=account,
+            balance=int(self.balances[account]),
+            stake=int(self.stakes[account]),
+            prev_root=prev,
+            digest=tuple(int(w) for w in digest),
+            siblings=prove_np(tree, account),
+        )
+
+    def proof_basis(self) -> "ProofBasis":
+        """Freeze the current settled height into a :class:`ProofBasis`
+        — an O(n) copy the proof-serving path (parallel/service.py)
+        takes ONCE per accepted certificate, so queries never touch the
+        live executor (which may be speculated ahead of the last
+        certified height by the time a query lands)."""
+        self.sync()
+        if self._spec:
+            raise RuntimeError(
+                "proof_basis() with an open speculation window — "
+                "resolve speculation first"
+            )
+        h = self.height
+        if h < 1:
+            raise ValueError("no applied height to serve proofs from")
+        tree, digest = self._proof_materials()
+        prev = self.roots[h - 1] if h > 1 else self.genesis_root
+        return ProofBasis(
+            height=h,
+            accounts=self.config.accounts,
+            prev_root=prev,
+            digest=tuple(int(w) for w in digest),
+            tree=[np.array(lvl, copy=True) for lvl in tree],
+            balances=[int(v) for v in self.balances],
+            stakes=[int(v) for v in self.stakes],
+        )
+
+    #: The client-side check, re-exported so light clients and tests
+    #: reach it without importing ops/ directly.
+    verify_inclusion = staticmethod(verify_inclusion)
 
     def _mask_for(self, h: int, blk: TxBlock):
         if not self.config.sign_txs:
@@ -661,6 +793,46 @@ class HostLedgerExecutor:
         member never leaves candidacy (ROBUSTNESS.md)."""
         floor = self.config.stake_floor
         return tuple(int(self.stakes[i]) + floor for i in range(n))
+
+
+class ProofBasis:
+    """A frozen proof-serving snapshot of ONE settled height: the tree,
+    leaf values, and O(1) chain witness (previous root + state digest),
+    copied out of an executor by :meth:`HostLedgerExecutor.proof_basis`.
+    Serving a proof from a basis is pure numpy indexing — O(log n), no
+    executor locks, no interaction with speculation — so the service
+    port can answer read storms while the executor runs ahead."""
+
+    __slots__ = ("height", "accounts", "prev_root", "digest", "tree",
+                 "balances", "stakes")
+
+    def __init__(self, *, height, accounts, prev_root, digest, tree,
+                 balances, stakes):
+        self.height = height
+        self.accounts = accounts
+        self.prev_root = prev_root
+        self.digest = digest
+        self.tree = tree
+        self.balances = balances
+        self.stakes = stakes
+
+    def prove(self, account: int) -> MerkleProof:
+        """O(log n) inclusion proof for ``account`` at this basis's
+        height — same shape :meth:`HostLedgerExecutor.prove` returns,
+        minus any dependence on live executor state."""
+        if not 0 <= account < self.accounts:
+            raise ValueError(
+                f"account {account} outside 0..{self.accounts - 1}"
+            )
+        return MerkleProof(
+            height=self.height,
+            account=account,
+            balance=self.balances[account],
+            stake=self.stakes[account],
+            prev_root=self.prev_root,
+            digest=self.digest,
+            siblings=prove_np(self.tree, account),
+        )
 
 
 class ExecApplyLauncher(VerifyLauncher):

@@ -42,7 +42,7 @@ from __future__ import annotations
 
 import threading
 
-from hyperdrive_tpu.load.frames import FRESH, classify_frame
+from hyperdrive_tpu.load.frames import FRESH, QUERY, classify_frame
 from hyperdrive_tpu.messages import Prevote
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
@@ -68,10 +68,14 @@ LEVEL_NAMES = ("accept", "shed_duplicates", "shed_low_priority",
 
 #: The closed shed-class vocabulary (ROBUSTNESS.md "Overload doctrine").
 #: ``duplicate`` / ``stale_height`` are behavior-neutral; ``low_priority``
-#: / ``panic`` trade prevote liveness for survival. There is deliberately
+#: / ``panic`` trade prevote liveness for survival; ``query`` is the
+#: read path — proof queries shed from SHED_LOW_PRIORITY up, always
+#: ahead of any consensus frame (reads are idempotent and retryable, so
+#: a read storm must never starve certificates). There is deliberately
 #: no class for proposals, precommits, or certificates — they are never
 #: shed, and the soak asserts the counters for them stay absent.
-SHED_CLASSES = ("duplicate", "stale_height", "low_priority", "panic")
+SHED_CLASSES = ("duplicate", "stale_height", "low_priority", "panic",
+                "query")
 
 # Classification (duplicate / stale detection and the dedup key shape)
 # is shared with the overlay contribution scorer through
@@ -309,6 +313,17 @@ class AdmissionGate:
             self._admitted()
             return True
         level = self.controller.level
+        if cls is QUERY:
+            # Read path: queries are the FIRST sheddable class once
+            # load crosses SHED_LOW_PRIORITY — before any fresh vote,
+            # and always before certificates (which classify keyless
+            # above and never reach here). Admitted queries are not
+            # remembered: reads dedup to nothing and must not evict
+            # vote keys from the bounded memory.
+            if level >= SHED_LOW_PRIORITY:
+                return self._shed(msg, "query")
+            self._admitted()
+            return True
         if level >= SHED_DUPLICATES and cls is not FRESH:
             # cls is the shed class verbatim: the classifier's closed
             # vocabulary intersects SHED_CLASSES on exactly the two

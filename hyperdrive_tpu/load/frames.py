@@ -27,9 +27,17 @@ The classes form a closed vocabulary (mirroring ``SHED_CLASSES``):
     the frame's height (epochs.py key retirement) — checked FIRST and
     for every message kind, because a retired key is invalid regardless
     of freshness.
+``QUERY``
+    a read-path proof query (:class:`QueryFrame` — the service port's
+    TAG_QUERY ingress). Always sheddable at SHED_LOW_PRIORITY and
+    above: reads are idempotent and retryable, so a read storm must
+    never displace consensus traffic — certificates and precommits
+    outrank queries by doctrine.
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass
 
 from hyperdrive_tpu.messages import Precommit, Prevote, Propose
 
@@ -38,7 +46,9 @@ __all__ = [
     "DUPLICATE",
     "STALE_HEIGHT",
     "STALE_GENERATION",
+    "QUERY",
     "FRAME_CLASSES",
+    "QueryFrame",
     "classify_frame",
 ]
 
@@ -46,9 +56,24 @@ FRESH = "fresh"
 DUPLICATE = "duplicate"
 STALE_HEIGHT = "stale_height"
 STALE_GENERATION = "stale_generation"
+QUERY = "query"
 
 #: The closed classification vocabulary, in check order.
-FRAME_CLASSES = (STALE_GENERATION, STALE_HEIGHT, DUPLICATE, FRESH)
+FRAME_CLASSES = (STALE_GENERATION, QUERY, STALE_HEIGHT, DUPLICATE, FRESH)
+
+
+@dataclass(frozen=True)
+class QueryFrame:
+    """One proof query at an admission gate: the lightweight stand-in
+    the service port classifies before any ledger work happens. Carries
+    no sender identity (stateless clients are anonymous to the gate —
+    fairness attribution uses the connection's tenant as ``peer``)."""
+
+    account: int
+    height: int = -1
+    round: int = -1
+    sender: bytes | None = None
+
 
 #: Message-type tags for dedup keys (stable across runs, unlike id()).
 _TAG = {Propose: 0, Prevote: 1, Precommit: 2}
@@ -76,6 +101,11 @@ def classify_frame(msg, *, seen=None, height_fn=None, retired=None):
         if bad_from is not None and getattr(msg, "height", -1) >= bad_from:
             return STALE_GENERATION, None
     t = type(msg)
+    if t is QueryFrame:
+        # Reads carry a key (so the gate treats them as sheddable) but
+        # are never deduplicated: an identical re-query after a shed is
+        # the client doing exactly what the retry doctrine tells it to.
+        return QUERY, ("query", msg.account)
     tag = _TAG.get(t)
     if tag is None or t is Propose:
         return FRESH, None

@@ -6,7 +6,8 @@ benchdiff.
                (``--tenants`` for per-origin device-launch latency,
                ``--overload`` for admission/shed posture,
                ``--overlay`` for aggregation-overlay posture,
-               ``--exec`` for execution-layer/state-root posture)
+               ``--exec`` for execution-layer/state-root posture,
+               ``--proofs`` for trustless-read/Merkle posture)
     export     convert a saved journal to Perfetto/Chrome trace JSON
     metrics    run a short observed sim, print its metrics-registry
                snapshot (JSON; ``--prometheus FILE`` for exposition text)
@@ -33,7 +34,9 @@ from hyperdrive_tpu.obs.report import (
     overlay_summary,
     overload_summary,
     phase_summary,
+    proofs_summary,
     render_exec_table,
+    render_proofs_table,
     render_overlay_table,
     render_overload_table,
     render_table,
@@ -84,6 +87,19 @@ def _cmd_report(ns):
                   "(record an execution run: Simulation(execution=...))")
             return 1
         print(render_exec_table(summary))
+        return 0
+    if ns.proofs:
+        summary = proofs_summary(journal["events"])
+        if ns.json:
+            print(json.dumps({"proofs": summary}, indent=1))
+            return 0
+        if not (summary["served"] or summary["shed"]
+                or summary["updates"] or summary["merkle_roots"]):
+            print("no merkle.*/proof.* events in journal window "
+                  "(record an execution run and serve queries through "
+                  "the service port)")
+            return 1
+        print(render_proofs_table(summary))
         return 0
     if ns.overlay:
         summary = overlay_summary(journal["events"])
@@ -269,6 +285,14 @@ def main(argv=None):
         help="execution-layer posture summary instead "
              "(the closed exec.* family: applied blocks, state-root "
              "agreement, epoch stake snapshots)",
+    )
+    rep.add_argument(
+        "--proofs",
+        action="store_true",
+        help="trustless-read posture summary instead "
+             "(the closed merkle.*/proof.* families: proofs served vs "
+             "shed, frame sizes, incremental-update posture, per-height "
+             "Merkle-root agreement)",
     )
     rep.set_defaults(fn=_cmd_report)
 

@@ -171,6 +171,20 @@ EVENT_KINDS = frozenset(
         "exec.spec.speculate",
         "exec.spec.confirm",
         "exec.spec.rollback",
+        # Merkleized state (ops/merkle.py via both executors): one mark
+        # per applied block's account-tree root and one per incremental
+        # update (detail: scatter-target count, tree depth, whether the
+        # kernel fell back to a full rebuild). Closed family — the lint
+        # (HD005), the --proofs report decoder, and OBSERVABILITY.md
+        # enumerate exactly these.
+        "merkle.root",
+        "merkle.update",
+        # Proof serving (parallel/service.py TAG_QUERY path): one mark
+        # per proof frame served (detail: account, frame bytes) and one
+        # per query shed by the admission gate (detail: tenant). Closed
+        # family — same three consumers as merkle.*.
+        "proof.serve",
+        "proof.shed",
     }
 )
 

@@ -28,6 +28,8 @@ __all__ = [
     "render_overlay_table",
     "exec_summary",
     "render_exec_table",
+    "proofs_summary",
+    "render_proofs_table",
 ]
 
 _TIMEOUT_FIRES = (
@@ -677,6 +679,131 @@ def render_exec_table(summary):
             + (f" (+{len(summary['stake_marks']) - 6} more)"
                if len(summary["stake_marks"]) > 6 else "")
         )
+    return "\n".join(lines)
+
+
+def proofs_summary(events):
+    """Trustless-read posture from the journal alone.
+
+    Decodes the closed ``merkle.*`` / ``proof.*`` families
+    (obs/recorder.py): how many proof frames the port served vs shed
+    (and at what sizes), how the incremental tree kept up (updates vs
+    full-rebuild fallbacks), and whether every replica that reported a
+    Merkle root at a height reported the SAME one — a Merkle-root fork
+    is state divergence even when the chained exec roots still agree.
+    """
+    out = {
+        "served": 0,
+        "shed": 0,
+        "bytes_min": None,
+        "bytes_max": None,
+        "bytes_mean": None,
+        "served_heights": {},  # basis height -> frames served
+        "shed_tenants": {},  # tenant -> queries shed
+        "updates": 0,
+        "full_rebuilds": 0,
+        "max_targets": 0,
+        "depth": None,
+        "merkle_roots": {},  # height -> {root8 -> [replicas]}
+        "merkle_forks": [],  # heights with >1 distinct Merkle root
+    }
+    byte_total = 0
+    for ev in events:
+        replica, height, kind, detail = ev[1], ev[2], ev[4], ev[5]
+        if kind == "proof.serve":
+            out["served"] += 1
+            out["served_heights"][height] = (
+                out["served_heights"].get(height, 0) + 1
+            )
+            for part in str(detail or "").split():
+                if part.startswith("bytes="):
+                    b = int(part[6:])
+                    byte_total += b
+                    out["bytes_min"] = (
+                        b if out["bytes_min"] is None
+                        else min(out["bytes_min"], b)
+                    )
+                    out["bytes_max"] = (
+                        b if out["bytes_max"] is None
+                        else max(out["bytes_max"], b)
+                    )
+        elif kind == "proof.shed":
+            out["shed"] += 1
+            tenant = str(detail or "")
+            out["shed_tenants"][tenant] = (
+                out["shed_tenants"].get(tenant, 0) + 1
+            )
+        elif kind == "merkle.root":
+            root8 = str(detail or "")
+            by_root = out["merkle_roots"].setdefault(height, {})
+            by_root.setdefault(root8, []).append(replica)
+        elif kind == "merkle.update":
+            out["updates"] += 1
+            for part in str(detail or "").split():
+                if part.startswith("targets="):
+                    out["max_targets"] = max(
+                        out["max_targets"], int(part[8:])
+                    )
+                elif part.startswith("depth="):
+                    out["depth"] = int(part[6:])
+                elif part.startswith("full=") and int(part[5:]):
+                    out["full_rebuilds"] += 1
+    if out["served"]:
+        out["bytes_mean"] = byte_total / out["served"]
+    out["merkle_forks"] = sorted(
+        h for h, by_root in out["merkle_roots"].items()
+        if len(by_root) > 1
+    )
+    return out
+
+
+def render_proofs_table(summary):
+    """The proofs summary as aligned text (the CLI's ``--proofs``)."""
+    lines = [
+        f"{summary['served']} proofs served · "
+        f"{summary['shed']} queries shed"
+    ]
+    if summary["served"]:
+        lines.append(
+            f"proof frames: {summary['bytes_min']}"
+            f"/{summary['bytes_mean']:.0f}/{summary['bytes_max']} "
+            "bytes (min/mean/max)"
+        )
+        rows = [["basis height", "served"]]
+        for h in sorted(summary["served_heights"]):
+            rows.append([str(h), str(summary["served_heights"][h])])
+        widths = [max(len(r[i]) for r in rows) for i in range(2)]
+        for i, r in enumerate(rows):
+            lines.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+    if summary["shed_tenants"]:
+        lines.append(
+            "shed by tenant: "
+            + " · ".join(
+                f"{t}={n}"
+                for t, n in sorted(summary["shed_tenants"].items())
+            )
+        )
+    if summary["updates"]:
+        lines.append(
+            f"merkle updates: {summary['updates']} "
+            f"({summary['full_rebuilds']} full rebuilds) · "
+            f"max targets {summary['max_targets']} · "
+            f"tree depth {summary['depth']}"
+        )
+    roots = summary["merkle_roots"]
+    if roots:
+        agreed = len(roots) - len(summary["merkle_forks"])
+        lines.append(
+            f"merkle roots: {len(roots)} heights reported · "
+            f"{agreed} unanimous"
+        )
+        if summary["merkle_forks"]:
+            lines.append(
+                "MERKLE ROOT FORKS at heights: "
+                + ", ".join(str(h) for h in summary["merkle_forks"])
+            )
     return "\n".join(lines)
 
 

@@ -56,6 +56,7 @@ __all__ = [
     "fold_root_np",
     "apply_block_chain_jax",
     "apply_block_chain_cols_jax",
+    "apply_block_chain_merkle_cols_jax",
     "pack_block_cols",
 ]
 
@@ -213,6 +214,55 @@ def apply_block_chain_cols_jax(balances, stakes, root_words, height_u32, cols, m
 @functools.cache
 def _jitted_chain_cols():
     return jax.jit(apply_block_chain_cols_jax)
+
+
+def apply_block_chain_merkle_cols_jax(
+    balances, stakes, root_words, tree, height_u32, cols, mix
+):
+    """The Merkleized pipeline step (PR 17): apply one packed block,
+    incrementally update the hash tree from the block's own scatter
+    targets, and fold digest + Merkle root into the running chained
+    root — all one launch, so per-account provability costs no extra
+    dispatch over the PR 16 chain.
+
+    Args beyond :func:`apply_block_chain_cols_jax`:
+      tree: tuple of uint32 [p >> d, NODE_WORDS] levels
+            (ops/merkle.py ``build_tree_jax``).
+
+    Returns ``(new_bal, new_stk, count, new_root, digest, new_tree)``
+    — ``digest`` is the post-block state digest (the proof witness),
+    ``new_tree`` the updated level tuple. The Merkle root is
+    ``new_tree[-1][0]``.
+
+    The dirty set is the sender and recipient columns verbatim — pad
+    rows point at account 0 and rejected rows leave state unchanged,
+    so their leaf recomputations are idempotent no-ops. When the block
+    touches at least as many lanes as the tree has leaves, the full
+    log-depth rebuild is cheaper than per-path scatters; the choice is
+    made at trace time (both branches fixed-shape).
+    """
+    from hyperdrive_tpu.ops import merkle
+
+    new_bal, new_stk, applied = apply_block_jax(
+        balances, stakes, cols[0], cols[1], cols[2], cols[3],
+        cols[4].astype(bool),
+    )
+    w = _state_words_jax(new_bal, new_stk)
+    digest = (w[:, None] * mix).sum(axis=0, dtype=jnp.uint32)
+    if 2 * cols.shape[1] >= tree[0].shape[0]:
+        new_tree = merkle.build_tree_jax(new_bal, new_stk)
+    else:
+        dirty = jnp.concatenate([cols[1], cols[2]])
+        new_tree = merkle.update_tree_jax(tree, new_bal, new_stk, dirty)
+    folded = merkle.fold_merkle_jax(digest, new_tree[-1][0])
+    new_root = _fold_root_jax(root_words, height_u32, folded)
+    count = applied.astype(jnp.int32).sum()
+    return new_bal, new_stk, count, new_root, digest, new_tree
+
+
+@functools.cache
+def _jitted_chain_merkle_cols():
+    return jax.jit(apply_block_chain_merkle_cols_jax)
 
 
 def pack_block_cols(kind, sender, recipient, amount, sig_ok=None,

@@ -1,0 +1,372 @@
+"""Device-Merkleized account state: incremental hash tree + inclusion proofs.
+
+PR 16's chained root proves whole-state equality only — nobody can check
+ONE account without refetching all of them. This module Merkleizes the
+packed account vector: a binary hash tree over the 8-byte-LE account
+leaves, built as log-depth fixed-shape reductions in the one-launch
+idiom (leaves padded to a power of two, every level one elementwise
+combine), so each committed root supports O(log n) per-account
+inclusion proofs.
+
+The perf core is the **incremental update**: per-block apply marks the
+dirty leaves straight from the scatter targets (sender + recipient
+columns — pad rows point at account 0, and recomputing a clean leaf is
+idempotent, so no mask is needed) and recomputes only the touched
+root-paths: O(k log n) scatter/gather work instead of the O(n) full
+rebuild, fused into the same launch as the block apply and the chain
+fold (ops/ledger.py) so the Merkle root rides the device-resident root
+chain with no extra dispatch.
+
+Node arithmetic is NODE_WORDS uint32 lanes through the lowbias32
+finalizer, mod 2^32, shared bit-identically by the NUMPY twin here
+(host reference executor, light clients, chaos soak — all jax-free)
+and the jnp twin (``*_jax``) the device kernel fuses. Like the root
+chain it feeds (``fold_merkle`` -> ``fold_root``), this is
+linear-algebraic, NOT a cryptographic hash — ROBUSTNESS.md
+"Proof-serving doctrine" states the trust envelope.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from hyperdrive_tpu.ops.rootmix import (
+    ROOT_WORDS,
+    FMIX_A,
+    FMIX_B,
+    fold_root_np,
+    root_bytes,
+    root_words,
+)
+
+__all__ = [
+    "NODE_WORDS",
+    "MerkleProof",
+    "tree_depth",
+    "leaf_count",
+    "build_tree_np",
+    "update_tree_np",
+    "merkle_root_np",
+    "merkle_bytes",
+    "fold_merkle_np",
+    "prove_np",
+    "fold_path_np",
+    "verify_inclusion",
+    "build_tree_jax",
+    "update_tree_jax",
+    "fold_merkle_jax",
+]
+
+_M32 = 0xFFFFFFFF
+
+#: Every tree node is 4 little-endian uint32 words = 16 bytes, half the
+#: chain-root width: a depth-17 proof (131072 accounts) is 272 bytes of
+#: siblings, and the per-level device combine stays a 4-lane elementwise
+#: op.
+NODE_WORDS = 4
+
+#: Leaf/combine multipliers (murmur3 c1/c2 and finalizer-family odd
+#: constants, disjoint from the rootmix chain-fold set so a leaf can
+#: never alias a fold term). Shared by the numpy and jnp twins.
+LEAF_FOLD = 0xCC9E2D51
+LEAF_IDX = 0x1B873593
+SIB_LEFT = 0x85EBCA6B
+SIB_RIGHT = 0xC2B2AE35
+MERKLE_FOLD = 0x27D4EB2F
+
+
+def leaf_count(accounts: int) -> int:
+    """Leaves are padded to the next power of two (min 1) so every
+    level halves exactly — the fixed-shape ladder of the build."""
+    return 1 if accounts <= 1 else 1 << (accounts - 1).bit_length()
+
+
+def tree_depth(accounts: int) -> int:
+    """Number of combine levels (== sibling-path length) for a ledger
+    of ``accounts`` accounts."""
+    return (leaf_count(accounts) - 1).bit_length()
+
+
+# ------------------------------------------------------------- numpy twin
+
+
+def _fmix_np(x: np.ndarray) -> np.ndarray:
+    x = np.asarray(x, dtype=np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = (x * np.uint32(FMIX_A)).astype(np.uint32)
+    x = x ^ (x >> np.uint32(15))
+    x = (x * np.uint32(FMIX_B)).astype(np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def leaf_words_np(idx, balances, stakes) -> np.ndarray:
+    """Leaf nodes for accounts ``idx``: the (lo, hi) uint32 words of the
+    8-byte-LE signed balance and stake (hi = sign extension, exactly the
+    ``pack_state`` bytes) salted by account index and lane, finalized.
+    Returns uint32[K, NODE_WORDS]."""
+    idx = np.asarray(idx, dtype=np.uint32)
+    b = np.asarray(balances, dtype=np.int32)
+    s = np.asarray(stakes, dtype=np.int32)
+    w = np.stack(
+        [
+            b.astype(np.uint32),
+            (b >> 31).astype(np.uint32),
+            s.astype(np.uint32),
+            (s >> 31).astype(np.uint32),
+        ],
+        axis=-1,
+    )
+    k = np.arange(NODE_WORDS, dtype=np.uint32)
+    return _fmix_np(
+        w * np.uint32(LEAF_FOLD) + idx[:, None] * np.uint32(LEAF_IDX) + k
+    )
+
+
+def combine_np(left, right) -> np.ndarray:
+    """Parent nodes from child pairs — position-asymmetric (left and
+    right multiply by different constants) so a swapped sibling can
+    never reproduce the parent. uint32[K, NODE_WORDS] each side."""
+    left = np.asarray(left, dtype=np.uint32)
+    right = np.asarray(right, dtype=np.uint32)
+    k = np.arange(NODE_WORDS, dtype=np.uint32)
+    return _fmix_np(
+        left * np.uint32(SIB_LEFT) + right * np.uint32(SIB_RIGHT) + k
+    )
+
+
+def build_tree_np(balances, stakes) -> list:
+    """Full O(n) rebuild: list of levels, leaves first, uint32
+    [p >> d, NODE_WORDS] each, root level last ([1, NODE_WORDS]).
+    Pad leaves are real leaves of zero-balance zero-stake accounts at
+    their padded index — deterministic and never dirtied."""
+    b = np.asarray(balances, dtype=np.int32)
+    s = np.asarray(stakes, dtype=np.int32)
+    p = leaf_count(b.shape[0])
+    if p != b.shape[0]:
+        b = np.pad(b, (0, p - b.shape[0]))
+        s = np.pad(s, (0, p - s.shape[0]))
+    levels = [leaf_words_np(np.arange(p, dtype=np.uint32), b, s)]
+    while levels[-1].shape[0] > 1:
+        cur = levels[-1]
+        levels.append(combine_np(cur[0::2], cur[1::2]))
+    return levels
+
+
+def update_tree_np(tree: list, balances, stakes, dirty_idx) -> list:
+    """Incremental O(k log n) update IN PLACE: recompute the dirty
+    leaves from post-block state and walk only the touched root-paths
+    up. Duplicate / already-clean indices are idempotent (a clean leaf
+    recomputes to itself), so callers pass raw scatter targets.
+    Returns ``tree`` for chaining."""
+    idx = np.unique(np.asarray(dirty_idx, dtype=np.int64))
+    b = np.asarray(balances, dtype=np.int32)
+    s = np.asarray(stakes, dtype=np.int32)
+    tree[0][idx] = leaf_words_np(idx.astype(np.uint32), b[idx], s[idx])
+    for d in range(1, len(tree)):
+        idx = np.unique(idx >> 1)
+        child = tree[d - 1]
+        tree[d][idx] = combine_np(child[2 * idx], child[2 * idx + 1])
+    return tree
+
+
+def merkle_root_np(tree) -> np.ndarray:
+    """uint32[NODE_WORDS] — the tree's root node."""
+    return np.asarray(tree[-1][0], dtype=np.uint32)
+
+
+def merkle_bytes(words) -> bytes:
+    """uint32[NODE_WORDS] -> the canonical 16-byte little-endian form
+    (the obs/report rendering; the wire carries the words)."""
+    return np.asarray(words, dtype=np.uint32).astype("<u4").tobytes()
+
+
+def fold_merkle_np(digest_words, merkle_words) -> np.ndarray:
+    """Chain the Merkle root into the state digest BEFORE the height
+    fold: digest'_k = fmix(digest_k * C + merkle_{k mod 4} + k). Both
+    executors fold this way, so ``root_h`` commits the tree and the
+    flat digest together and a light client can rebind a proof to the
+    certificate chain with O(1) extra witness words."""
+    d = np.asarray(digest_words, dtype=np.uint32)
+    m = np.asarray(merkle_words, dtype=np.uint32)
+    k = np.arange(ROOT_WORDS, dtype=np.uint32)
+    return _fmix_np(d * np.uint32(MERKLE_FOLD) + m[k % NODE_WORDS] + k)
+
+
+def prove_np(tree, account: int) -> tuple:
+    """O(log n) sibling path for ``account``, leaf level upward: a
+    tuple of NODE_WORDS-int tuples, one per level below the root."""
+    sibs = []
+    i = int(account)
+    for d in range(len(tree) - 1):
+        sibs.append(tuple(int(w) for w in tree[d][i ^ 1]))
+        i >>= 1
+    return tuple(sibs)
+
+
+def fold_path_np(leaf, account: int, siblings) -> np.ndarray:
+    """Walk a sibling path from ``leaf`` back to the Merkle root —
+    the light-client side of :func:`prove_np`. uint32[NODE_WORDS]."""
+    cur = np.asarray(leaf, dtype=np.uint32).reshape(1, NODE_WORDS)
+    i = int(account)
+    for sib in siblings:
+        sib = np.asarray(sib, dtype=np.uint32).reshape(1, NODE_WORDS)
+        cur = combine_np(cur, sib) if i % 2 == 0 else combine_np(sib, cur)
+        i >>= 1
+    return cur[0]
+
+
+@dataclass(frozen=True)
+class MerkleProof:
+    """Everything a stateless client needs to check one account against
+    a trusted chained root: the claimed height, the previous chained
+    root and post-block state digest as O(1) witness words, and the
+    O(log n) sibling path. The client recomputes
+
+      root'_h = fold_root(prev_root, h, fold_merkle(digest, path(leaf)))
+
+    and compares against the certificate-chain root — zero trust in
+    the serving replica."""
+
+    height: int
+    account: int
+    balance: int
+    stake: int
+    prev_root: bytes  # 32 bytes — root_{h-1}
+    digest: tuple  # ROOT_WORDS ints — post-block state digest
+    siblings: tuple  # depth × NODE_WORDS-int tuples, leaf level first
+
+
+#: Paths longer than this are rejected before any arithmetic — 2^64
+#: accounts bounds every honest tree, so an attacker can't stall a
+#: client with a mile-long forged path.
+MAX_DEPTH = 64
+
+
+def verify_inclusion(root: bytes, account: int, balance: int, stake: int,
+                     proof: MerkleProof) -> bool:
+    """True iff ``proof`` binds (account, balance, stake) into the
+    trusted chained root ``root``. Detects stale roots (old-height
+    witness against a fresh root), forged siblings, truncated paths,
+    and wrong-leaf values — each perturbs the recomputed fold."""
+    if (
+        not isinstance(proof, MerkleProof)
+        or proof.height < 1
+        or account < 0
+        or len(proof.prev_root) != 32
+        or len(proof.digest) != ROOT_WORDS
+        or len(proof.siblings) > MAX_DEPTH
+        or account >> len(proof.siblings)
+    ):
+        return False
+    leaf = leaf_words_np(
+        np.asarray([account], dtype=np.uint32), [balance], [stake]
+    )[0]
+    mroot = fold_path_np(leaf, account, proof.siblings)
+    folded = fold_merkle_np(
+        np.asarray(proof.digest, dtype=np.uint32), mroot
+    )
+    r = fold_root_np(root_words(proof.prev_root), proof.height, folded)
+    return root_bytes(r) == root
+
+
+# --------------------------------------------------------------- jnp twin
+#
+# Imported lazily by ops/ledger.py's fused kernel; everything below
+# mirrors the numpy twin mod 2^32 bit-for-bit. Kept in one module so a
+# constant can never drift between the twins.
+
+
+def _fmix_jax(x):
+    import jax.numpy as jnp
+
+    x = x ^ jnp.right_shift(x, 16)
+    x = x * jnp.uint32(FMIX_A)
+    x = x ^ jnp.right_shift(x, 15)
+    x = x * jnp.uint32(FMIX_B)
+    x = x ^ jnp.right_shift(x, 16)
+    return x
+
+
+def _leaf_words_jax(idx_u32, balances, stakes):
+    import jax.numpy as jnp
+
+    w = jnp.stack(
+        [
+            balances.astype(jnp.uint32),
+            jnp.right_shift(balances, 31).astype(jnp.uint32),
+            stakes.astype(jnp.uint32),
+            jnp.right_shift(stakes, 31).astype(jnp.uint32),
+        ],
+        axis=-1,
+    )
+    k = jnp.arange(NODE_WORDS, dtype=jnp.uint32)
+    return _fmix_jax(
+        w * jnp.uint32(LEAF_FOLD)
+        + idx_u32[:, None] * jnp.uint32(LEAF_IDX)
+        + k
+    )
+
+
+def _combine_jax(left, right):
+    import jax.numpy as jnp
+
+    k = jnp.arange(NODE_WORDS, dtype=jnp.uint32)
+    return _fmix_jax(
+        left * jnp.uint32(SIB_LEFT) + right * jnp.uint32(SIB_RIGHT) + k
+    )
+
+
+def build_tree_jax(balances, stakes):
+    """Full rebuild on device: log-depth fixed-shape strided combines.
+    Returns the tuple-of-levels pytree the fused kernel threads."""
+    import jax.numpy as jnp
+
+    a = balances.shape[0]
+    p = leaf_count(a)
+    if p != a:
+        balances = jnp.pad(balances, (0, p - a))
+        stakes = jnp.pad(stakes, (0, p - a))
+    levels = [
+        _leaf_words_jax(jnp.arange(p, dtype=jnp.uint32), balances, stakes)
+    ]
+    while levels[-1].shape[0] > 1:
+        cur = levels[-1]
+        levels.append(_combine_jax(cur[0::2], cur[1::2]))
+    return tuple(levels)
+
+
+def update_tree_jax(tree, balances, stakes, dirty_idx):
+    """Incremental update on device: one [K] leaf scatter plus one
+    [K] gather-combine-scatter per level — O(k log n) work in the same
+    launch as the block apply. Duplicate dirty indices scatter
+    identical values (each recomputed from the same post-block state),
+    so the result is deterministic without a dedup pass the device
+    can't shape. Returns a new tuple of levels (functional)."""
+    idx = dirty_idx.astype("int32")
+    new0 = tree[0].at[idx].set(
+        _leaf_words_jax(idx.astype("uint32"), balances[idx], stakes[idx])
+    )
+    levels = [new0]
+    for d in range(1, len(tree)):
+        idx = idx // 2
+        child = levels[-1]
+        levels.append(
+            tree[d]
+            .at[idx]
+            .set(_combine_jax(child[2 * idx], child[2 * idx + 1]))
+        )
+    return tuple(levels)
+
+
+def fold_merkle_jax(digest_words, merkle_words):
+    import jax.numpy as jnp
+
+    k = jnp.arange(ROOT_WORDS, dtype=jnp.uint32)
+    return _fmix_jax(
+        digest_words * jnp.uint32(MERKLE_FOLD)
+        + merkle_words[k % NODE_WORDS]
+        + k
+    )

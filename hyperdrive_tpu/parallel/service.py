@@ -41,6 +41,7 @@ from __future__ import annotations
 import hashlib
 import queue as queue_mod
 import socket
+import struct
 import threading
 import time
 
@@ -52,6 +53,7 @@ from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.crypto.keys import KeyRing
 from hyperdrive_tpu.messages import Precommit
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
+from hyperdrive_tpu.ops.merkle import MAX_DEPTH, MerkleProof
 from hyperdrive_tpu.transport import _LEN, _MAX_FRAME, _recv_exact
 
 __all__ = [
@@ -64,6 +66,11 @@ __all__ = [
     "STATUS_NO_QUORUM",
     "STATUS_SHED",
     "STATUS_UNKNOWN_TENANT",
+    "STATUS_NO_STATE",
+    "TAG_QUERY",
+    "encode_query",
+    "encode_proof",
+    "decode_proof",
 ]
 
 # ------------------------------------------------------------ wire format
@@ -76,13 +83,22 @@ __all__ = [
 TAG_HELLO = 1
 TAG_SUBMIT = 2
 TAG_RESULT = 3
+#: Proof query (request) / proof answer (response) — the trustless read
+#: path. Result frames keep TAG_RESULT byte-for-byte, so a v15-era
+#: client and this port interoperate on the submit path unchanged
+#: (tests/test_service.py pins the cross-version roundtrip).
+TAG_QUERY = 4
 
 STATUS_COMMITTED = 0
 STATUS_NO_QUORUM = 1
 STATUS_SHED = 2
 STATUS_UNKNOWN_TENANT = 3
+#: Query answered before the tenant's first certificate landed (no
+#: settled basis to prove against yet) — retryable, like SHED.
+STATUS_NO_STATE = 4
 
-STATUS_NAMES = ("committed", "no_quorum", "shed", "unknown_tenant")
+STATUS_NAMES = ("committed", "no_quorum", "shed", "unknown_tenant",
+                "no_state")
 
 #: Committee width cap for HELLO (matches the certificate bitmap cap).
 _MAX_SIGNATORIES = 4096
@@ -152,11 +168,89 @@ def encode_result(req_id: int, status: int, nrows: int, mask,
     return w.data()
 
 
+def encode_query(req_id: int, account: int) -> bytes:
+    """A stateless client's proof request: ONE account id. The answer
+    (:func:`encode_proof`) is self-contained — the client needs nothing
+    but the certificate-chain root it already trusts."""
+    w = Writer()
+    w.u8(TAG_QUERY)
+    w.u64(req_id)
+    w.u32(int(account))
+    return w.data()
+
+
+def encode_proof(req_id: int, status: int, proof=None) -> bytes:
+    """ONE proof frame: leaf values, the O(1) chain witness (previous
+    root + state digest), and the O(log n) sibling path — everything
+    :func:`~hyperdrive_tpu.ops.merkle.verify_inclusion` needs against a
+    trusted root, with zero trust in the serving replica. Non-committed
+    statuses carry no body."""
+    w = Writer()
+    w.u8(TAG_QUERY)
+    w.u64(req_id)
+    w.u8(int(status))
+    if status != STATUS_COMMITTED:
+        return w.data()
+    w.i64(proof.height)
+    w.u32(proof.account)
+    w.i64(proof.balance)
+    w.i64(proof.stake)
+    w.bytes32(proof.prev_root)
+    w.raw(struct.pack("<8I", *proof.digest))
+    w.u32(len(proof.siblings))
+    w.raw(b"".join(struct.pack("<4I", *sib) for sib in proof.siblings))
+    return w.data()
+
+
+def decode_proof(payload: bytes):
+    """Client-side decode: ``(req_id, status, proof_or_None)``. Raises
+    SerdeError on malformed bytes or a path deeper than MAX_DEPTH — a
+    Byzantine server cannot make the client loop or allocate
+    unboundedly."""
+    r = Reader(payload)
+    if r.u8() != TAG_QUERY:
+        raise SerdeError("expected a proof frame")
+    req_id = r.u64()
+    status = r.u8()
+    if status != STATUS_COMMITTED:
+        return req_id, status, None
+    height = r.i64()
+    account = r.u32()
+    balance = r.i64()
+    stake = r.i64()
+    prev_root = r.bytes32()
+    digest_raw = r.raw()
+    if len(digest_raw) != 32:
+        raise SerdeError(
+            f"proof digest must be 32 bytes, got {len(digest_raw)}"
+        )
+    depth = r.u32()
+    if depth > MAX_DEPTH:
+        raise SerdeError(f"proof path deeper than {MAX_DEPTH}: {depth}")
+    sib_raw = r.raw()
+    if len(sib_raw) != 16 * depth:
+        raise SerdeError("sibling bytes disagree with the path depth")
+    proof = MerkleProof(
+        height=height,
+        account=account,
+        balance=balance,
+        stake=stake,
+        prev_root=prev_root,
+        digest=struct.unpack("<8I", digest_raw),
+        siblings=tuple(
+            struct.unpack_from("<4I", sib_raw, 16 * i)
+            for i in range(depth)
+        ),
+    )
+    return req_id, status, proof
+
+
 def decode_request(payload: bytes):
-    """Server-side decode: ``("hello", name, f, signatories)`` or
+    """Server-side decode: ``("hello", name, f, signatories)``,
     ``("submit", req_id, height, round, value, generation, rows)`` with
-    ``rows`` as ``(sender, signature)`` pairs. Raises SerdeError on
-    anything malformed or over the width caps."""
+    ``rows`` as ``(sender, signature)`` pairs, or
+    ``("query", req_id, account)``. Raises SerdeError on anything
+    malformed or over the width caps."""
     r = Reader(payload)
     tag = r.u8()
     if tag == TAG_HELLO:
@@ -177,6 +271,8 @@ def decode_request(payload: bytes):
             raise SerdeError(f"window too wide: {n} rows")
         rows = [(r.bytes32(), r.raw()) for _ in range(n)]
         return ("submit", req_id, height, rnd, value, generation, rows)
+    if tag == TAG_QUERY:
+        return ("query", r.u64(), r.u32())
     raise SerdeError(f"unknown service frame tag: {tag}")
 
 
@@ -276,6 +372,13 @@ class ShardVerifyService:
         self.executors: dict = {}
         #: tenant -> {height -> 32-byte chained state root}.
         self.state_roots: dict = {}
+        #: tenant -> :class:`~hyperdrive_tpu.exec.ledger.ProofBasis`:
+        #: the frozen snapshot proof queries answer from, refreshed in
+        #: :meth:`accept_certificate` whenever the executor sits exactly
+        #: at the certified height with no open speculation. Queries
+        #: never touch the live executor — it may be speculated ahead
+        #: of the last certificate by the time a query lands.
+        self.proof_bases: dict = {}
 
     def _tenant_id(self, tenant) -> int:
         tid = self.tenant_ids.get(tenant)
@@ -373,6 +476,14 @@ class ShardVerifyService:
             self.state_roots[tenant][cert.height] = ex.advance_to(
                 cert.height
             )
+            if ex.height == cert.height and not ex._spec:
+                # Freeze the newly-certified height for proof serving.
+                # When the executor already ran ahead (pipelined
+                # speculation), the basis simply lags one certificate —
+                # clients verify against the trusted root at the
+                # proof's own height, so a lagging basis is still a
+                # sound answer.
+                self.proof_bases[tenant] = ex.proof_basis()
         wm = self.watermarks.get(tenant, 0)
         if cert.height > wm:
             wm = self.watermarks[tenant] = cert.height
@@ -399,6 +510,7 @@ class ShardVerifyService:
         self.tenants.pop(tenant, None)
         tid = self.tenant_ids.pop(tenant, None)
         self.watermarks.pop(tenant, None)
+        self.proof_bases.pop(tenant, None)
         if released:
             self.retired_certs += released
         if tid is not None and self.obs is not NULL_BOUND:
@@ -639,6 +751,20 @@ class TenantShard:
         client.hello(self.name, self.ring.signatories, self.f)
         return self
 
+    @staticmethod
+    def verify_balance(proof, trusted_root: bytes) -> bool:
+        """The light-client check: does ``proof`` bind its (account,
+        balance, stake) leaf into ``trusted_root`` — a chained state
+        root this shard already holds from its own certificate chain?
+        Pure recomputation (ops/merkle.py ``verify_inclusion``); the
+        serving replica is trusted for nothing."""
+        from hyperdrive_tpu.ops.merkle import verify_inclusion
+
+        return verify_inclusion(
+            trusted_root, proof.account, proof.balance, proof.stake,
+            proof,
+        )
+
     def run_remote(self, max_inflight: int = 4, timeout: float = 30.0,
                    max_shed_retries: int = 1024) -> None:
         """Drive every height through the attached client. Keeps
@@ -759,6 +885,8 @@ class ServicePort:
         self.remote_submits = 0
         self.remote_resolves = 0
         self.remote_sheds = 0
+        self.remote_queries = 0
+        self.query_sheds = 0
         self.bad_frames = 0
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -849,6 +977,8 @@ class ServicePort:
                 continue
             if req[0] == "hello":
                 self._handle_hello(conn, *req[1:])
+            elif req[0] == "query":
+                self._handle_query(conn, *req[1:])
             else:
                 self._handle_submit(conn, *req[1:])
         return handled
@@ -864,6 +994,43 @@ class ServicePort:
             self.controller,
             height_fn=lambda name=name: watermarks.get(name, 0) + 1,
         )
+
+    def _handle_query(self, conn, req_id, account) -> None:
+        """One TAG_QUERY request → ONE proof frame (or a status-only
+        refusal). Queries ride the tenant's admission gate as the
+        ``query`` shed class: at SHED_LOW_PRIORITY and above the port
+        answers STATUS_SHED without touching any ledger state, so a
+        read storm degrades reads first and never queues ahead of
+        certificates. Serving itself reads the frozen
+        :class:`~hyperdrive_tpu.exec.ledger.ProofBasis` — O(log n)
+        numpy indexing, no executor locks, no speculation hazard."""
+        from hyperdrive_tpu.load.frames import QueryFrame
+
+        if conn.tenant is None:
+            self._send(conn, encode_proof(req_id, STATUS_UNKNOWN_TENANT))
+            return
+        self.controller.poll()
+        if not conn.gate.admit(QueryFrame(account=account),
+                               peer=conn.tenant):
+            self.query_sheds += 1
+            if self.obs is not NULL_BOUND:
+                self.obs.emit("proof.shed", -1, -1, conn.tenant)
+            self._send(conn, encode_proof(req_id, STATUS_SHED))
+            return
+        basis = self.service.proof_bases.get(conn.tenant)
+        if basis is None or not 0 <= account < basis.accounts:
+            self._send(conn, encode_proof(req_id, STATUS_NO_STATE))
+            return
+        payload = encode_proof(
+            req_id, STATUS_COMMITTED, basis.prove(account)
+        )
+        self.remote_queries += 1
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "proof.serve", basis.height, -1,
+                "account=%d bytes=%d" % (account, len(payload)),
+            )
+        self._send(conn, payload)
 
     def _handle_submit(self, conn, req_id, height, rnd, value,
                        generation, rows) -> None:
@@ -997,7 +1164,7 @@ class RemoteFuture:
     """Resolution handle for one remote window: a thread event the
     client's reader sets when the certificate frame lands."""
 
-    __slots__ = ("_event", "status", "mask", "cert", "root")
+    __slots__ = ("_event", "status", "mask", "cert", "root", "proof")
 
     def __init__(self):
         self._event = threading.Event()
@@ -1009,6 +1176,9 @@ class RemoteFuture:
         #: :meth:`result`'s tuple so root-less deployments keep their
         #: 3-tuple unpack.
         self.root = None
+        #: :class:`~hyperdrive_tpu.ops.merkle.MerkleProof` for a
+        #: TAG_QUERY request (None on submit futures and refusals).
+        self.proof = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -1020,6 +1190,12 @@ class RemoteFuture:
         if not self._event.wait(timeout):
             raise TimeoutError("remote verify window timed out")
         return self.status, self.mask, self.cert
+
+    def proof_result(self, timeout: float = 30.0):
+        """``(status, proof_or_None)`` for a TAG_QUERY request."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("remote proof query timed out")
+        return self.status, self.proof
 
 
 class RemoteServiceClient:
@@ -1058,6 +1234,19 @@ class RemoteServiceClient:
         )
         return fut
 
+    def query(self, account: int) -> RemoteFuture:
+        """Request an O(log n) inclusion proof for ``account`` at the
+        tenant's latest certified height. Resolve with
+        :meth:`RemoteFuture.proof_result`; STATUS_SHED / STATUS_NO_STATE
+        answers are retryable, exactly like shed submits."""
+        fut = RemoteFuture()
+        with self._pending_lock:
+            req_id = self._next_req
+            self._next_req += 1
+            self._pending[req_id] = fut
+        self._send(encode_query(req_id, account))
+        return fut
+
     def _send(self, payload: bytes) -> None:
         frame = _LEN.pack(len(payload)) + payload
         with self._send_lock:
@@ -1076,9 +1265,14 @@ class RemoteServiceClient:
                 if payload is None:
                     return
                 try:
-                    req_id, status, mask, cert, root = decode_result(
-                        payload
-                    )
+                    if payload and payload[0] == TAG_QUERY:
+                        req_id, status, proof = decode_proof(payload)
+                        mask = cert = root = None
+                    else:
+                        req_id, status, mask, cert, root = decode_result(
+                            payload
+                        )
+                        proof = None
                 except SerdeError:
                     continue
                 with self._pending_lock:
@@ -1088,6 +1282,7 @@ class RemoteServiceClient:
                     fut.mask = mask
                     fut.cert = cert
                     fut.root = root
+                    fut.proof = proof
                     fut._event.set()
         except OSError:
             return

@@ -2,8 +2,8 @@
 
 Well-formed lowercase dotted names that sit under the closed event
 families (sched.launch.*, verify.occupancy.*, metrics.*, bls.*,
-tenant.drain.*, service.*, exec.*) but are not members of the recorder
-taxonomy
+tenant.drain.*, service.*, exec.*, merkle.*, proof.*) but are not
+members of the recorder taxonomy
 are silent forks — the grep-based journal test only audits files it
 covers, the lint covers the rest.
 """
@@ -38,6 +38,12 @@ class Pipeline:
     def bad_unknown_spec(self, h):
         self.obs.emit("exec.spec.commit", -1, h, -1, 0)  # BAD: fork
 
+    def bad_unknown_merkle(self, h):
+        self.obs.emit("merkle.rebuild", -1, h, -1, 0)  # BAD: fork
+
+    def bad_unknown_proof(self, t):
+        self.obs.emit("proof.refused", -1, -1, -1, t)  # BAD: fork
+
     def good_taxonomy_members(self, lid, pct):
         self.obs.emit("sched.launch.begin", -2, -1, -1, lid)
         self.obs.emit("verify.occupancy.pct", -1, -1, -1, pct)
@@ -52,6 +58,10 @@ class Pipeline:
         self.obs.emit("exec.spec.speculate", -1, -1, -1, 0)
         self.obs.emit("exec.spec.confirm", -1, -1, -1, 0)
         self.obs.emit("exec.spec.rollback", -1, -1, -1, 0)
+        self.obs.emit("merkle.root", -1, -1, -1, 0)
+        self.obs.emit("merkle.update", -1, -1, -1, 0)
+        self.obs.emit("proof.serve", -1, -1, -1, 0)
+        self.obs.emit("proof.shed", -1, -1, -1, 0)
 
     def good_open_family(self):
         # Families outside the closed prefixes stay grep-audited only:

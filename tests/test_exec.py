@@ -531,6 +531,76 @@ def test_block_source_cache_pins_open_epoch_and_counts():
     assert src.hits == 2
 
 
+def test_prove_verifies_on_both_executors_and_after_rollback():
+    # The Merkle surface end to end: host and device executors serve
+    # bit-identical proofs, every proof verifies against the chained
+    # root a light client already trusts, and a speculation rollback
+    # restores the dirty-set snapshot so post-rollback proofs verify
+    # against the rebuilt chain.
+    from hyperdrive_tpu.exec.device import DeviceLedgerExecutor
+
+    cfg = _cfg(seed=21, txs_per_block=16)
+    src = BlockSource(cfg)
+    host = HostLedgerExecutor(cfg, source=src)
+    dev = DeviceLedgerExecutor(cfg, source=src)
+    for ex in (host, dev):
+        ex.advance_to(4)
+    for account in (0, 9, 31):
+        hp, dp = host.prove(account), dev.prove(account)
+        assert hp == dp
+        assert host.verify_inclusion(
+            host.roots[4], account, hp.balance, hp.stake, hp
+        )
+    # Roll a speculative window back; the tree snapshot restores with
+    # the state, and a fresh proof verifies against the replayed chain.
+    for ex in (host, dev):
+        ex.speculate(5, [i % 2 == 0 for i in range(cfg.txs_per_block)])
+        with pytest.raises(RuntimeError):
+            ex.prove(3)  # speculative roots may roll back: refuse
+        ex.resolve(5, [True] * cfg.txs_per_block)
+        assert ex.spec_rolled_back == 1
+        p = ex.prove(3)
+        assert ex.verify_inclusion(
+            ex.roots[5], 3, p.balance, p.stake, p
+        )
+    assert host.root == dev.root
+    assert host.prove(3) == dev.prove(3)
+
+
+def test_proof_basis_is_frozen_against_executor_progress():
+    cfg = _cfg(seed=25)
+    ex = HostLedgerExecutor(cfg)
+    ex.advance_to(2)
+    basis = ex.proof_basis()
+    frozen = basis.prove(4)
+    root_h2 = ex.roots[2]
+    # The executor moves on (and even speculates); the basis still
+    # serves height-2 proofs that verify against the height-2 root.
+    ex.advance_to(5)
+    ex.speculate(6, None)
+    again = basis.prove(4)
+    assert again == frozen and again.height == 2
+    assert ex.verify_inclusion(
+        root_h2, 4, frozen.balance, frozen.stake, frozen
+    )
+    with pytest.raises(RuntimeError):
+        ex.proof_basis()  # open speculation window refuses
+
+
+def test_merkle_events_ride_the_journal_on_both_routes():
+    from hyperdrive_tpu.obs.report import proofs_summary
+
+    for device in (False, True):
+        sim = _exec_sim(device=device, target=3, observe=True)
+        sim.run()
+        summary = proofs_summary(sim.obs.snapshot())
+        assert summary["updates"] >= 3
+        assert summary["merkle_roots"]
+        assert summary["merkle_forks"] == []
+        assert summary["depth"] == 5  # 32 accounts
+        assert summary["full_rebuilds"] in (0, summary["updates"])
+
+
 def test_exec_report_renders_speculation_outcome_table():
     from hyperdrive_tpu.obs.report import exec_summary, render_exec_table
 

@@ -171,6 +171,48 @@ def test_gate_per_peer_fairness_budget():
     assert gate.admit(_pv(sender=b"\x02", value=bytes([99])), peer=meek)
 
 
+def test_gate_query_class_sheds_at_low_priority_never_ahead_of_certs():
+    from hyperdrive_tpu.load.frames import QueryFrame
+
+    # Calm and duplicate-shedding levels: reads flow, and an identical
+    # re-query is NOT a duplicate (reads never enter dedup memory — a
+    # retry after a shed is the doctrine, not replay spam).
+    gate = AdmissionGate(_pinned(SHED_DUPLICATES), height_fn=lambda: 5)
+    assert gate.admit(QueryFrame(account=3))
+    assert gate.admit(QueryFrame(account=3))
+    assert gate.shed == {}
+    # From SHED_LOW_PRIORITY up, queries are the first prey — while the
+    # never-shed kinds (certificates, proposals, precommits) still pass
+    # even at CRITICAL_ONLY. A read storm cannot starve consensus.
+    for level in (SHED_LOW_PRIORITY, CRITICAL_ONLY):
+        gate = AdmissionGate(_pinned(level), height_fn=lambda: 5)
+        assert not gate.admit(QueryFrame(account=3))
+        assert gate.admit(object())  # certificate-like kinds
+        assert gate.admit(
+            Precommit(
+                height=5, round=0, value=b"\x07" * 32, sender=b"\x01" * 32
+            )
+        )
+        assert gate.shed == {"query": 1}
+
+
+def test_gate_query_accounting_identity_and_memory_neutrality():
+    from hyperdrive_tpu.load.frames import QueryFrame
+
+    gate = AdmissionGate(_pinned(SHED_LOW_PRIORITY), height_fn=lambda: 5)
+    for i in range(4):
+        gate.admit(QueryFrame(account=i))
+    gate.admit(_pv(value=b"\x08"))
+    snap = gate.snapshot()
+    assert snap["offered"] == snap["admitted"] + sum(snap["shed"].values())
+    assert snap["shed"]["query"] == 4
+    # Admitted queries never evict vote keys from the bounded memory.
+    gate2 = AdmissionGate(_pinned(ACCEPT))
+    for _ in range(8):
+        assert gate2.admit(QueryFrame(account=0))
+    assert gate2._mem == {}
+
+
 def test_gate_accounting_identity():
     gate = AdmissionGate(_pinned(SHED_DUPLICATES), height_fn=lambda: 5)
     pv = _pv()

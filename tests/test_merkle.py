@@ -1,0 +1,240 @@
+"""Device-Merkleized state (ops/merkle.py).
+
+The numpy twin is the reference: build/update/prove/verify are pinned
+here jax-free, and the jnp twin is asserted bit-identical against it —
+the same twin discipline tests/test_exec.py applies to the root chain.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from hyperdrive_tpu.ops.merkle import (
+    MAX_DEPTH,
+    MerkleProof,
+    NODE_WORDS,
+    build_tree_np,
+    combine_np,
+    fold_merkle_np,
+    fold_path_np,
+    leaf_count,
+    leaf_words_np,
+    merkle_bytes,
+    merkle_root_np,
+    prove_np,
+    tree_depth,
+    update_tree_np,
+    verify_inclusion,
+)
+from hyperdrive_tpu.ops.rootmix import fold_root_np, root_bytes, root_words
+
+_SEED = 23
+
+
+def _state(n, seed=_SEED):
+    rng = np.random.default_rng(seed)
+    bal = rng.integers(-1000, 100000, size=n, dtype=np.int32)
+    stk = rng.integers(0, 500, size=n, dtype=np.int32)
+    return bal, stk
+
+
+# ------------------------------------------------------------ tree shape
+
+
+def test_leaf_count_and_depth_follow_power_of_two_padding():
+    assert [leaf_count(n) for n in (1, 2, 3, 16, 17, 64)] == [
+        1, 2, 4, 16, 32, 64,
+    ]
+    assert [tree_depth(n) for n in (1, 2, 3, 16, 17, 64)] == [
+        0, 1, 2, 4, 5, 6,
+    ]
+
+
+def test_build_tree_levels_halve_to_one_root():
+    bal, stk = _state(20)  # pads to 32
+    tree = build_tree_np(bal, stk)
+    assert [lvl.shape for lvl in tree] == [
+        (32, NODE_WORDS), (16, NODE_WORDS), (8, NODE_WORDS),
+        (4, NODE_WORDS), (2, NODE_WORDS), (1, NODE_WORDS),
+    ]
+    assert merkle_root_np(tree).shape == (NODE_WORDS,)
+    assert len(merkle_bytes(merkle_root_np(tree))) == 16
+
+
+def test_combine_is_position_asymmetric():
+    l, r = leaf_words_np(np.arange(2, dtype=np.uint32), [5, 9], [1, 2])
+    assert not np.array_equal(
+        combine_np(l[None], r[None]), combine_np(r[None], l[None])
+    )
+
+
+# --------------------------------------------------- incremental update
+
+
+def test_incremental_update_matches_full_rebuild():
+    bal, stk = _state(64)
+    tree = build_tree_np(bal, stk)
+    rng = np.random.default_rng(7)
+    for _ in range(10):
+        dirty = rng.integers(0, 64, size=6)
+        bal[dirty] += rng.integers(1, 50, size=6, dtype=np.int32)
+        stk[dirty[0]] += 1
+        update_tree_np(tree, bal, stk, np.append(dirty, dirty[0]))
+        ref = build_tree_np(bal, stk)
+        for got, want in zip(tree, ref):
+            assert np.array_equal(got, want)
+
+
+def test_update_with_clean_and_duplicate_targets_is_idempotent():
+    # The executors pass raw scatter targets (pad rows point at account
+    # 0): recomputing a CLEAN leaf must be a no-op, so no mask or dedup
+    # is ever needed for correctness.
+    bal, stk = _state(16)
+    tree = build_tree_np(bal, stk)
+    before = [lvl.copy() for lvl in tree]
+    update_tree_np(tree, bal, stk, np.array([0, 0, 3, 3, 15]))
+    for got, want in zip(tree, before):
+        assert np.array_equal(got, want)
+
+
+def test_pad_leaves_are_stable_zero_accounts():
+    # 20 accounts pad to 32: the 12 pad leaves are zero-balance
+    # zero-stake accounts at their padded index, never dirtied — two
+    # ledgers differing only in a pad-index write cannot exist, and the
+    # tree equals a 32-account ledger whose tail is genuinely zero.
+    bal, stk = _state(20)
+    tree = build_tree_np(bal, stk)
+    wide = build_tree_np(
+        np.pad(bal, (0, 12)), np.pad(stk, (0, 12))
+    )
+    for got, want in zip(tree, wide):
+        assert np.array_equal(got, want)
+
+
+# ------------------------------------------------------- proofs + verify
+
+
+def test_prove_then_fold_path_recovers_root_for_every_account():
+    bal, stk = _state(20)
+    tree = build_tree_np(bal, stk)
+    root = merkle_root_np(tree)
+    for account in range(20):
+        sibs = prove_np(tree, account)
+        assert len(sibs) == tree_depth(20) == 5
+        leaf = leaf_words_np(
+            np.asarray([account], dtype=np.uint32),
+            [bal[account]], [stk[account]],
+        )[0]
+        assert np.array_equal(fold_path_np(leaf, account, sibs), root)
+
+
+def _chained(bal, stk, height=3, seed=11):
+    """A miniature chained root: fold_root(prev, h, fold_merkle(d, m))
+    with an arbitrary digest — enough to test verify_inclusion without
+    an executor."""
+    rng = np.random.default_rng(seed)
+    prev_words = rng.integers(0, 2**32, size=8, dtype=np.uint64).astype(
+        np.uint32
+    )
+    prev = root_bytes(prev_words)
+    digest = tuple(
+        int(v) for v in rng.integers(0, 2**32, size=8, dtype=np.uint64)
+    )
+    tree = build_tree_np(bal, stk)
+    folded = fold_merkle_np(
+        np.asarray(digest, dtype=np.uint32), merkle_root_np(tree)
+    )
+    root = root_bytes(fold_root_np(root_words(prev), height, folded))
+    return tree, prev, digest, root
+
+
+def test_verify_inclusion_accepts_honest_proof():
+    bal, stk = _state(16)
+    tree, prev, digest, root = _chained(bal, stk)
+    for account in (0, 7, 15):
+        proof = MerkleProof(
+            height=3, account=account, balance=int(bal[account]),
+            stake=int(stk[account]), prev_root=prev, digest=digest,
+            siblings=prove_np(tree, account),
+        )
+        assert verify_inclusion(
+            root, account, proof.balance, proof.stake, proof
+        )
+
+
+def test_verify_inclusion_rejects_all_four_forgeries():
+    bal, stk = _state(16)
+    tree, prev, digest, root = _chained(bal, stk)
+    proof = MerkleProof(
+        height=3, account=7, balance=int(bal[7]), stake=int(stk[7]),
+        prev_root=prev, digest=digest, siblings=prove_np(tree, 7),
+    )
+    stale_root = dataclasses.replace(proof, prev_root=b"\x01" * 32)
+    forged_sib = dataclasses.replace(
+        proof, siblings=((9, 9, 9, 9),) + proof.siblings[1:]
+    )
+    truncated = dataclasses.replace(proof, siblings=proof.siblings[:-1])
+    wrong_leaf = dataclasses.replace(proof, balance=proof.balance + 1)
+    for bad in (stale_root, forged_sib, truncated, wrong_leaf):
+        assert not verify_inclusion(root, 7, bad.balance, bad.stake, bad)
+
+
+def test_verify_inclusion_rejects_malformed_shapes():
+    bal, stk = _state(16)
+    tree, prev, digest, root = _chained(bal, stk)
+    good = MerkleProof(
+        height=3, account=7, balance=int(bal[7]), stake=int(stk[7]),
+        prev_root=prev, digest=digest, siblings=prove_np(tree, 7),
+    )
+    assert not verify_inclusion(
+        root, 7, good.balance, good.stake,
+        dataclasses.replace(good, height=0),
+    )
+    assert not verify_inclusion(
+        root, 7, good.balance, good.stake,
+        dataclasses.replace(good, prev_root=b"\x00" * 8),
+    )
+    assert not verify_inclusion(
+        root, 7, good.balance, good.stake,
+        dataclasses.replace(good, digest=digest[:4]),
+    )
+    over = dataclasses.replace(
+        good, siblings=good.siblings * (MAX_DEPTH // 4 + 1)
+    )
+    assert not verify_inclusion(root, 7, good.balance, good.stake, over)
+    # Account index outside the path's span.
+    assert not verify_inclusion(root, 1 << 10, good.balance, good.stake,
+                                good)
+
+
+# ------------------------------------------------------- jnp twin parity
+
+
+def test_jax_twins_match_numpy_bitwise():
+    jnp = pytest.importorskip("jax.numpy")
+    from hyperdrive_tpu.ops.merkle import (
+        build_tree_jax,
+        fold_merkle_jax,
+        update_tree_jax,
+    )
+
+    bal, stk = _state(20)
+    ref = build_tree_np(bal, stk)
+    dtree = build_tree_jax(jnp.asarray(bal), jnp.asarray(stk))
+    for got, want in zip(dtree, ref):
+        assert np.array_equal(np.asarray(got), want)
+
+    dirty = np.array([0, 3, 3, 19, 7], dtype=np.int32)
+    bal[dirty] += 9
+    update_tree_np(ref, bal, stk, dirty)
+    dtree = update_tree_jax(
+        dtree, jnp.asarray(bal), jnp.asarray(stk), jnp.asarray(dirty)
+    )
+    for got, want in zip(dtree, ref):
+        assert np.array_equal(np.asarray(got), want)
+
+    digest = np.arange(8, dtype=np.uint32) * np.uint32(0x9E3779B9)
+    want = fold_merkle_np(digest, merkle_root_np(ref))
+    got = fold_merkle_jax(jnp.asarray(digest), dtree[-1][0])
+    assert np.array_equal(np.asarray(got), want)

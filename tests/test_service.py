@@ -7,6 +7,8 @@ seam, and the cross-process TCP port — not the crypto underneath it
 (test_ed25519* own that).
 """
 
+import dataclasses
+import struct
 import time
 
 import pytest
@@ -17,13 +19,17 @@ from hyperdrive_tpu.obs.devtel import DeviceTelemetry
 from hyperdrive_tpu.parallel.service import (
     RemoteServiceClient,
     STATUS_COMMITTED,
+    STATUS_NO_STATE,
     STATUS_SHED,
     STATUS_UNKNOWN_TENANT,
     ShardVerifyService,
     TenantShard,
+    decode_proof,
     decode_request,
     decode_result,
     encode_hello,
+    encode_proof,
+    encode_query,
     encode_result,
     encode_submit,
 )
@@ -566,3 +572,234 @@ def test_epoch_rotation_mid_serve_keeps_roots_continuous():
     assert rotated.state_roots == baseline.state_roots
     assert rotated.commit_digest() == baseline.commit_digest()
     assert rotated.generation == 1
+
+
+# ---------------------------------------- result-frame version back-compat
+
+
+def _v15_encode_result(req_id, status, nrows, mask, root=b""):
+    """The result-frame encoder EXACTLY as the v15-era client/server
+    shipped it, frozen in struct calls (no shared code with the live
+    codec, so a drift in either direction fails here). Layout: u8 tag,
+    u64 req_id, u8 status, u32 nrows, raw bitmap, raw root, raw cert —
+    ``raw`` being a u32 length prefix + bytes."""
+    bitmap = bytearray(-(-nrows // 8)) if nrows else bytearray()
+    for i, ok in enumerate(mask or ()):
+        if ok:
+            bitmap[i >> 3] |= 1 << (i & 7)
+    out = struct.pack("<B", 3) + struct.pack("<Q", req_id)
+    out += struct.pack("<B", status) + struct.pack("<I", nrows)
+    out += struct.pack("<I", len(bitmap)) + bytes(bitmap)
+    out += struct.pack("<I", len(root)) + bytes(root)
+    out += struct.pack("<I", 0)  # no certificate tail
+    return out
+
+
+def _v15_decode_result(payload):
+    """The v15-era client's decode, frozen: returns (req_id, status,
+    mask, root_or_None) — certificate tails are skipped as the old
+    reader did when the cert length prefix said empty."""
+    off = 0
+    (tag,) = struct.unpack_from("<B", payload, off); off += 1
+    assert tag == 3
+    (req_id,) = struct.unpack_from("<Q", payload, off); off += 8
+    (status,) = struct.unpack_from("<B", payload, off); off += 1
+    (n,) = struct.unpack_from("<I", payload, off); off += 4
+    (blen,) = struct.unpack_from("<I", payload, off); off += 4
+    bitmap = payload[off:off + blen]; off += blen
+    mask = [bool(bitmap[i >> 3] >> (i & 7) & 1) for i in range(n)]
+    (rlen,) = struct.unpack_from("<I", payload, off); off += 4
+    root = payload[off:off + rlen] or None
+    return req_id, status, mask, root
+
+
+def test_result_frame_back_compat_across_versions():
+    # TAG_QUERY is a NEW tag; the result frame itself must be
+    # byte-identical in both directions so a v15-era peer and this
+    # build interoperate on the submit path unchanged.
+    mask = [True, False, True, False, True]
+    live = encode_result(5, STATUS_COMMITTED, 5, mask, root=b"\x42" * 32)
+    frozen = _v15_encode_result(
+        5, STATUS_COMMITTED, 5, mask, root=b"\x42" * 32
+    )
+    assert live == frozen  # new server -> old client, byte for byte
+    # Old server -> new client: the live decoder accepts the frozen
+    # bytes and reads the same fields.
+    req_id, status, got_mask, cert, root = decode_result(frozen)
+    assert (req_id, status, cert) == (5, STATUS_COMMITTED, None)
+    assert got_mask == mask and root == b"\x42" * 32
+    # Old client -> frozen decode of the live bytes agrees too.
+    assert _v15_decode_result(live) == (
+        5, STATUS_COMMITTED, mask, b"\x42" * 32
+    )
+    # Rootless frames (the v15 default) as well.
+    assert encode_result(9, STATUS_SHED, 3, ()) == _v15_encode_result(
+        9, STATUS_SHED, 3, ()
+    )
+
+
+# ------------------------------------------------- trustless read path
+
+
+def test_wire_roundtrip_query_and_proof():
+    kind, req_id, account = decode_request(encode_query(11, 7))
+    assert (kind, req_id, account) == ("query", 11, 7)
+    # Status-only refusals carry no body.
+    rid, status, proof = decode_proof(encode_proof(4, STATUS_NO_STATE))
+    assert (rid, status, proof) == (4, STATUS_NO_STATE, None)
+    # A full proof round-trips field for field.
+    from hyperdrive_tpu.ops.merkle import MerkleProof
+
+    p = MerkleProof(
+        height=3, account=7, balance=123, stake=-4,
+        prev_root=b"\x05" * 32, digest=tuple(range(8)),
+        siblings=((1, 2, 3, 4), (5, 6, 7, 8), (9, 10, 11, 12)),
+    )
+    rid, status, got = decode_proof(encode_proof(8, STATUS_COMMITTED, p))
+    assert (rid, status, got) == (8, STATUS_COMMITTED, p)
+    # Byzantine depth: a path deeper than MAX_DEPTH raises before any
+    # per-sibling allocation.
+    from hyperdrive_tpu.codec import Writer
+    from hyperdrive_tpu.ops.merkle import MAX_DEPTH
+
+    w = Writer()
+    w.u8(4)  # TAG_QUERY
+    w.u64(1)
+    w.u8(STATUS_COMMITTED)
+    w.i64(1)
+    w.u32(0)
+    w.i64(0)
+    w.i64(0)
+    w.bytes32(b"\x00" * 32)
+    w.raw(b"\x00" * 32)
+    w.u32(MAX_DEPTH + 1)
+    w.raw(b"")
+    with pytest.raises(SerdeError):
+        decode_proof(w.data())
+
+
+def _proof_port(target_height=3, seed=9):
+    """Spin a service + port + remote execution-attached tenant driven
+    to ``target_height``; returns (svc, port, client, remote)."""
+    import threading
+
+    svc = _service()
+    svc.attach_execution("rx", _exec_cfg(seed=seed))
+    port = svc.remote_port()
+    client = RemoteServiceClient(*port.address)
+    remote = TenantShard("rx", target_height=target_height, sign=False)
+    remote.attach_remote(client)
+    t = threading.Thread(target=remote.run_remote, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 10.0
+    while not remote.done and time.monotonic() < deadline:
+        port.pump()
+        svc.drain()
+        time.sleep(0.001)
+    t.join(timeout=5.0)
+    assert remote.done and remote.rejected == 0
+    return svc, port, client, remote
+
+
+def _query(port, svc, client, account):
+    fut = client.query(account)
+    deadline = time.monotonic() + 5.0
+    while not fut.done() and time.monotonic() < deadline:
+        port.pump()
+        svc.drain()
+        time.sleep(0.001)
+    return fut.proof_result(timeout=1.0)
+
+
+def test_remote_query_serves_verifiable_proof():
+    svc, port, client, remote = _proof_port()
+    try:
+        status, proof = _query(port, svc, client, 3)
+        assert status == STATUS_COMMITTED
+        # The client verifies against the root it ALREADY trusts from
+        # the certificate chain — zero trust in the serving replica.
+        trusted = remote.state_roots[proof.height]
+        assert remote.verify_balance(proof, trusted)
+        assert proof.balance >= 0 and len(proof.siblings) == 4  # 16 accts
+        assert port.remote_queries == 1 and port.query_sheds == 0
+    finally:
+        client.close()
+        port.close()
+        svc.close()
+
+
+def test_remote_query_detects_all_four_forged_proof_variants():
+    from hyperdrive_tpu.ops.merkle import verify_inclusion
+
+    svc, port, client, remote = _proof_port(seed=7)
+    try:
+        status, proof = _query(port, svc, client, 5)
+        assert status == STATUS_COMMITTED
+        trusted = remote.state_roots[proof.height]
+        assert verify_inclusion(
+            trusted, 5, proof.balance, proof.stake, proof
+        )
+        # A Byzantine server's four classic forgeries, applied to the
+        # real frame the wire delivered — each must fail the client's
+        # recomputation against the trusted root.
+        stale = dataclasses.replace(proof, prev_root=b"\x00" * 32)
+        forged = dataclasses.replace(
+            proof, siblings=((1, 2, 3, 4),) + proof.siblings[1:]
+        )
+        truncated = dataclasses.replace(
+            proof, siblings=proof.siblings[:-1]
+        )
+        wrong_leaf = dataclasses.replace(proof, balance=proof.balance + 1)
+        for bad in (stale, forged, truncated, wrong_leaf):
+            assert not verify_inclusion(
+                trusted, 5, bad.balance, bad.stake, bad
+            )
+    finally:
+        client.close()
+        port.close()
+        svc.close()
+
+
+def test_remote_query_before_first_certificate_is_no_state():
+    svc = _service()
+    svc.attach_execution("rx", _exec_cfg())
+    port = svc.remote_port()
+    client = RemoteServiceClient(*port.address)
+    remote = TenantShard("rx", target_height=1, sign=False)
+    remote.attach_remote(client)
+    try:
+        client.hello("rx", remote.ring.signatories, remote.f)
+        status, proof = _query(port, svc, client, 0)
+        assert status == STATUS_NO_STATE and proof is None
+        # Rootless tenants (no execution attached) answer the same way.
+        assert port.remote_queries == 0
+    finally:
+        client.close()
+        port.close()
+        svc.close()
+
+
+def test_remote_query_sheds_under_pressure_and_recovers():
+    from hyperdrive_tpu.load.backpressure import SHED_LOW_PRIORITY
+
+    svc, port, client, remote = _proof_port()
+    try:
+        port.controller.floor = SHED_LOW_PRIORITY
+        port.controller.poll()
+        status, proof = _query(port, svc, client, 2)
+        assert status == STATUS_SHED and proof is None
+        assert port.query_sheds == 1
+        # Pressure released -> the same retried query serves (reads are
+        # flow-controlled, never lost).
+        port.controller.floor = 0
+        for _ in range(port.controller.hysteresis):
+            port.controller.poll()
+        status2, proof2 = _query(port, svc, client, 2)
+        assert status2 == STATUS_COMMITTED
+        assert remote.verify_balance(
+            proof2, remote.state_roots[proof2.height]
+        )
+    finally:
+        client.close()
+        port.close()
+        svc.close()
