@@ -42,11 +42,22 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog"
     )
+    parser.add_argument(
+        "--wire-report", action="store_true",
+        help="print the wire-codec inventory (tag, version, max_bytes, "
+             "roundtrip-test locations) instead of linting",
+    )
     args = parser.parse_args(argv)
 
     if args.list_rules:
         for code, cls in sorted(ALL_RULES.items()):
             print(f"{code}  {cls.name:28s} {cls.summary}")
+        return 0
+
+    if args.wire_report:
+        from hyperdrive_tpu.analysis.wireflow import wire_report
+
+        print(wire_report(args.paths or [_default_target()]))
         return 0
 
     if args.rules:

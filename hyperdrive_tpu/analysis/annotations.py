@@ -12,6 +12,16 @@ it; HD001 treats anything inside a ``device_fetch(...)`` call as
 accounted-for. Keeping every deliberate sync behind one name makes the
 cost grep-able: ``grep -rn device_fetch hyperdrive_tpu`` IS the sync
 budget.
+
+``@wire_codec`` is the same doctrine applied to the wire surface:
+every encoder/decoder pair that touches bytes a Byzantine peer can
+author registers itself under a frame-family ``tag`` with a declared
+``max_bytes`` decode budget. The registry is read three ways — the
+wire rules (HD007–HD010, analysis/wireflow.py) check it syntactically
+without importing anything, the HDS005 WireBudget sanitizer charges
+decodes against it at runtime, and ``--wire-report`` prints it as the
+one-glance codec inventory. ``grep -rn wire_codec hyperdrive_tpu`` IS
+the attack surface.
 """
 
 from __future__ import annotations
@@ -23,6 +33,13 @@ __all__ = [
     "device_fetch",
     "set_fetch_observer",
     "set_fetch_probe",
+    "wire_codec",
+    "wire_entry",
+    "declare_wire_budget",
+    "wire_budget_for",
+    "WIRE_CODECS",
+    "WIRE_BUDGETS",
+    "WireCodecSpec",
 ]
 
 #: Optional callback invoked with the ``why`` string on every
@@ -104,6 +121,126 @@ def drain_point(fn=None):
     except (AttributeError, TypeError):  # builtins / slotted callables
         pass
     return fn
+
+
+class WireCodecSpec:
+    """One registered codec endpoint: ``tag`` names the frame family,
+    ``max_bytes`` is its per-frame decode byte budget, ``role`` is
+    ``encode`` / ``decode`` / ``both`` (classes carrying a
+    marshal/unmarshal pair register once as ``both``)."""
+
+    __slots__ = ("tag", "max_bytes", "version", "role", "name", "module")
+
+    def __init__(self, tag, max_bytes, version, role, name, module):
+        self.tag = tag
+        self.max_bytes = max_bytes
+        self.version = version
+        self.role = role
+        self.name = name
+        self.module = module
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"WireCodecSpec(tag={self.tag!r}, role={self.role!r}, "
+                f"max_bytes={self.max_bytes}, v{self.version}, "
+                f"{self.module}.{self.name})")
+
+
+#: tag -> list[WireCodecSpec], populated at import time by the
+#: decorators below. Runtime consumers: the HDS005 WireBudget (budget
+#: lookup by tag) and tests/test_wire_audit.py (closure + fuzz
+#: parametrization). The static rules never read this — they collect
+#: the same decorators from the AST, so linting never imports the
+#: code it scans.
+WIRE_CODECS: dict = {}
+
+#: tag -> max_bytes for budget-only entries (object-frame seams with no
+#: byte codec of their own, e.g. the overlay's partial-aggregate frames
+#: whose wire size is *estimated* and charged at ingress).
+WIRE_BUDGETS: dict = {}
+
+_ENCODE_PREFIXES = ("encode", "marshal")
+_DECODE_PREFIXES = ("decode", "unmarshal")
+
+
+def _infer_role(obj) -> str:
+    if isinstance(obj, type):
+        return "both"
+    name = getattr(obj, "__name__", "")
+    leaf = name.lstrip("_")
+    if any(leaf.startswith(p) for p in _DECODE_PREFIXES):
+        return "decode"
+    if any(leaf.startswith(p) for p in _ENCODE_PREFIXES):
+        return "encode"
+    return "both"
+
+
+def wire_codec(*, tag: str, max_bytes: int, version: int = 1,
+               role: str = None):
+    """Register a wire codec endpoint under the frame-family ``tag``.
+
+    Apply to an ``encode_*`` / ``marshal_*`` function, its matching
+    ``decode_*`` / ``unmarshal_*``, or ONCE to a class that carries the
+    ``marshal``/``unmarshal`` pair as methods. ``max_bytes`` is the
+    decode byte budget HDS005 enforces per frame of this family (the
+    surge MaxBytes analogue, declared where the format is defined
+    instead of implied by call sites). ``role`` is inferred from the
+    name when omitted. Pure marker at call time: returns the object
+    unchanged apart from an ``__hd_wire_codec__`` attribute.
+    """
+    if max_bytes <= 0:
+        raise ValueError(f"wire_codec max_bytes must be positive: {max_bytes}")
+
+    def deco(obj):
+        spec = WireCodecSpec(
+            tag=str(tag),
+            max_bytes=int(max_bytes),
+            version=int(version),
+            role=role if role is not None else _infer_role(obj),
+            name=getattr(obj, "__name__", "?"),
+            module=getattr(obj, "__module__", "?"),
+        )
+        try:
+            obj.__hd_wire_codec__ = spec
+        except (AttributeError, TypeError):  # slotted callables
+            pass
+        WIRE_CODECS.setdefault(spec.tag, []).append(spec)
+        return obj
+
+    return deco
+
+
+def wire_entry(fn=None):
+    """Mark ``fn`` as a wire entry point: its byte-typed parameters are
+    untrusted (authored by a potentially Byzantine peer). HD007/HD008
+    seed their taint lattice from these markers in addition to the
+    intrinsic socket-receive sources, so handlers that take already-
+    framed payloads (inbox pumps, replay loaders) stay in the audited
+    set. Pure marker, usable bare or called."""
+    if fn is None:
+        return wire_entry
+    try:
+        fn.__hd_wire_entry__ = True
+    except (AttributeError, TypeError):  # builtins / slotted callables
+        pass
+    return fn
+
+
+def declare_wire_budget(tag: str, max_bytes: int) -> None:
+    """Declare a decode budget for a frame family with no byte codec of
+    its own (object-frame seams: the ingress handler estimates the wire
+    size and charges it via the sanitizer's ``wire_charge``)."""
+    if max_bytes <= 0:
+        raise ValueError(f"wire budget must be positive: {max_bytes}")
+    WIRE_BUDGETS[str(tag)] = int(max_bytes)
+
+
+def wire_budget_for(tag: str):
+    """The declared ``max_bytes`` for ``tag`` (codec registrations win
+    over budget-only declarations), or None when the tag is unknown."""
+    specs = WIRE_CODECS.get(tag)
+    if specs:
+        return min(s.max_bytes for s in specs)
+    return WIRE_BUDGETS.get(tag)
 
 
 def device_fetch(x, *, why: str = ""):

@@ -191,12 +191,22 @@ def iter_python_files(paths) -> list:
 def lint_paths(paths, rules, strict: bool = False):
     """Run ``rules`` over ``paths``.
 
+    Rules come in two shapes: per-file rules expose ``check(ctx)`` and
+    run once per parsed file; package rules (the HD007–HD010 wire
+    dataflow set) expose ``check_package(ctxs)`` and run ONCE over the
+    full parsed file set, because their properties — taint crossing
+    module boundaries, codec-pair completeness — do not decompose per
+    file. Both kinds yield plain :class:`Finding`\\ s, and suppressions
+    apply identically: a package finding is waived by a pragma in the
+    file it points at.
+
     Returns ``(findings, errors)``: surviving findings sorted by
     location, and non-lint problems (unreadable / unparsable files) as
     strings. ``strict`` adds HD000 findings for reasonless
     suppressions."""
     findings: list = []
     errors: list = []
+    ctxs: list = []
     for path in iter_python_files(paths):
         try:
             with open(path, encoding="utf-8") as fh:
@@ -205,15 +215,26 @@ def lint_paths(paths, rules, strict: bool = False):
             errors.append(f"{path}: unreadable: {e}")
             continue
         try:
-            ctx = FileContext(path, source)
+            ctxs.append(FileContext(path, source))
         except SyntaxError as e:
             errors.append(f"{path}: syntax error: {e}")
-            continue
+    by_path = {ctx.path: ctx for ctx in ctxs}
+    file_rules = [r for r in rules if hasattr(r, "check")]
+    package_rules = [r for r in rules if hasattr(r, "check_package")]
+    for ctx in ctxs:
         raw: list = []
-        for rule in rules:
+        for rule in file_rules:
             raw.extend(rule.check(ctx))
         findings.extend(f for f in set(raw) if not ctx.suppressed(f))
-        if strict:
+    raw = []
+    for rule in package_rules:
+        raw.extend(rule.check_package(ctxs))
+    for f in set(raw):
+        ctx = by_path.get(f.path)
+        if ctx is None or not ctx.suppressed(f):
+            findings.append(f)
+    if strict:
+        for ctx in ctxs:
             findings.extend(ctx.suppression_issues())
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
     return findings, errors

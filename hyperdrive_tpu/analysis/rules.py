@@ -15,7 +15,8 @@ from hyperdrive_tpu.analysis.engine import Finding
 
 __all__ = ["ALL_RULES", "default_rules", "HostSyncRule", "RetraceRule",
            "NondetIterRule", "DtypeWidthRule", "MetricNameRule",
-           "AsyncFetchRule"]
+           "AsyncFetchRule", "WireTaintRule", "WireBoundsRule",
+           "CodecPairRule", "TagDispatchRule"]
 
 _CASTS = frozenset({"int", "float", "bool"})
 _NP_CONVERTERS = frozenset(
@@ -804,10 +805,18 @@ class AsyncFetchRule:
         return findings
 
 
+from hyperdrive_tpu.analysis.wireflow import (  # noqa: E402
+    CodecPairRule,
+    TagDispatchRule,
+    WireBoundsRule,
+    WireTaintRule,
+)
+
 ALL_RULES = {
     r.code: r
     for r in (HostSyncRule, RetraceRule, NondetIterRule, DtypeWidthRule,
-              MetricNameRule, AsyncFetchRule)
+              MetricNameRule, AsyncFetchRule, WireTaintRule, WireBoundsRule,
+              CodecPairRule, TagDispatchRule)
 }
 
 

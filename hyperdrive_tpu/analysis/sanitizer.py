@@ -18,6 +18,14 @@ DeviceTallyFlusher's per-launch tally view:
   bit-equal to the host counters for every answered query
   (:class:`~hyperdrive_tpu.ops.votegrid.CheckedTallyView` differential,
   re-raised under the rule name).
+* **HDS005** wire decode budget: every frame decoded at a wire seam
+  (TcpNode ingress, ServicePort/RemoteServiceClient frames, flight and
+  scenario replay loaders, overlay partial frames) is charged against
+  the ``max_bytes`` its ``@wire_codec`` registration declared — the
+  surge-style accounting from codec.py, but with the PER-FAMILY budget
+  the format author wrote down instead of the one global MAX_BYTES.
+  A decode that reads past its family budget, or a frame family with
+  no registration at all, raises here.
 
 Toggled by ``HD_SANITIZE`` (tests default it ON via conftest; perf runs
 export ``HD_SANITIZE=0`` — see BENCH.md). Violations raise
@@ -30,7 +38,8 @@ from __future__ import annotations
 import os
 
 __all__ = ["SanitizerError", "enabled", "install", "maybe_install",
-           "maybe_tally_check"]
+           "maybe_tally_check", "WireBudget", "maybe_wire_reader",
+           "wire_charge"]
 
 
 class SanitizerError(AssertionError):
@@ -46,6 +55,115 @@ def enabled() -> bool:
     return os.environ.get("HD_SANITIZE", "0").strip().lower() in (
         "1", "true", "on", "yes"
     )
+
+
+class WireBudget:
+    """HDS005 accounting for ONE frame family: resolves the registered
+    ``max_bytes`` for ``tag`` and charges decode reads against it.
+
+    ``reader(payload)`` returns a budget-capped
+    :class:`~hyperdrive_tpu.codec.Reader` whose exhaustion re-raises as
+    HDS005 (instead of the generic SerdeError budget message), so a
+    decoder that reads past its family's declared budget dies loudly
+    under HD_SANITIZE while plain malformed input keeps its typed
+    SerdeError. ``charge(nbytes)`` is the object-frame variant for
+    seams with no byte decode (overlay partial frames): the handler
+    estimates the frame's wire size and charges it up front.
+    """
+
+    __slots__ = ("tag", "max_bytes", "_obs")
+
+    def __init__(self, tag: str, obs=None):
+        from hyperdrive_tpu.analysis.annotations import wire_budget_for
+
+        max_bytes = wire_budget_for(tag)
+        if max_bytes is None:
+            raise SanitizerError(
+                "HDS005",
+                f"decode of unregistered wire frame family {tag!r}: every "
+                "decode seam must name a @wire_codec tag (or a "
+                "declare_wire_budget entry) so its byte budget is "
+                "accounted",
+            )
+        self.tag = tag
+        self.max_bytes = max_bytes
+        self._obs = obs
+
+    def _exceeded(self, needed: int) -> SanitizerError:
+        if self._obs is not None:
+            self._obs.emit("wire.budget.exceeded", -1, -1, -1,
+                           f"{self.tag}:{needed}")
+        return SanitizerError(
+            "HDS005",
+            f"decode of a {self.tag!r} frame read past its registered "
+            f"budget: needs {needed} bytes, max_bytes={self.max_bytes} "
+            "(raise the registration or fix the decoder's caps)",
+        )
+
+    def charge(self, nbytes: int) -> int:
+        if nbytes > self.max_bytes:
+            raise self._exceeded(nbytes)
+        return nbytes
+
+    def reader(self, payload: bytes):
+        # Charge the frame itself first: a payload already wider than
+        # the family budget is a violation before the first read.
+        if len(payload) > self.max_bytes:
+            raise self._exceeded(len(payload))
+        r = _budget_reader_cls()(payload, rem=self.max_bytes)
+        r._budget = self
+        return r
+
+
+#: Built once on first use — this sits on every frame decode under
+#: HD_SANITIZE, so per-call class creation would tax the whole suite.
+_BUDGET_READER_CLS = None
+
+
+def _budget_reader_cls():
+    global _BUDGET_READER_CLS
+    if _BUDGET_READER_CLS is None:
+        from hyperdrive_tpu.codec import Reader, SerdeError
+
+        class _BudgetReader(Reader):
+            __slots__ = ("_budget",)
+
+            def _take(self, n):
+                try:
+                    return Reader._take(self, n)
+                except SerdeError:
+                    b = self._budget
+                    if self.rem < n:  # budget breach, not mere underflow
+                        raise b._exceeded(
+                            b.max_bytes - self.rem + n
+                        ) from None
+                    raise
+
+        _BUDGET_READER_CLS = _BudgetReader
+    return _BUDGET_READER_CLS
+
+
+def maybe_wire_reader(tag: str, payload: bytes, obs=None, rem=None):
+    """The decode-seam helper: an HDS005 budget reader for ``tag`` when
+    the sanitizer is on, a plain Reader otherwise. Wire seams call this
+    instead of ``Reader(payload)`` so the per-family accounting
+    interposes with zero code at the call site. ``rem`` preserves a
+    seam's historical sanitizer-off byte budget when it differs from
+    the codec default (the giant scenario/checkpoint loaders)."""
+    if enabled():
+        return WireBudget(tag, obs=obs).reader(payload)
+    from hyperdrive_tpu.codec import Reader
+
+    return Reader(payload) if rem is None else Reader(payload, rem=rem)
+
+
+def wire_charge(tag: str, nbytes: int, obs=None) -> int:
+    """Object-frame seams (no byte decode): charge an estimated wire
+    size against ``tag``'s budget under HD_SANITIZE; no-op otherwise.
+    Returns ``nbytes`` so the charge can wrap an expression."""
+    if enabled():
+        WireBudget(tag, obs=obs).charge(nbytes)
+    return nbytes
 
 
 def _check_lock(proc) -> None:

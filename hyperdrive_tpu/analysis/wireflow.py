@@ -1,0 +1,1025 @@
+"""Interprocedural wire-taint dataflow: rules HD007–HD010.
+
+The first six hdlint rules are per-file and syntactic. The wire rules
+cannot be: untrusted bytes enter in ``transport.py`` and flow through
+decoders defined two modules away, and codec-pair completeness is a
+property of the PACKAGE, not of any single file. This module builds a
+package index over every :class:`~hyperdrive_tpu.analysis.engine.
+FileContext` the engine parsed — every function, every call edge
+resolved by leaf name, every ``@wire_codec`` registration and
+``declare_wire_budget`` call, every ``TAG_*``/``KIND_*`` constant
+group — then seeds a taint lattice at the wire entry points and
+propagates assignment flow across call edges to a fixpoint. Nothing is
+ever imported: like the rest of hdlint, the analysis reads the same
+decorators the runtime registry executes, purely from the AST.
+
+The lattice is deliberately byte-centric:
+
+* **wire bytes** — values a Byzantine peer authored: results of socket
+  receives (``_recv_exact``/``recv``), parameters of ``@wire_entry``
+  functions, parameters of registered decode-role codecs (a decoder's
+  input is untrusted BY CONTRACT — that is what the registration
+  asserts), file reads inside ``@wire_entry`` replay loaders, and
+  anything sliced/concatenated from the above.
+* **wire ints** — integers derived from wire bytes: reader primitives
+  (``r.u32()`` …), ``int.from_bytes`` over tainted bytes,
+  ``struct.unpack`` of tainted buffers, subscripts of tainted bytes.
+* **laundering** — passing wire bytes to ``Reader``/
+  ``maybe_wire_reader`` or a *registered* decoder produces clean
+  values: the codec layer's byte budget plus the decoder's own caps
+  are the validation boundary (and HD008 audits the decoders
+  themselves, so the boundary is not taken on faith).
+
+HD007 flags raw wire bytes reaching digest/commit/state scope without
+crossing that boundary. HD008 flags allocation-shaped uses of wire
+ints (``range``/``bytearray``/sequence-repeat) with no bounds check,
+and ``int.from_bytes`` over unbounded tainted buffers; a loop that
+consumes its own reader per iteration is exempt (the byte budget
+bounds it). HD009 proves codec-registry closure and pair
+completeness. HD010 proves frame-tag dispatch exhaustiveness in every
+codec-bearing module. The runtime complement is HDS005
+(analysis/sanitizer.py): the same registered budgets, enforced on live
+frames under ``HD_SANITIZE``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from hyperdrive_tpu.analysis.engine import Finding
+
+__all__ = [
+    "WireTaintRule",
+    "WireBoundsRule",
+    "CodecPairRule",
+    "TagDispatchRule",
+    "PackageIndex",
+    "wire_report",
+]
+
+#: Calls whose result is attacker-authored bytes wherever they appear.
+_SOURCE_CALLS = frozenset({"_recv_exact", "recv", "recvfrom", "recv_into"})
+#: Inside a @wire_entry function, file reads are replay input — the
+#: chaos/flight loaders feed recorded (possibly mutated) frames back in.
+_ENTRY_SOURCE_CALLS = _SOURCE_CALLS | frozenset({"read"})
+#: Reader primitives yielding wire ints / validated byte fields.
+_READER_INT_METHODS = frozenset({"u8", "u16", "u32", "u64", "i8", "i64"})
+_READER_METHODS = _READER_INT_METHODS | frozenset(
+    {"raw", "bytes32", "f64", "bool", "done", "remaining_bytes"}
+)
+#: Constructors that launder wire bytes into budget-accounted reads.
+_LAUNDER_CALLS = frozenset({"Reader", "maybe_wire_reader"})
+#: Digest/commit sinks: hash constructors, incremental hash feeding,
+#: and the committer seam. Raw wire bytes must never reach these.
+_SINK_CALLS = frozenset(
+    {"sha256", "sha512", "sha3_256", "blake2b", "blake2s", "md5",
+     "update", "commit"}
+)
+#: Allocation shapes a wire int must not size unguarded.
+_ALLOC_CALLS = frozenset({"range", "bytearray", "bytes"})
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+_TAINT_ROUNDS = 8
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _leaf(node):
+    """Rightmost identifier of a Name/Attribute/Call target, or None."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _const_int(node, env=None):
+    """Evaluate a compile-time int expression (Constant, module
+    constant by Name, +,-,*,<<,// of the same). None when dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name) and env is not None:
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand, env)
+        return None if v is None else -v
+    if isinstance(node, ast.BinOp):
+        lhs = _const_int(node.left, env)
+        rhs = _const_int(node.right, env)
+        if lhs is None or rhs is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return lhs + rhs
+        if isinstance(node.op, ast.Sub):
+            return lhs - rhs
+        if isinstance(node.op, ast.Mult):
+            return lhs * rhs
+        if isinstance(node.op, ast.LShift):
+            return lhs << rhs
+        if isinstance(node.op, ast.FloorDiv) and rhs:
+            return lhs // rhs
+    return None
+
+
+def _decorators(node):
+    """{leaf name: decorator node} for a function/class definition."""
+    out = {}
+    for dec in node.decorator_list:
+        name = _leaf(dec)
+        if name is not None:
+            out[name] = dec
+    return out
+
+
+def _slice_width(sub, env):
+    """Constant byte width of ``x[a:b]``, or None. Recognizes const
+    bounds and the ``x[off : off + K]`` cursor idiom."""
+    sl = sub.slice
+    if not isinstance(sl, ast.Slice) or sl.step is not None:
+        return None
+    lo, hi = sl.lower, sl.upper
+    lo_c = 0 if lo is None else _const_int(lo, env)
+    hi_c = None if hi is None else _const_int(hi, env)
+    if lo_c is not None and hi_c is not None:
+        return max(0, hi_c - lo_c)
+    if lo is not None and isinstance(hi, ast.BinOp) \
+            and isinstance(hi.op, ast.Add):
+        k = _const_int(hi.right, env)
+        if k is not None and ast.dump(hi.left) == ast.dump(lo):
+            return k
+        k = _const_int(hi.left, env)
+        if k is not None and ast.dump(hi.right) == ast.dump(lo):
+            return k
+    return None
+
+
+# --------------------------------------------------------- package index
+
+
+class _Func:
+    __slots__ = ("node", "ctx", "qual", "leaf", "params", "is_method",
+                 "decorators")
+
+    def __init__(self, node, ctx, qual, is_method):
+        self.node = node
+        self.ctx = ctx
+        self.qual = qual
+        self.leaf = node.name
+        args = node.args
+        self.params = [a.arg for a in (
+            args.posonlyargs + args.args
+        )]
+        self.is_method = is_method
+        self.decorators = _decorators(node)
+
+
+class _Codec:
+    __slots__ = ("tag", "max_bytes", "version", "role", "name", "path",
+                 "line")
+
+    def __init__(self, tag, max_bytes, version, role, name, path, line):
+        self.tag = tag
+        self.max_bytes = max_bytes
+        self.version = version
+        self.role = role
+        self.name = name
+        self.path = path
+        self.line = line
+
+
+def _codec_role(node):
+    if isinstance(node, ast.ClassDef):
+        return "both"
+    leaf = node.name.lstrip("_")
+    if leaf.startswith(("decode", "unmarshal")):
+        return "decode"
+    if leaf.startswith(("encode", "marshal")):
+        return "encode"
+    return "both"
+
+
+class PackageIndex:
+    """Everything the wire rules need, built once from the parsed
+    FileContexts and shared by all four ``check_package`` calls."""
+
+    def __init__(self, ctxs):
+        self.ctxs = list(ctxs)
+        #: leaf name -> [_Func]: the call-resolution table.
+        self.by_leaf: dict = {}
+        #: all functions, definition order.
+        self.funcs: list = []
+        #: every @wire_codec registration found in the AST.
+        self.codecs: list = []
+        #: every declare_wire_budget(tag, n) module-level call.
+        self.budgets: list = []
+        #: path -> {const name: int} module constant environment.
+        self.const_env: dict = {}
+        #: leafs that launder taint (registered decoders + Reader).
+        self.launder_leafs: set = set(_LAUNDER_CALLS)
+        #: (dec node, def node, ctx) registrations, evaluated after every
+        #: module's constants are known (max_bytes may name a constant
+        #: IMPORTED from another module, e.g. transport._MAX_FRAME).
+        self._pending_codecs: list = []
+        for ctx in self.ctxs:
+            self._index_file(ctx)
+        #: name -> int across every module: the cross-module fallback for
+        #: max_bytes expressions naming an imported constant. Ambiguous
+        #: names (same name, different values) are dropped — a budget
+        #: must resolve uniquely or not at all.
+        self.global_consts: dict = {}
+        dropped: set = set()
+        for env in self.const_env.values():
+            for name, value in env.items():
+                if name in dropped:
+                    continue
+                if name in self.global_consts \
+                        and self.global_consts[name] != value:
+                    del self.global_consts[name]
+                    dropped.add(name)
+                else:
+                    self.global_consts[name] = value
+        for dec, node, ctx in self._pending_codecs:
+            self._collect_codec(dec, node, ctx)
+        for codec in self.codecs:
+            if codec.role in ("decode", "both"):
+                self.launder_leafs.add(codec.name)
+
+    # -- construction
+
+    def _index_file(self, ctx) -> None:
+        env: dict = {}
+        self.const_env[ctx.path] = env
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                v = _const_int(node.value, env)
+                if v is not None:
+                    env[node.targets[0].id] = v
+            elif isinstance(node, ast.Expr) \
+                    and isinstance(node.value, ast.Call) \
+                    and _leaf(node.value) == "declare_wire_budget":
+                self._collect_budget(node.value, ctx, env)
+        self._index_scope(ctx.tree.body, ctx, qual_prefix="",
+                          is_method=False, env=env)
+
+    def _index_scope(self, body, ctx, qual_prefix, is_method, env) -> None:
+        for node in body:
+            if isinstance(node, _FUNC_NODES):
+                fn = _Func(node, ctx, qual_prefix + node.name, is_method)
+                self.funcs.append(fn)
+                self.by_leaf.setdefault(fn.leaf, []).append(fn)
+                for dec in node.decorator_list:
+                    if _leaf(dec) == "wire_codec":
+                        self._pending_codecs.append((dec, node, ctx))
+                # nested defs resolve like module functions (closures
+                # over inbox pumps etc.) — index one level down.
+                self._index_scope(node.body, ctx,
+                                  qual_prefix + node.name + ".",
+                                  is_method=False, env=env)
+            elif isinstance(node, ast.ClassDef):
+                for dec in node.decorator_list:
+                    if _leaf(dec) == "wire_codec":
+                        self._pending_codecs.append((dec, node, ctx))
+                self._index_scope(node.body, ctx, node.name + ".",
+                                  is_method=True, env=env)
+
+    def _collect_codec(self, dec, node, ctx) -> None:
+        env = dict(self.global_consts)
+        env.update(self.const_env.get(ctx.path, {}))
+        tag = max_bytes = None
+        version = 1
+        role = None
+        if isinstance(dec, ast.Call):
+            for kw in dec.keywords:
+                if kw.arg == "tag" and isinstance(kw.value, ast.Constant):
+                    tag = kw.value.value
+                elif kw.arg == "max_bytes":
+                    max_bytes = _const_int(kw.value, env)
+                elif kw.arg == "version":
+                    version = _const_int(kw.value, env) or 1
+                elif kw.arg == "role" \
+                        and isinstance(kw.value, ast.Constant):
+                    role = kw.value.value
+        self.codecs.append(_Codec(
+            tag=tag, max_bytes=max_bytes, version=version,
+            role=role if role is not None else _codec_role(node),
+            name=node.name, path=ctx.path, line=node.lineno,
+        ))
+
+    def _collect_budget(self, call, ctx, env) -> None:
+        if len(call.args) >= 2 and isinstance(call.args[0], ast.Constant):
+            self.budgets.append(_Codec(
+                tag=call.args[0].value,
+                max_bytes=_const_int(call.args[1], env),
+                version=1, role="budget", name="declare_wire_budget",
+                path=ctx.path, line=call.lineno,
+            ))
+
+    # -- call resolution
+
+    def resolve(self, call) -> list:
+        """Candidate package functions for a call, by leaf name. A call
+        through an attribute only matches methods; a bare name only
+        matches module-level functions."""
+        leaf = _leaf(call)
+        if leaf is None:
+            return []
+        via_attr = isinstance(call.func, ast.Attribute)
+        return [
+            f for f in self.by_leaf.get(leaf, ())
+            if f.is_method == via_attr
+        ]
+
+
+_INDEX_CACHE: list = [None, None]  # [key, PackageIndex]
+
+
+def index_for(ctxs) -> PackageIndex:
+    """One shared index per lint run: the four wire rules receive the
+    same ctx list object sequence, so a single-slot memo suffices."""
+    key = tuple(id(c) for c in ctxs)
+    if _INDEX_CACHE[0] != key:
+        _INDEX_CACHE[0] = key
+        _INDEX_CACHE[1] = PackageIndex(ctxs)
+    return _INDEX_CACHE[1]
+
+
+# ---------------------------------------------------------- taint engine
+
+
+class _FuncTaint:
+    """One function's lattice state after intraprocedural propagation."""
+
+    __slots__ = ("func", "tainted", "wire_ints", "readers", "guarded",
+                 "prop")
+
+    def __init__(self, func):
+        self.func = func
+        self.tainted: set = set()      # wire-bytes names
+        self.wire_ints: dict = {}      # name -> producing reader or None
+        self.readers: set = set()      # names used as codec Readers
+        self.guarded: set = set()      # names bounds-checked somewhere
+        self.prop: list = []           # (call, tainted arg positions)
+
+
+def _find_readers(node) -> set:
+    """Names that behave as codec Readers in this function: assigned
+    from a laundering constructor, or having reader primitives called
+    on them."""
+    out: set = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and isinstance(n.func.value, ast.Name) \
+                and n.func.attr in _READER_METHODS:
+            out.add(n.func.value.id)
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and _leaf(n.value) in _LAUNDER_CALLS:
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _find_guards(node) -> set:
+    """Names that appear in any comparison or min()/max() clamp — the
+    coarse 'a bounds check exists' evidence HD008 accepts."""
+    out: set = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Compare):
+            for sub in ast.walk(n):
+                leaf = _leaf(sub) if isinstance(
+                    sub, (ast.Name, ast.Attribute)
+                ) else None
+                if leaf is not None:
+                    out.add(leaf)
+        elif isinstance(n, ast.Call) and _leaf(n) in ("min", "max"):
+            for arg in n.args:
+                if isinstance(arg, ast.Name):
+                    out.add(arg.id)
+    return out
+
+
+def _is_entry(func) -> bool:
+    return "wire_entry" in func.decorators
+
+
+def _analyze(func, index, seed_params) -> _FuncTaint:
+    """Intraprocedural pass: seed taint, iterate assignments to a local
+    fixpoint, record interprocedural propagation edges."""
+    st = _FuncTaint(func)
+    st.readers = _find_readers(func.node)
+    st.guarded = _find_guards(func.node)
+    st.tainted |= seed_params
+    entry = _is_entry(func)
+    sources = _ENTRY_SOURCE_CALLS if entry else _SOURCE_CALLS
+
+    def bytes_tainted(e) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in st.tainted
+        if isinstance(e, ast.Subscript):
+            if isinstance(e.slice, ast.Slice):
+                return bytes_tainted(e.value)
+            return False  # x[i] is an int, handled by wire_int
+        if isinstance(e, ast.Call):
+            leaf = _leaf(e)
+            if leaf in sources:
+                return True
+            return False
+        if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
+            return bytes_tainted(e.left) or bytes_tainted(e.right)
+        if isinstance(e, (ast.IfExp,)):
+            return bytes_tainted(e.body) or bytes_tainted(e.orelse)
+        return False
+
+    def int_reader(e):
+        """(is_wire_int, producing_reader_name) for an expression."""
+        if isinstance(e, ast.Name):
+            if e.id in st.wire_ints:
+                return True, st.wire_ints[e.id]
+            return False, None
+        if isinstance(e, ast.Call):
+            f = e.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+                if f.attr in _READER_INT_METHODS \
+                        and f.value.id in st.readers:
+                    return True, f.value.id
+                if f.attr in ("from_bytes",) and e.args \
+                        and bytes_tainted(e.args[0]):
+                    return True, None
+                if f.attr in ("unpack", "unpack_from") and any(
+                    bytes_tainted(a) for a in e.args
+                ):
+                    return True, None
+            return False, None
+        if isinstance(e, ast.Subscript) \
+                and not isinstance(e.slice, ast.Slice) \
+                and bytes_tainted(e.value):
+            return True, None
+        if isinstance(e, ast.BinOp):
+            li, lr = int_reader(e.left)
+            ri, rr = int_reader(e.right)
+            if li or ri:
+                return True, lr if li else rr
+        return False, None
+
+    for _ in range(_TAINT_ROUNDS):
+        before = (len(st.tainted), len(st.wire_ints))
+        for n in ast.walk(func.node):
+            if not isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                continue
+            value = n.value
+            if value is None:
+                continue
+            targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+            names: list = []
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    names.extend(
+                        e.id for e in t.elts if isinstance(e, ast.Name)
+                    )
+            if not names:
+                continue
+            if bytes_tainted(value):
+                st.tainted.update(names)
+            is_int, reader = int_reader(value)
+            if is_int:
+                for name in names:
+                    st.wire_ints.setdefault(name, reader)
+        if (len(st.tainted), len(st.wire_ints)) == before:
+            break
+
+    # Interprocedural edges: tainted bytes handed to package functions
+    # (laundering callees stop the flow — that boundary is audited by
+    # HD008 on the decoder side).
+    for n in ast.walk(func.node):
+        if not isinstance(n, ast.Call):
+            continue
+        leaf = _leaf(n)
+        if leaf is None or leaf in index.launder_leafs:
+            continue
+        positions = [
+            i for i, a in enumerate(n.args) if bytes_tainted(a)
+        ]
+        if positions:
+            st.prop.append((n, positions))
+    return st
+
+
+def _taint_fixpoint(index) -> dict:
+    """Propagate parameter taint across call edges until stable.
+    Returns {func -> _FuncTaint} with final lattices."""
+    seeds: dict = {}
+    for f in index.funcs:
+        seed: set = set()
+        if _is_entry(f):
+            seed |= {p for p in f.params if p not in ("self", "cls")}
+        if "wire_codec" in f.decorators \
+                and _codec_role(f.node) == "decode":
+            seed |= {p for p in f.params if p not in ("self", "cls")}
+        seeds[f] = seed
+    states: dict = {}
+    for _ in range(_TAINT_ROUNDS):
+        changed = False
+        for f in index.funcs:
+            states[f] = _analyze(f, index, seeds[f])
+        for f in index.funcs:
+            for call, positions in states[f].prop:
+                for callee in index.resolve(call):
+                    offset = 1 if callee.is_method and callee.params[:1] in (
+                        ["self"], ["cls"]
+                    ) else 0
+                    for i in positions:
+                        if i + offset < len(callee.params):
+                            p = callee.params[i + offset]
+                            if p not in seeds[callee]:
+                                seeds[callee].add(p)
+                                changed = True
+        if not changed:
+            break
+    return states
+
+
+_STATES_CACHE: list = [None, None]
+
+
+def _states_for(index) -> dict:
+    if _STATES_CACHE[0] is not index:
+        _STATES_CACHE[0] = index
+        _STATES_CACHE[1] = _taint_fixpoint(index)
+    return _STATES_CACHE[1]
+
+
+# ----------------------------------------------------------------- rules
+
+
+class WireTaintRule:
+    """HD007: untrusted wire bytes reaching digest/commit/state scope
+    without passing a registered validator/decoder.
+
+    Wire bytes (socket receives, ``@wire_entry`` parameters, registered
+    decoders' inputs and everything derived from them by slicing or
+    concatenation) may flow into exactly one kind of consumer: a
+    laundering boundary — ``Reader``/``maybe_wire_reader`` or a
+    registered ``@wire_codec`` decoder, whose own body HD008 audits.
+    Feeding them RAW to a hash constructor, an ``.update(...)``, a
+    ``commit(...)`` call, or storing them on ``self`` in digest scope
+    means attacker-authored bytes shape a digest or survive into state
+    with zero validation between — the exact bug class surge exists to
+    kill. Route the bytes through the registered decoder for their
+    frame family first (or register one).
+    """
+
+    code = "HD007"
+    name = "wire-taint-to-digest"
+    summary = "raw wire bytes reach digest/commit/state without a decoder"
+
+    def check_package(self, ctxs):
+        index = index_for(ctxs)
+        states = _states_for(index)
+        findings: list = []
+        for f, st in states.items():
+            for n in ast.walk(f.node):
+                if isinstance(n, ast.Call):
+                    leaf = _leaf(n)
+                    if leaf not in _SINK_CALLS:
+                        continue
+                    dirty = [
+                        a for a in list(n.args)
+                        + [kw.value for kw in n.keywords]
+                        if isinstance(a, ast.Name) and a.id in st.tainted
+                    ]
+                    if dirty:
+                        findings.append(Finding(
+                            self.code, f.ctx.path, n.lineno,
+                            f"wire-tainted bytes {dirty[0].id!r} reach "
+                            f"{leaf}() without passing a registered "
+                            "@wire_codec decoder: decode (and "
+                            "budget-check) peer bytes before they touch "
+                            "digest/commit scope",
+                        ))
+                elif isinstance(n, ast.Assign) and "digest" in f.ctx.scopes:
+                    if not (isinstance(n.value, ast.Name)
+                            and n.value.id in st.tainted):
+                        continue
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            findings.append(Finding(
+                                self.code, f.ctx.path, n.lineno,
+                                f"wire-tainted bytes {n.value.id!r} "
+                                "stored into digest-scope state "
+                                f"(self.{t.attr}) without passing a "
+                                "registered decoder",
+                            ))
+        return findings
+
+
+class WireBoundsRule:
+    """HD008: allocation sized by a wire int with no bounds check
+    against a declared budget.
+
+    A length a peer wrote — a reader primitive's result, an
+    ``int.from_bytes`` over tainted bytes, a ``struct.unpack`` of a
+    received buffer — must not size a ``range``/``bytearray``/sequence
+    repeat until the code has compared it against SOMETHING (a cap
+    constant, ``min()``). Two idioms are recognized as already safe:
+    a loop that consumes bytes from the SAME reader every iteration
+    (the codec byte budget bounds it — each iteration costs at least
+    one byte), and constant-width slices (Python clamps slice bounds).
+    ``int.from_bytes`` over a whole tainted buffer or a dynamic-width
+    slice is flagged too: a bigint parse is an allocation.
+    """
+
+    code = "HD008"
+    name = "wire-bounds"
+    summary = "wire-derived length sizes an allocation with no bounds check"
+
+    def _loop_consumes_reader(self, call, parents, reader) -> bool:
+        """True when the range() is the iterable of a loop whose body
+        consumes the producing reader (budget-bounded by construction)."""
+        if reader is None:
+            return False
+        parent = parents.get(id(call))
+        body: list = []
+        if isinstance(parent, (ast.For,)) and parent.iter is call:
+            body = parent.body
+        elif isinstance(parent, ast.comprehension) and parent.iter is call:
+            comp = parents.get(id(parent))
+            if comp is not None:
+                body = [getattr(comp, "elt", None) or comp]
+        for stmt in body:
+            if stmt is None:
+                continue
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == reader \
+                        and n.func.attr in _READER_METHODS:
+                    return True
+        return False
+
+    def check_package(self, ctxs):
+        index = index_for(ctxs)
+        states = _states_for(index)
+        findings: list = []
+        for f, st in states.items():
+            env = index.const_env.get(f.ctx.path, {})
+            parents: dict = {}
+            for n in ast.walk(f.node):
+                for child in ast.iter_child_nodes(n):
+                    parents[id(child)] = n
+
+            def wire_len(e):
+                """(is_wire_int, producer, display name) for an
+                allocation-size argument."""
+                if isinstance(e, ast.Name) and e.id in st.wire_ints:
+                    return True, st.wire_ints[e.id], e.id
+                if isinstance(e, ast.Call) \
+                        and isinstance(e.func, ast.Attribute) \
+                        and isinstance(e.func.value, ast.Name) \
+                        and e.func.attr in _READER_INT_METHODS \
+                        and e.func.value.id in st.readers:
+                    return True, e.func.value.id, \
+                        f"{e.func.value.id}.{e.func.attr}()"
+                return False, None, None
+
+            for n in ast.walk(f.node):
+                if isinstance(n, ast.Call):
+                    leaf = _leaf(n)
+                    if leaf in _ALLOC_CALLS and n.args:
+                        # range(stop) / range(start, stop[, step])
+                        args = n.args if leaf != "range" or len(n.args) == 1 \
+                            else n.args[1:2]
+                        for a in args:
+                            hit, reader, shown = wire_len(a)
+                            if not hit or (
+                                isinstance(a, ast.Name)
+                                and a.id in st.guarded
+                            ):
+                                continue
+                            if leaf == "range" and self._loop_consumes_reader(
+                                n, parents, reader
+                            ):
+                                continue
+                            findings.append(Finding(
+                                self.code, f.ctx.path, n.lineno,
+                                f"{leaf}({shown}) sized by a wire-"
+                                "derived length with no bounds check: "
+                                "compare it against a declared cap "
+                                "(or consume the reader inside the "
+                                "loop so the byte budget bounds it)",
+                            ))
+                    elif leaf == "from_bytes" and n.args:
+                        a = n.args[0]
+                        if isinstance(a, ast.Name) and a.id in st.tainted \
+                                and a.id not in st.guarded:
+                            findings.append(Finding(
+                                self.code, f.ctx.path, n.lineno,
+                                f"int.from_bytes({a.id}) over a whole "
+                                "wire-tainted buffer: a peer-sized "
+                                "bigint parse is an unbounded "
+                                "allocation — slice a constant width "
+                                "or length-check first",
+                            ))
+                        elif isinstance(a, ast.Subscript) \
+                                and isinstance(a.value, ast.Name) \
+                                and a.value.id in st.tainted \
+                                and isinstance(a.slice, ast.Slice) \
+                                and _slice_width(a, env) is None:
+                            findings.append(Finding(
+                                self.code, f.ctx.path, n.lineno,
+                                "int.from_bytes over a dynamic-width "
+                                "slice of wire-tainted bytes: make the "
+                                "width a compile-time constant or "
+                                "bounds-check it first",
+                            ))
+                elif isinstance(n, ast.BinOp) \
+                        and isinstance(n.op, ast.Mult):
+                    for side, other in ((n.left, n.right),
+                                        (n.right, n.left)):
+                        if isinstance(side, ast.Name) \
+                                and side.id in st.wire_ints \
+                                and side.id not in st.guarded \
+                                and isinstance(
+                                    other, (ast.Constant, ast.List,
+                                            ast.Tuple)
+                                ):
+                            findings.append(Finding(
+                                self.code, f.ctx.path, n.lineno,
+                                f"sequence repeat sized by wire int "
+                                f"{side.id!r} with no bounds check",
+                            ))
+                            break
+        return findings
+
+
+class CodecPairRule:
+    """HD009: codec registry closure and pair completeness.
+
+    Every module-level ``encode_*``/``marshal_*``/``decode_*``/
+    ``unmarshal_*`` function, and every class carrying a
+    ``marshal``/``unmarshal`` method pair, must be registered with
+    ``@wire_codec(tag=..., max_bytes=...)`` — an unregistered codec is
+    a frame family with no declared budget, invisible to HDS005 and to
+    the fuzz corpus (tests/test_wire_audit.py parametrizes over the
+    registry, so registration IS test coverage). And every tag must
+    have both directions: an encoder whose tag has no decoder is a
+    frame nobody can reject; a decoder with no encoder is dead attack
+    surface. Registrations must carry a literal tag and a resolvable
+    constant ``max_bytes``.
+    """
+
+    code = "HD009"
+    name = "wire-codec-registry"
+    summary = "codec missing @wire_codec registration or its pair"
+
+    _PREFIXES = ("encode_", "decode_", "marshal_", "unmarshal_")
+    _METHODS = frozenset({"marshal", "unmarshal", "unmarshal_into"})
+
+    def check_package(self, ctxs):
+        index = index_for(ctxs)
+        findings: list = []
+        registered_lines = {(c.path, c.line) for c in index.codecs}
+        # -- closure: every syntactic codec carries the decorator
+        for ctx in ctxs:
+            for node in ctx.tree.body:
+                if isinstance(node, _FUNC_NODES) \
+                        and node.name.startswith(self._PREFIXES) \
+                        and (ctx.path, node.lineno) not in registered_lines:
+                    findings.append(Finding(
+                        self.code, ctx.path, node.lineno,
+                        f"wire codec {node.name}() is not registered: "
+                        "decorate it with @wire_codec(tag=..., "
+                        "max_bytes=...) so its budget is declared and "
+                        "the fuzz corpus covers it",
+                    ))
+                elif isinstance(node, ast.ClassDef):
+                    methods = {
+                        m.name for m in node.body
+                        if isinstance(m, _FUNC_NODES)
+                    }
+                    if methods & self._METHODS \
+                            and (ctx.path, node.lineno) \
+                            not in registered_lines:
+                        findings.append(Finding(
+                            self.code, ctx.path, node.lineno,
+                            f"class {node.name} carries a marshal/"
+                            "unmarshal pair but is not registered: "
+                            "decorate the class with @wire_codec(tag="
+                            "..., max_bytes=...)",
+                        ))
+        # -- registration hygiene + pair completeness
+        by_tag: dict = {}
+        for c in index.codecs:
+            if c.tag is None or c.max_bytes is None:
+                findings.append(Finding(
+                    self.code, c.path, c.line,
+                    f"@wire_codec on {c.name} needs a literal tag and "
+                    "a compile-time-constant max_bytes (the linter and "
+                    "the sanitizer must both resolve them)",
+                ))
+                continue
+            by_tag.setdefault(c.tag, []).append(c)
+        for tag, specs in sorted(by_tag.items()):
+            roles = {c.role for c in specs}
+            first = specs[0]
+            if "both" in roles:
+                continue
+            if "decode" not in roles:
+                findings.append(Finding(
+                    self.code, first.path, first.line,
+                    f"codec tag {tag!r} has encoder(s) but no "
+                    "registered decoder: a frame family nobody can "
+                    "parse-and-reject is unaudited attack surface",
+                ))
+            if "encode" not in roles:
+                findings.append(Finding(
+                    self.code, first.path, first.line,
+                    f"codec tag {tag!r} has decoder(s) but no "
+                    "registered encoder: roundtrip fuzzing needs both "
+                    "directions",
+                ))
+        return findings
+
+
+class TagDispatchRule:
+    """HD010: frame-tag dispatch exhaustiveness.
+
+    In every codec-bearing module (one that registers at least one
+    ``@wire_codec``), the module's ``TAG_*``/``KIND_*`` integer
+    constants form its frame-tag namespace. Two properties must hold:
+    every tag in the namespace is COMPARED somewhere (a tag nobody
+    dispatches on is either dead or silently accepted), and at least
+    one comparing function explicitly raises — the unknown-tag
+    fallthrough must be a typed rejection, never an implicit pass.
+    Fail-closed dispatch is the wire doctrine's second half: budget
+    accounting bounds what a frame may cost, tag exhaustiveness bounds
+    what a frame may MEAN.
+    """
+
+    code = "HD010"
+    name = "tag-dispatch-exhaustive"
+    summary = "frame-tag constant not dispatched, or no unknown-tag reject"
+
+    def check_package(self, ctxs):
+        index = index_for(ctxs)
+        codec_paths = {c.path for c in index.codecs}
+        findings: list = []
+        for ctx in ctxs:
+            if ctx.path not in codec_paths:
+                continue
+            groups: dict = {}  # namespace -> {name: lineno}
+            for node in ctx.tree.body:
+                self._collect(node, "", groups)
+                if isinstance(node, ast.ClassDef):
+                    for sub in node.body:
+                        self._collect(sub, node.name + ".", groups)
+            compared: set = set()
+            raising_compare: set = set()
+            for fn in ast.walk(ctx.tree):
+                if not isinstance(fn, _FUNC_NODES):
+                    continue
+                names = self._compared_names(fn)
+                compared |= names
+                if names and any(
+                    isinstance(n, ast.Raise) for n in ast.walk(fn)
+                ):
+                    raising_compare |= names
+            for ns, members in sorted(groups.items()):
+                if len(members) < 2:
+                    continue
+                missing = sorted(
+                    name for name in members if name not in compared
+                )
+                for name in missing:
+                    findings.append(Finding(
+                        self.code, ctx.path, members[name],
+                        f"frame tag {name} is never compared in any "
+                        "dispatch: a registered tag every decoder "
+                        "ignores is either dead or silently accepted",
+                    ))
+                handled = set(members) - set(missing)
+                if handled and not (handled & raising_compare):
+                    first = min(members.values())
+                    findings.append(Finding(
+                        self.code, ctx.path, first,
+                        f"tag namespace {ns or 'module'} has dispatch "
+                        "but no function that rejects unknown tags "
+                        "with a raise: unknown frames must fail "
+                        "closed",
+                    ))
+        return findings
+
+    @staticmethod
+    def _collect(node, prefix, groups) -> None:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            if name.startswith(("TAG_", "KIND_")) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                groups.setdefault(prefix, {})[name] = node.lineno
+
+    @staticmethod
+    def _compared_names(fn) -> set:
+        out: set = set()
+        for n in ast.walk(fn):
+            if not isinstance(n, ast.Compare):
+                continue
+            for sub in ast.walk(n):
+                leaf = None
+                if isinstance(sub, ast.Name):
+                    leaf = sub.id
+                elif isinstance(sub, ast.Attribute):
+                    leaf = sub.attr
+                if leaf is not None and leaf.startswith(("TAG_", "KIND_")):
+                    out.add(leaf)
+        return out
+
+
+# ------------------------------------------------------------ wire report
+
+
+def wire_report(paths) -> str:
+    """The ``--wire-report`` inventory: every registered codec and
+    budget-only declaration, with its roundtrip-test locations in
+    tests/test_wire_audit.py (found by walking up from the scanned
+    tree). Pure AST — importing nothing, same as the rules."""
+    from hyperdrive_tpu.analysis.engine import FileContext, \
+        iter_python_files
+
+    ctxs = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                ctxs.append(FileContext(path, fh.read()))
+        except (OSError, SyntaxError):
+            continue
+    index = PackageIndex(ctxs)
+    # locate the roundtrip corpus relative to the scanned tree
+    test_lines: dict = {}
+    test_path = None
+    probe = os.path.abspath(paths[0] if paths else ".")
+    for _ in range(6):
+        cand = os.path.join(probe, "tests", "test_wire_audit.py")
+        if os.path.isfile(cand):
+            test_path = cand
+            break
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    if test_path is not None:
+        rel = os.path.relpath(test_path)
+        with open(test_path, encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                for tag in {c.tag for c in index.codecs if c.tag} | {
+                    b.tag for b in index.budgets
+                }:
+                    if f'"{tag}"' in line and tag not in test_lines:
+                        test_lines[tag] = f"{rel}:{lineno}"
+    rows = []
+    by_tag: dict = {}
+    for c in index.codecs:
+        if c.tag is not None:
+            by_tag.setdefault(c.tag, []).append(c)
+    for tag, specs in sorted(by_tag.items()):
+        enc = [c.name for c in specs if c.role in ("encode", "both")]
+        dec = [c.name for c in specs if c.role in ("decode", "both")]
+        rows.append((
+            tag,
+            str(max(c.version for c in specs)),
+            str(min(c.max_bytes for c in specs if c.max_bytes is not None)),
+            "/".join(enc) or "—",
+            "/".join(dec) or "—",
+            test_lines.get(tag, "—"),
+        ))
+    for b in sorted(index.budgets, key=lambda b: b.tag):
+        rows.append((b.tag, "-", str(b.max_bytes), "(budget only)",
+                     "(charged at seam)", test_lines.get(b.tag, "—")))
+    header = ("TAG", "VER", "MAX_BYTES", "ENCODER", "DECODER",
+              "ROUNDTRIP TEST")
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows
+        else len(header[i])
+        for i in range(len(header))
+    ]
+    lines = [
+        "  ".join(h.ljust(widths[i]) for i, h in enumerate(header)),
+        "  ".join("-" * w for w in widths),
+    ]
+    for r in rows:
+        lines.append("  ".join(r[i].ljust(widths[i]) for i in range(len(r))))
+    lines.append("")
+    lines.append(f"{len(by_tag)} codec tag(s), {len(index.budgets)} "
+                 "budget-only declaration(s)")
+    return "\n".join(lines)

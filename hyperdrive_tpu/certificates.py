@@ -47,6 +47,7 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass
 
+from hyperdrive_tpu.analysis.annotations import wire_codec
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
@@ -133,6 +134,7 @@ def _binding(height, round, value_digest, signers, transcript,
     return h.digest()
 
 
+@wire_codec(tag="cert.quorum", max_bytes=8192)
 def marshal_certificate(cert: QuorumCertificate, w: Writer) -> None:
     w.u64(cert.height)
     w.u32(cert.round)
@@ -143,6 +145,7 @@ def marshal_certificate(cert: QuorumCertificate, w: Writer) -> None:
     w.raw(cert.agg_sig)
 
 
+@wire_codec(tag="cert.quorum", max_bytes=8192)
 def unmarshal_certificate(r: Reader) -> QuorumCertificate:
     height = r.u64()
     rnd = r.u32()

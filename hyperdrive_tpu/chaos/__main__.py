@@ -7,7 +7,7 @@ Usage::
         [--pipelined-every K] [--certs-every K] [--bls-certs-every K]
         [--churn-every K] [--overload-every K] [--overlay-every K]
         [--tenants-every K] [--exec-every K] [--exec-pipeline-every K]
-        [--proofs-every K] [--dump-ok DIR]
+        [--proofs-every K] [--fuzz-frames-every K] [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -503,6 +503,124 @@ def _proof_probe(scen_seed: int) -> dict:
     }
 
 
+def _wire_fuzz_probe(scen_seed: int) -> dict:
+    """The Byzantine-bytes fault family (ISSUE 18, jax-free): a real
+    :class:`~hyperdrive_tpu.transport.TcpNode` behind a
+    :class:`~hyperdrive_tpu.chaos.ChaosProxy` with ``fuzz_every`` armed,
+    fed a burst of signed prevote frames where every 3rd payload arrives
+    mutated (seeded truncate / extend / bitflip / tag-smash, length
+    header recomputed so the corruption lands in the DECODE path).
+    Invariants:
+
+    - every CLEAN frame still delivers — a garbage frame must never
+      take honest traffic down with it (FIFO link, so clean deliveries
+      can only be missing if a mutant killed the read loop);
+    - a final clean frame sent after the burst delivers on the SAME
+      connection — the read loop survived every mutant without
+      desyncing or crashing its thread;
+    - every frame the target counted as malformed was one the proxy
+      fuzzed (honest frames never misparse), and the fuzzer never broke
+      framing (``oversize_frames`` stays zero: the corruption is the
+      payload's, not the length prefix's).
+
+    Runs with whatever ``HD_SANITIZE`` the environment sets — CI arms
+    it, so mutants also cross the HDS005 budget accounting.
+    """
+    import socket
+    import time
+
+    from hyperdrive_tpu.chaos.proxy import ChaosProxy
+    from hyperdrive_tpu.crypto.keys import KeyRing
+    from hyperdrive_tpu.messages import Prevote
+    from hyperdrive_tpu.transport import TcpNode, encode_frame
+
+    received: list = []
+
+    class _Sink:
+        def propose(self, m, stop=None):
+            received.append(m)
+
+        prevote = precommit = timeout = propose
+
+    def _await(pred, deadline_s=10.0):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.01)
+        return pred()
+
+    frames, fuzz_every = 60, 3
+    ring = KeyRing.deterministic(1, namespace=b"wirefuzz")
+
+    def _frame(height: int) -> bytes:
+        return encode_frame(ring[0].sign_message(
+            Prevote(height=height, round=0, value=b"\x07" * 32,
+                    sender=ring[0].public)
+        ))
+
+    node = TcpNode()
+    node.add_replica(_Sink())
+    node.start()
+    proxy = ChaosProxy(
+        "127.0.0.1", node.port, seed=scen_seed, fuzz_every=fuzz_every
+    ).start()
+    try:
+        with socket.create_connection(("127.0.0.1", proxy.port)) as s:
+            for h in range(1, frames + 1):
+                s.sendall(_frame(h))
+            if not _await(lambda: proxy.forwarded >= frames):
+                raise InvariantViolation(
+                    "wire-fuzz",
+                    f"proxy forwarded {proxy.forwarded}/{frames} frames",
+                )
+            if proxy.fuzzed != frames // fuzz_every:
+                raise InvariantViolation(
+                    "wire-fuzz",
+                    f"fuzz cadence missed: {proxy.fuzzed} mutations for "
+                    f"{frames} frames at every {fuzz_every}",
+                )
+            clean = frames - proxy.fuzzed
+            if not _await(lambda: len(received) >= clean):
+                raise InvariantViolation(
+                    "wire-fuzz",
+                    f"only {len(received)} of {clean} clean frames "
+                    "delivered — a garbage frame took honest traffic "
+                    "down with it",
+                )
+            # frames+1 is not a multiple of fuzz_every, so the survivor
+            # frame crosses the proxy unmutated.
+            before = len(received)
+            s.sendall(_frame(frames + 1))
+            if not _await(lambda: len(received) > before):
+                raise InvariantViolation(
+                    "wire-fuzz",
+                    "read loop dead after the fuzz burst: a clean "
+                    "frame no longer delivers",
+                )
+        if node.oversize_frames:
+            raise InvariantViolation(
+                "wire-fuzz",
+                f"fuzzer broke framing: target counted "
+                f"{node.oversize_frames} oversize frames",
+            )
+        if node.malformed_frames > proxy.fuzzed:
+            raise InvariantViolation(
+                "wire-fuzz",
+                f"{node.malformed_frames} malformed frames exceed the "
+                f"{proxy.fuzzed} mutations — an honest frame misparsed",
+            )
+        return {
+            "frames": frames + 1,
+            "fuzzed": proxy.fuzzed,
+            "malformed": node.malformed_frames,
+            "delivered": len(received),
+        }
+    finally:
+        proxy.stop()
+        node.stop()
+
+
 def _dump_failure(out: str, scen_seed: int, sim, err) -> str:
     os.makedirs(out, exist_ok=True)
     base = os.path.join(out, f"chaos_seed_{scen_seed}")
@@ -925,6 +1043,30 @@ def soak(args) -> int:
                 f"rejected={sum(e.rejected_total for e in xsim.executors)} "
                 f"roots={len(xsim.executors[0].roots)} root-agreement=ok"
             )
+        if args.fuzz_frames_every and k % args.fuzz_frames_every == 0:
+            # Every Kth scenario additionally runs the Byzantine-bytes
+            # probe (ISSUE 18): a real TcpNode behind a frame-fuzzing
+            # proxy — every 3rd payload mutated, length header intact —
+            # must deliver all clean traffic, survive every mutant
+            # without a read-loop crash, and never misparse an honest
+            # frame.
+            try:
+                wstats = _wire_fuzz_probe(scen_seed)
+            except (InvariantViolation, AssertionError) as err:
+                failures += 1
+                print(
+                    f"FAIL wire-fuzz seed={scen_seed} {err}",
+                    file=sys.stderr,
+                )
+                if not args.keep_going:
+                    return 1
+                continue
+            print(
+                f"ok wire-fuzz seed={scen_seed} "
+                f"frames={wstats['frames']} fuzzed={wstats['fuzzed']} "
+                f"malformed={wstats['malformed']} "
+                f"delivered={wstats['delivered']}"
+            )
         if args.exec_pipeline_every and k % args.exec_pipeline_every == 0:
             # Every Kth scenario additionally runs the speculative-
             # pipeline family (PR 16): forged-but-well-formed tx
@@ -1143,6 +1285,16 @@ def main(argv=None) -> int:
         "roundtrip the wire codec and verify against the chained "
         "root, and all four forged-proof variants must fail "
         "verification; 0 = off)",
+    )
+    p.add_argument(
+        "--fuzz-frames-every",
+        type=int,
+        default=0,
+        help="additionally run every Kth seed as a Byzantine-bytes "
+        "probe (real TcpNode behind a frame-fuzzing proxy mutating "
+        "every 3rd payload; clean traffic must all deliver, the read "
+        "loop must survive every mutant, and honest frames must never "
+        "misparse; 0 = off)",
     )
     p.add_argument(
         "--dump-ok",

@@ -52,6 +52,15 @@ class ChaosProxy:
     overload family's slow-peer fault — the reader-side complement of
     the sender's backpressure: the target node's peer queue toward a
     throttled peer fills and sheds while healthy peers stay fast.
+
+    ``fuzz_every = K > 0`` is the Byzantine-bytes fault: every Kth
+    forwarded frame has its PAYLOAD mutated (seeded truncate / extend /
+    bitflip / tag-swap, same vocabulary as tests/test_wire_audit.py)
+    and its length header recomputed, so the stream stays parseable and
+    the corruption lands in the target's DECODE path, not its framing
+    layer. The target must count the frame (``malformed_frames``) or
+    deliver a still-valid decode — never crash a read thread. ``fuzzed``
+    counts mutations for assertions.
     """
 
     def __init__(
@@ -66,6 +75,7 @@ class ChaosProxy:
         delay: float = 0.0,
         delay_s: tuple[float, float] = (0.005, 0.05),
         bandwidth_bps: float = 0.0,
+        fuzz_every: int = 0,
     ) -> None:
         self._target = (target_host, target_port)
         self._rng = random.Random(seed)
@@ -82,6 +92,13 @@ class ChaosProxy:
         #: Cumulative seconds of serialization delay paid (tests assert
         #: the throttle actually bit).
         self.throttled_s = 0.0
+        if fuzz_every < 0:
+            raise ValueError(f"fuzz_every must be >= 0, got {fuzz_every}")
+        self.fuzz_every = fuzz_every
+        #: Frames mutated by the fuzz fault (tests assert the mutation
+        #: cadence actually bit).
+        self.fuzzed = 0
+        self._fuzz_ctr = 0
         self._partitioned = threading.Event()
         self._stop = threading.Event()
         self.forwarded = 0
@@ -185,6 +202,14 @@ class ChaosProxy:
                         with self._count_lock:
                             self.throttled_s += pay
                         time.sleep(pay)
+                    if self.fuzz_every:
+                        with self._count_lock:
+                            self._fuzz_ctr += 1
+                            hit = self._fuzz_ctr % self.fuzz_every == 0
+                        if hit:
+                            frame = self._fuzz(frame)
+                            with self._count_lock:
+                                self.fuzzed += 1
                     copies = (
                         2 if self.duplicate and r_dup < self.duplicate else 1
                     )
@@ -204,6 +229,36 @@ class ChaosProxy:
                     upstream.close()
                 except OSError:
                     pass
+
+    def _fuzz(self, frame: bytes) -> bytes:
+        """Mutate a frame's payload and recompute its length header.
+
+        The framing layer stays intact on purpose: a bad length prefix
+        only exercises the target's ``_read_frame`` guard, while a
+        well-framed garbage payload reaches ``unmarshal_message`` — the
+        decode path HD007/HDS005 exist to defend. Mutations mirror the
+        wire-audit corpus: truncate, extend with junk, flip one bit,
+        smash the leading tag byte."""
+        payload = frame[_LEN.size:]
+        with self._rng_lock:
+            kind = self._rng.randrange(4)
+            if kind == 0 and len(payload) > 1:
+                payload = payload[: self._rng.randrange(1, len(payload))]
+            elif kind == 1:
+                payload = payload + bytes(
+                    self._rng.randrange(256)
+                    for _ in range(self._rng.randrange(1, 17))
+                )
+            elif kind == 2 and payload:
+                i = self._rng.randrange(len(payload))
+                b = bytearray(payload)
+                b[i] ^= 1 << self._rng.randrange(8)
+                payload = bytes(b)
+            elif payload:
+                b = bytearray(payload)
+                b[0] = self._rng.randrange(256)
+                payload = bytes(b)
+        return _LEN.pack(len(payload)) + payload
 
     def _dial(self) -> "socket.socket | None":
         deadline = time.monotonic() + 5.0

@@ -54,6 +54,7 @@ from hyperdrive_tpu.certificates import (
     marshal_certificate,
     unmarshal_certificate,
 )
+from hyperdrive_tpu.analysis.annotations import wire_codec
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
@@ -484,6 +485,7 @@ class EpochProof:
     cert: QuorumCertificate
 
 
+@wire_codec(tag="epoch.proof", max_bytes=4 << 20)
 def marshal_epoch_proof(proof: EpochProof, w: Writer) -> None:
     w.u64(proof.epoch)
     w.bytes32(proof.prev_set_digest)
@@ -494,6 +496,7 @@ def marshal_epoch_proof(proof: EpochProof, w: Writer) -> None:
     marshal_certificate(proof.cert, w)
 
 
+@wire_codec(tag="epoch.proof", max_bytes=4 << 20)
 def unmarshal_epoch_proof(r: Reader) -> EpochProof:
     epoch = r.u64()
     prev_digest = r.bytes32()

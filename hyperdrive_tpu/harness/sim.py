@@ -33,6 +33,8 @@ import numpy as np
 from dataclasses import dataclass, field, replace
 from typing import Callable, Optional
 
+from hyperdrive_tpu.analysis.annotations import wire_codec, wire_entry
+from hyperdrive_tpu.analysis.sanitizer import maybe_wire_reader
 from hyperdrive_tpu.batch import WindowColumns
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.messages import (
@@ -105,6 +107,7 @@ class VirtualClock:
         return dropped
 
 
+@wire_codec(tag="scenario.record", max_bytes=1 << 30)
 @dataclass
 class ScenarioRecord:
     """A reproducible account of one simulated run
@@ -285,9 +288,12 @@ class ScenarioRecord:
             fh.write(w.data())
 
     @classmethod
+    @wire_entry
     def load(cls, path: str) -> "ScenarioRecord":
         with open(path, "rb") as fh:
-            return cls.unmarshal(Reader(fh.read(), rem=1 << 30))
+            return cls.unmarshal(maybe_wire_reader(
+                "scenario.record", fh.read(), rem=1 << 30
+            ))
 
 
 class _Discard:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass, field, replace
 
+from hyperdrive_tpu.analysis.annotations import wire_codec
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.types import (
     INT64_MIN,
@@ -43,6 +44,7 @@ def _check_i64(v: int, what: str) -> None:
         raise SerdeError(f"{what} out of int64 range: {v}")
 
 
+@wire_codec(tag="msg.propose", max_bytes=1 << 20)
 @dataclass(frozen=True, slots=True)
 class Propose:
     """A proposer's value suggestion for one (height, round).
@@ -128,6 +130,7 @@ class Propose:
         return replace(self, signature=signature)
 
 
+@wire_codec(tag="msg.prevote", max_bytes=256)
 @dataclass(frozen=True, slots=True)
 class Prevote:
     """The first voting step (reference: ``process/message.go:156-162``)."""
@@ -177,6 +180,7 @@ class Prevote:
         return replace(self, signature=signature)
 
 
+@wire_codec(tag="msg.precommit", max_bytes=256)
 @dataclass(frozen=True, slots=True)
 class Precommit:
     """The second voting step (reference: ``process/message.go:254-260``)."""
@@ -230,6 +234,7 @@ class Precommit:
         return replace(self, signature=signature)
 
 
+@wire_codec(tag="msg.timeout", max_bytes=32)
 @dataclass(frozen=True, slots=True)
 class Timeout:
     """A fired timeout event (reference: ``timer/timer.go:14-18``)."""
@@ -270,6 +275,13 @@ _TAG_CLASSES = {
 }
 
 
+#: Widest detached signature the envelope accepts: Ed25519 is 64 bytes,
+#: BLS12-381 G2 is 96 — anything longer is a Byzantine frame, not a key
+#: format we will ever grow into silently.
+_MAX_SIGNATURE = 96
+
+
+@wire_codec(tag="msg.envelope", max_bytes=1 << 20)
 def marshal_message(msg, w: Writer) -> None:
     """Marshal any message with a leading type tag (the wire envelope used
     by scenario records). Unlike the core message serde, the envelope also
@@ -284,8 +296,11 @@ def marshal_message(msg, w: Writer) -> None:
         w.raw(msg.signature)
 
 
+@wire_codec(tag="msg.envelope", max_bytes=1 << 20)
 def unmarshal_message(r: Reader):
-    """Inverse of :func:`marshal_message`."""
+    """Inverse of :func:`marshal_message`. Unknown tags and oversized
+    trailing signatures are typed rejections — the envelope is the first
+    decode a Byzantine peer's bytes meet."""
     ty = r.i8()
     try:
         cls = _TAG_CLASSES[MessageType(ty)]
@@ -294,6 +309,11 @@ def unmarshal_message(r: Reader):
     msg = cls.unmarshal(r)
     if cls is not Timeout:
         signature = r.raw()
+        if len(signature) > _MAX_SIGNATURE:
+            raise SerdeError(
+                f"detached signature too wide: {len(signature)} > "
+                f"{_MAX_SIGNATURE}"
+            )
         if signature:
             msg = msg.with_signature(signature)
     return msg

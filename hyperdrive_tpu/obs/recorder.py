@@ -102,6 +102,9 @@ EVENT_KINDS = frozenset(
         "wire.frame.oversize",
         "wire.frame.shed",
         "wire.frame.stale",
+        # HDS005 decode-budget breach (analysis/sanitizer.py WireBudget):
+        # detail = "<tag>:<bytes needed>".
+        "wire.budget.exceeded",
         "transport.peer.dropped",
         "transport.reconnect",
         # Overload harness (load/): offered-load marks from the open-loop

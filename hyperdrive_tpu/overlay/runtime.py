@@ -47,11 +47,24 @@ import hashlib
 import random
 from dataclasses import dataclass
 
+from hyperdrive_tpu.analysis.annotations import declare_wire_budget
+from hyperdrive_tpu.analysis.sanitizer import wire_charge
 from hyperdrive_tpu.load.frames import STALE_GENERATION, classify_frame
 from hyperdrive_tpu.messages import Precommit, Prevote
 
 from .score import ContributionScores
 from .topology import Topology
+
+#: HDS005 budget for one partial-aggregate frame, as it would cost on a
+#: wire: header + committee-wide mask + 48-byte BLS aggregate + one
+#: full-envelope extra per committee member (the worst legal frame under
+#: the on_frame shape caps). Object frames charge an ESTIMATE of this
+#: footprint at ingress — the sanitizer fires only if the caps and this
+#: budget drift apart.
+declare_wire_budget("overlay.partial", 1 << 20)
+#: Wire-size estimate for one extras envelope (signed vote riding
+#: outside the table): 8 + 8 + 32 + 32 + 64 plus framing slack.
+_EXTRA_WIRE_BYTES = 160
 
 __all__ = [
     "OverlayConfig",
@@ -342,6 +355,9 @@ class OverlayRuntime:
         self.rekeys = 0
         self.bls_partials_attached = 0
         self.bls_partial_rejects = 0
+        #: Frames rejected at the shape caps (mask wider than the
+        #: committee, extras flood) before any state was touched.
+        self.frame_rejects = 0
 
     # -------------------------------------------------------------- events
 
@@ -432,6 +448,23 @@ class OverlayRuntime:
         self._arm(st, slot, node)
 
     def on_frame(self, to: int, frame: OverlayFrame) -> None:
+        # Byzantine frame-shape caps, enforced before ANY state mutation:
+        # a mask wider than the committee or an extras flood is a typed
+        # rejection scored against the contributor — never an unbounded
+        # merge, never a crash.
+        if (frame.mask < 0 or frame.mask.bit_length() > self.n
+                or len(frame.extras) > self.n):
+            self.frame_rejects += 1
+            self._count("overlay.frame.reject")
+            self._charge(frame.src, "invalid", frame.slot, to)
+            return
+        # HDS005: charge the frame's estimated wire footprint against
+        # the declared overlay budget (object seam — no byte decode).
+        wire_charge(
+            "overlay.partial",
+            16 + (frame.mask.bit_length() + 7) // 8 + 48
+            + _EXTRA_WIRE_BYTES * len(frame.extras),
+        )
         slot = frame.slot
         st = self._slots.get(slot)
         if st is None:

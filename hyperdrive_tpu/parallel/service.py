@@ -45,11 +45,13 @@ import struct
 import threading
 import time
 
+from hyperdrive_tpu.analysis.annotations import wire_codec
+from hyperdrive_tpu.analysis.sanitizer import maybe_wire_reader
 from hyperdrive_tpu.certificates import (
     marshal_certificate,
     unmarshal_certificate,
 )
-from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.codec import SerdeError, Writer
 from hyperdrive_tpu.crypto.keys import KeyRing
 from hyperdrive_tpu.messages import Precommit
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
@@ -104,8 +106,13 @@ STATUS_NAMES = ("committed", "no_quorum", "shed", "unknown_tenant",
 _MAX_SIGNATORIES = 4096
 #: Rows per submitted window — far above any committee's 2f+1 burst.
 _MAX_ROWS = 65536
+#: Tenant-name cap for HELLO: a name is an identifier, not a payload.
+_MAX_NAME = 256
+#: Widest per-row detached signature (Ed25519 64, BLS G2 96).
+_MAX_ROW_SIG = 96
 
 
+@wire_codec(tag="service.hello", max_bytes=1 << 18)
 def encode_hello(name: str, signatories, f: int) -> bytes:
     w = Writer()
     w.u8(TAG_HELLO)
@@ -117,6 +124,7 @@ def encode_hello(name: str, signatories, f: int) -> bytes:
     return w.data()
 
 
+@wire_codec(tag="service.submit", max_bytes=_MAX_FRAME)
 def encode_submit(req_id: int, height: int, round: int, value: bytes,
                   rows, generation: int = 0) -> bytes:
     """``rows``: signed :class:`~hyperdrive_tpu.messages.Precommit`s (or
@@ -141,6 +149,7 @@ def encode_submit(req_id: int, height: int, round: int, value: bytes,
     return w.data()
 
 
+@wire_codec(tag="service.result", max_bytes=_MAX_FRAME)
 def encode_result(req_id: int, status: int, nrows: int, mask,
                   cert=None, root=None) -> bytes:
     """``root`` (32 bytes or None) rides between the mask and the
@@ -168,6 +177,7 @@ def encode_result(req_id: int, status: int, nrows: int, mask,
     return w.data()
 
 
+@wire_codec(tag="service.query", max_bytes=64)
 def encode_query(req_id: int, account: int) -> bytes:
     """A stateless client's proof request: ONE account id. The answer
     (:func:`encode_proof`) is self-contained — the client needs nothing
@@ -179,6 +189,7 @@ def encode_query(req_id: int, account: int) -> bytes:
     return w.data()
 
 
+@wire_codec(tag="service.proof", max_bytes=4096)
 def encode_proof(req_id: int, status: int, proof=None) -> bytes:
     """ONE proof frame: leaf values, the O(1) chain witness (previous
     root + state digest), and the O(log n) sibling path — everything
@@ -202,17 +213,20 @@ def encode_proof(req_id: int, status: int, proof=None) -> bytes:
     return w.data()
 
 
+@wire_codec(tag="service.proof", max_bytes=4096)
 def decode_proof(payload: bytes):
     """Client-side decode: ``(req_id, status, proof_or_None)``. Raises
-    SerdeError on malformed bytes or a path deeper than MAX_DEPTH — a
-    Byzantine server cannot make the client loop or allocate
-    unboundedly."""
-    r = Reader(payload)
+    SerdeError on malformed bytes, trailing garbage, or a path deeper
+    than MAX_DEPTH — a Byzantine server cannot make the client loop or
+    allocate unboundedly."""
+    r = maybe_wire_reader("service.proof", payload)
     if r.u8() != TAG_QUERY:
         raise SerdeError("expected a proof frame")
     req_id = r.u64()
     status = r.u8()
     if status != STATUS_COMMITTED:
+        if not r.done():
+            raise SerdeError("trailing bytes after proof status")
         return req_id, status, None
     height = r.i64()
     account = r.u32()
@@ -242,24 +256,51 @@ def decode_proof(payload: bytes):
             for i in range(depth)
         ),
     )
+    if not r.done():
+        raise SerdeError("trailing bytes after proof frame")
     return req_id, status, proof
 
 
+#: First frame byte -> budget family for the shared request decoder:
+#: each request kind is charged against ITS OWN registered budget, so a
+#: 256 KiB hello cannot hide behind the wider submit allowance.
+_REQUEST_FAMILIES = {
+    TAG_HELLO: "service.hello",
+    TAG_SUBMIT: "service.submit",
+    TAG_QUERY: "service.query",
+}
+
+
+@wire_codec(tag="service.hello", max_bytes=1 << 18)
+@wire_codec(tag="service.submit", max_bytes=_MAX_FRAME)
+@wire_codec(tag="service.query", max_bytes=64)
 def decode_request(payload: bytes):
     """Server-side decode: ``("hello", name, f, signatories)``,
     ``("submit", req_id, height, round, value, generation, rows)`` with
     ``rows`` as ``(sender, signature)`` pairs, or
     ``("query", req_id, account)``. Raises SerdeError on anything
-    malformed or over the width caps."""
-    r = Reader(payload)
+    malformed, over the width caps, or carrying trailing garbage — a
+    truncated or padded frame is rejected typed, never half-decoded."""
+    if not payload:
+        raise SerdeError("empty service frame")
+    family = _REQUEST_FAMILIES.get(payload[0])
+    if family is None:
+        raise SerdeError(f"unknown service frame tag: {payload[0]}")
+    r = maybe_wire_reader(family, payload)
     tag = r.u8()
     if tag == TAG_HELLO:
-        name = r.raw().decode("utf-8", "replace")
+        name_raw = r.raw()
+        if len(name_raw) > _MAX_NAME:
+            raise SerdeError(f"tenant name too long: {len(name_raw)}")
+        name = name_raw.decode("utf-8", "replace")
         f = r.u32()
         n = r.u32()
         if n > _MAX_SIGNATORIES:
             raise SerdeError(f"committee too wide: {n}")
-        return ("hello", name, f, [r.bytes32() for _ in range(n)])
+        sigs = [r.bytes32() for _ in range(n)]
+        if not r.done():
+            raise SerdeError("trailing bytes after hello frame")
+        return ("hello", name, f, sigs)
     if tag == TAG_SUBMIT:
         req_id = r.u64()
         height = r.i64()
@@ -269,17 +310,29 @@ def decode_request(payload: bytes):
         n = r.u32()
         if n > _MAX_ROWS:
             raise SerdeError(f"window too wide: {n} rows")
-        rows = [(r.bytes32(), r.raw()) for _ in range(n)]
+        rows = []
+        for _ in range(n):
+            sender = r.bytes32()
+            sig = r.raw()
+            if len(sig) > _MAX_ROW_SIG:
+                raise SerdeError(f"row signature too wide: {len(sig)}")
+            rows.append((sender, sig))
+        if not r.done():
+            raise SerdeError("trailing bytes after submit frame")
         return ("submit", req_id, height, rnd, value, generation, rows)
-    if tag == TAG_QUERY:
-        return ("query", r.u64(), r.u32())
-    raise SerdeError(f"unknown service frame tag: {tag}")
+    req = ("query", r.u64(), r.u32())
+    if not r.done():
+        raise SerdeError("trailing bytes after query frame")
+    return req
 
 
+@wire_codec(tag="service.result", max_bytes=_MAX_FRAME)
 def decode_result(payload: bytes):
     """Client-side decode:
-    ``(req_id, status, mask, cert_or_None, root_or_None)``."""
-    r = Reader(payload)
+    ``(req_id, status, mask, cert_or_None, root_or_None)``. The bitmap
+    must be exactly ``ceil(n/8)`` wide (the canonical encoding) and the
+    frame must end where the certificate tail ends."""
+    r = maybe_wire_reader("service.result", payload)
     if r.u8() != TAG_RESULT:
         raise SerdeError("expected a result frame")
     req_id = r.u64()
@@ -288,14 +341,18 @@ def decode_result(payload: bytes):
     if n > _MAX_ROWS:
         raise SerdeError(f"result mask too wide: {n} rows")
     bitmap = r.raw()
-    if len(bitmap) < -(-n // 8):
-        raise SerdeError("result bitmap narrower than its row count")
+    if len(bitmap) != -(-n // 8):
+        raise SerdeError("result bitmap width disagrees with its row count")
     mask = [bool(bitmap[i >> 3] >> (i & 7) & 1) for i in range(n)]
     root = r.raw() or None
     if root is not None and len(root) != 32:
         raise SerdeError(f"state root must be 32 bytes, got {len(root)}")
     cert_bytes = r.raw()
-    cert = unmarshal_certificate(Reader(cert_bytes)) if cert_bytes else None
+    cert = unmarshal_certificate(
+        maybe_wire_reader("cert.quorum", cert_bytes)
+    ) if cert_bytes else None
+    if not r.done():
+        raise SerdeError("trailing bytes after result frame")
     return req_id, status, mask, cert, root
 
 

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 from typing import Optional, Protocol, runtime_checkable
 
+from hyperdrive_tpu.analysis.annotations import wire_codec
 from hyperdrive_tpu.codec import Reader, Writer
 from hyperdrive_tpu.messages import Precommit, Prevote, Propose
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
@@ -135,6 +136,7 @@ class Catcher(Protocol):
 # -------------------------------------------------------------------- process
 
 
+@wire_codec(tag="process.checkpoint", max_bytes=1 << 28)
 class Process:
     """The consensus automaton for one replica identity.
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from hyperdrive_tpu.analysis.annotations import wire_codec
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.messages import Precommit, Prevote, Propose
 from hyperdrive_tpu.types import (
@@ -42,6 +43,7 @@ class OnceFlag:
 _MAX_LOG_ENTRIES = 1 << 20
 
 
+@wire_codec(tag="state.checkpoint", max_bytes=1 << 28)
 @dataclass
 class State:
     """Consensus-automaton state (paper L1 initialization block)."""

@@ -29,7 +29,9 @@ import socket
 import struct
 import threading
 
-from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+from hyperdrive_tpu.analysis.annotations import wire_codec, wire_entry
+from hyperdrive_tpu.analysis.sanitizer import maybe_wire_reader
+from hyperdrive_tpu.codec import SerdeError, Writer
 from hyperdrive_tpu.messages import (
     Precommit,
     Propose,
@@ -58,6 +60,7 @@ _MAX_FRAME = 1 << 20  # 1 MiB: far above any consensus envelope
 _PEER_QUEUE = 4096
 
 
+@wire_codec(tag="msg.envelope", max_bytes=_MAX_FRAME)
 def encode_frame(msg) -> bytes:
     w = Writer()
     marshal_message(msg, w)
@@ -166,6 +169,12 @@ class TcpNode:
         self.generation = 0
         self.retired: dict = {}
         self.stale_frames = 0
+        #: Wire-anomaly counters (guarded by ``_lock``): frames dropped
+        #: for a malformed envelope / an oversize length header. The
+        #: chaos soak's frame-fuzz leg asserts on these — a mutated
+        #: frame must land HERE, never in a crashed read thread.
+        self.malformed_frames = 0
+        self.oversize_frames = 0
         self._verifiers: list = []
         self._replicas: list = []
         #: peer key -> outbound frame queue, drained by a dedicated sender
@@ -298,6 +307,8 @@ class TcpNode:
                         return
                     (length,) = _LEN.unpack(head)
                     if length > _MAX_FRAME:
+                        with self._lock:
+                            self.oversize_frames += 1
                         if self.obs is not self._obs_null:
                             self.obs.emit("wire.frame.oversize", -1, -1,
                                           length)
@@ -308,8 +319,13 @@ class TcpNode:
                 except OSError:
                     return
                 try:
-                    msg = unmarshal_message(Reader(payload))
+                    msg = unmarshal_message(
+                        maybe_wire_reader("msg.envelope", payload,
+                                          obs=self.obs)
+                    )
                 except SerdeError:
+                    with self._lock:
+                        self.malformed_frames += 1
                     if self.obs is not self._obs_null:
                         self.obs.emit("wire.frame.malformed", -1, -1,
                                       len(payload))
@@ -490,6 +506,7 @@ class TcpNode:
             self.obs.emit("transport.peer.dropped", -1, -1, count)
 
 
+@wire_codec(tag="flight.record", max_bytes=_MAX_FRAME)
 class FlightRecorder:
     """One replica's consumption log: every input the replica's event
     loop consumed — votes, local timeouts, resets — in consumption order.
@@ -545,6 +562,7 @@ class FlightRecorder:
                 f.write(frame)
 
     @staticmethod
+    @wire_entry
     def load(path) -> list:
         """Decode a dumped flight log back into input objects (messages
         and :class:`~hyperdrive_tpu.replica.ResetHeight`), in recorded
@@ -573,9 +591,11 @@ class FlightRecorder:
                 break  # partial body: killed mid-write
             off += 5 + length
             if kind == FlightRecorder.KIND_MSG:
-                out.append(unmarshal_message(Reader(body)))
+                out.append(unmarshal_message(
+                    maybe_wire_reader("msg.envelope", body)
+                ))
             elif kind == FlightRecorder.KIND_RESET:
-                r = Reader(body)
+                r = maybe_wire_reader("flight.record", body)
                 height = r.i64()
                 sigs = tuple(r.raw() for _ in range(r.u32()))
                 out.append(ResetHeight(height, sigs))

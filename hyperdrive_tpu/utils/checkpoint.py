@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import zlib
 
+from hyperdrive_tpu.analysis.sanitizer import maybe_wire_reader
 from hyperdrive_tpu.codec import Reader, SerdeError, Writer
 from hyperdrive_tpu.process import Process
 
@@ -68,7 +69,9 @@ def restore_bytes(proc: Process, data: bytes) -> None:
         )
     if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
         raise SerdeError("checkpoint checksum mismatch")
-    proc.unmarshal_into(Reader(payload, rem=_MAX_BYTES))
+    proc.unmarshal_into(maybe_wire_reader(
+        "process.checkpoint", payload, rem=_MAX_BYTES
+    ))
 
 
 def save_process(proc: Process, path: str) -> None:

@@ -1,0 +1,50 @@
+# hdlint: scope=digest
+"""HD007 fixture: raw wire bytes must pass a decoder before digest/
+commit/state scope. BAD lines feed socket/entry bytes straight to a
+sink; GOOD lines launder through Reader/maybe_wire_reader first."""
+
+from hashlib import sha256
+
+from hyperdrive_tpu.analysis.annotations import wire_entry
+from hyperdrive_tpu.analysis.sanitizer import maybe_wire_reader
+from hyperdrive_tpu.codec import Reader
+
+
+def ingest_frame(sock, h):
+    payload = sock.recv(4096)
+    h.update(payload)  # BAD: raw peer bytes into a running digest
+
+
+@wire_entry
+def handle_frame(frame):
+    return sha256(frame)  # BAD: entry bytes hashed with no decode
+
+
+@wire_entry
+def commit_frame(ledger, frame):
+    ledger.commit(frame)  # BAD: entry bytes committed with no decode
+
+
+class Journal:
+    def absorb(self, sock):
+        body = sock.recv(1024)
+        self.pending = body  # BAD: wire bytes stored in digest scope
+
+
+@wire_entry
+def laundered(frame):
+    r = Reader(frame)  # GOOD: the laundering boundary
+    return sha256(r.raw())
+
+
+def budgeted(sock):
+    body = sock.recv(1024)
+    r = maybe_wire_reader("msg.envelope", body)  # GOOD: budget seam
+    return r.raw()
+
+
+@wire_entry
+def waived(frame, h):
+    # hdlint: disable=HD007 loopback self-frame, hashed for dedup only
+    h.update(frame)
+    return h

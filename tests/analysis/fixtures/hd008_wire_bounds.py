@@ -1,0 +1,53 @@
+"""HD008 fixture: wire-derived lengths must be bounds-checked before
+they size an allocation. BAD lines allocate straight off a peer int;
+GOOD lines guard first, consume the reader inside the loop, or slice a
+constant width."""
+
+from hyperdrive_tpu.analysis.annotations import wire_entry
+from hyperdrive_tpu.codec import Reader
+
+_CAP = 4096
+
+
+@wire_entry
+def parse_header(frame):
+    r = Reader(frame)
+    n = r.u32()
+    buf = bytearray(n)  # BAD: peer-sized allocation, no check
+    pad = b"\x00" * n  # BAD: peer-sized sequence repeat
+    for _ in range(n):  # BAD: loop never consumes the reader
+        buf.append(0)
+    return buf, pad
+
+
+@wire_entry
+def parse_bigint(frame):
+    big = int.from_bytes(frame, "little")  # BAD: whole-buffer bigint
+    lo = int.from_bytes(frame[0:8], "little")  # GOOD: constant width
+    return big, lo
+
+
+@wire_entry
+def parse_guarded(frame):
+    r = Reader(frame)
+    m = r.u32()
+    if m > _CAP:
+        raise ValueError("row count over cap")
+    rows = bytearray(m)  # GOOD: m was compared against the cap
+    k = min(r.u32(), _CAP)  # GOOD: min() clamps the width
+    return rows, bytes(k)
+
+
+@wire_entry
+def parse_budgeted(frame):
+    r = Reader(frame)
+    count = r.u32()
+    return [r.u64() for _ in range(count)]  # GOOD: loop drains r
+
+
+@wire_entry
+def parse_waived(frame):
+    r = Reader(frame)
+    n = r.u32()
+    # hdlint: disable=HD008 trusted intra-host pipe, capped by sender
+    return bytearray(n)

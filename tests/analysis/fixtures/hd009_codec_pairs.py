@@ -1,0 +1,60 @@
+"""HD009 fixture: every syntactic codec is registered, registrations
+resolve literal tag + constant max_bytes, and every tag has both
+directions. BAD: an unregistered encode_ function, an unregistered
+marshal/unmarshal class, a decoder tag with no encoder, and a
+registration whose max_bytes the linter cannot resolve."""
+
+from hyperdrive_tpu.analysis.annotations import wire_codec
+from hyperdrive_tpu.codec import Reader, Writer
+
+import config  # noqa: F401 (stand-in for an unresolvable import)
+
+
+def encode_widget(obj) -> bytes:  # BAD: codec with no registration
+    w = Writer()
+    w.u32(obj)
+    return w.data()
+
+
+@wire_codec(tag="fixture.orphan", max_bytes=64)
+def decode_orphan(payload):  # BAD tag: decoder with no encoder pair
+    return Reader(payload).u32()
+
+
+@wire_codec(tag="fixture.opaque", max_bytes=config.LIMIT)
+def encode_opaque(obj) -> bytes:  # BAD: max_bytes is not resolvable
+    return bytes([obj])
+
+
+class Blob:  # BAD: marshal/unmarshal pair with no registration
+    def marshal(self, w) -> None:
+        w.u32(0)
+
+    def unmarshal(self, r) -> None:
+        r.u32()
+
+
+@wire_codec(tag="fixture.gadget", max_bytes=128)
+def encode_gadget(obj) -> bytes:  # GOOD: registered, paired
+    w = Writer()
+    w.u64(obj)
+    return w.data()
+
+
+@wire_codec(tag="fixture.gadget", max_bytes=128)
+def decode_gadget(payload):  # GOOD: registered, paired
+    return Reader(payload).u64()
+
+
+@wire_codec(tag="fixture.record", max_bytes=256)
+class Record:  # GOOD: class registration covers both directions
+    def marshal(self, w) -> None:
+        w.u32(1)
+
+    def unmarshal(self, r) -> None:
+        r.u32()
+
+
+# hdlint: disable=HD009 scratch codec for a doc example, never on a wire
+def encode_scratch(obj) -> bytes:
+    return bytes(obj)

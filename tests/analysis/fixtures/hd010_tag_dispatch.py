@@ -1,0 +1,41 @@
+"""HD010 fixture: in a codec-bearing module, every TAG_*/KIND_* frame
+constant is dispatched somewhere, and some dispatcher rejects unknown
+tags with a raise. BAD: a tag nobody compares, and a class namespace
+whose only dispatcher falls through silently."""
+
+from hyperdrive_tpu.analysis.annotations import wire_codec
+from hyperdrive_tpu.codec import Reader, Writer
+
+TAG_PING = 1
+TAG_PONG = 2
+TAG_GONE = 3  # BAD: never compared in any dispatch
+
+
+class Frames:
+    KIND_DATA = 1  # BAD: namespace dispatched below but never raises
+    KIND_ACK = 2
+
+
+@wire_codec(tag="fixture.pingpong", max_bytes=16)
+def encode_ping(kind) -> bytes:
+    w = Writer()
+    w.u8(kind)
+    return w.data()
+
+
+@wire_codec(tag="fixture.pingpong", max_bytes=16)
+def decode_ping(payload):
+    k = Reader(payload).u8()
+    if k == TAG_PING:
+        return "ping"
+    if k == TAG_PONG:
+        return "pong"
+    raise ValueError(f"unknown tag {k}")  # GOOD: fail-closed dispatch
+
+
+def classify(kind) -> int:
+    if kind == Frames.KIND_DATA:
+        return 0
+    if kind == Frames.KIND_ACK:
+        return 1
+    return -1  # silent fallthrough: the namespace's HD010 violation

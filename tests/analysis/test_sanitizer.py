@@ -246,3 +246,114 @@ def test_replica_installs_sanitizer_by_default(monkeypatch):
     # The sanitizer wraps the replica's tracing committer, which wraps
     # the app's: attribute access falls through the whole chain.
     assert replica.proc.committer.commit is not None
+
+
+# -------------------------------------------------------- HDS005 wire budget
+
+
+def test_unregistered_frame_family_raises(monkeypatch):
+    from hyperdrive_tpu.analysis.sanitizer import (
+        WireBudget,
+        maybe_wire_reader,
+    )
+
+    monkeypatch.setenv("HD_SANITIZE", "1")
+    with pytest.raises(SanitizerError, match="HDS005.*unregistered"):
+        WireBudget("no.such.family")
+    with pytest.raises(SanitizerError, match="HDS005"):
+        maybe_wire_reader("no.such.family", b"\x00")
+
+
+def test_oversized_payload_is_charged_up_front(monkeypatch):
+    from hyperdrive_tpu.analysis.annotations import WIRE_BUDGETS
+    from hyperdrive_tpu.analysis.sanitizer import WireBudget
+
+    monkeypatch.setitem(WIRE_BUDGETS, "test.tiny", 8)
+    budget = WireBudget("test.tiny")
+    with pytest.raises(SanitizerError, match="HDS005.*budget"):
+        budget.reader(b"\x00" * 9)  # wider than the family allows
+    assert budget.charge(8) == 8
+    with pytest.raises(SanitizerError, match="HDS005"):
+        budget.charge(9)
+
+
+def test_budget_violating_decoder_dies_with_rule_name(monkeypatch):
+    # The satellite contract: a decoder that reads PAST its family's
+    # declared budget raises HDS005; a merely-truncated payload keeps
+    # its typed SerdeError (underflow is malformed input, not a
+    # doctrine violation).
+    from hyperdrive_tpu.analysis.annotations import WIRE_BUDGETS
+    from hyperdrive_tpu.analysis.sanitizer import WireBudget
+    from hyperdrive_tpu.codec import SerdeError
+
+    monkeypatch.setitem(WIRE_BUDGETS, "test.tiny", 8)
+
+    def greedy_decode(payload):
+        r = WireBudget("test.tiny").reader(payload)
+        r.u64()
+        return r.u8()  # 9th byte: past the family budget
+
+    with pytest.raises(SanitizerError, match="HDS005"):
+        greedy_decode(b"\x00" * 8)
+
+    def truncated_decode(payload):
+        r = WireBudget("test.tiny").reader(payload)
+        return r.u32(), r.u32()
+
+    with pytest.raises(SerdeError):
+        truncated_decode(b"\x00" * 2)  # underflow, budget untouched
+
+
+def test_budget_breach_emits_wire_budget_event(monkeypatch):
+    from hyperdrive_tpu.analysis.annotations import WIRE_BUDGETS
+    from hyperdrive_tpu.analysis.sanitizer import WireBudget
+
+    monkeypatch.setitem(WIRE_BUDGETS, "test.tiny", 8)
+    events = []
+    obs = SimpleNamespace(
+        emit=lambda kind, node, h, r, detail: events.append((kind, detail))
+    )
+    with pytest.raises(SanitizerError):
+        WireBudget("test.tiny", obs=obs).charge(64)
+    assert events == [("wire.budget.exceeded", "test.tiny:64")]
+
+
+def test_maybe_wire_reader_off_path_is_a_plain_reader(monkeypatch):
+    from hyperdrive_tpu.analysis.sanitizer import maybe_wire_reader
+    from hyperdrive_tpu.codec import MAX_BYTES, Reader
+
+    monkeypatch.setenv("HD_SANITIZE", "0")
+    r = maybe_wire_reader("no.such.family", b"\x01\x02")
+    assert type(r) is Reader  # no budget subclass, no registry check
+    assert r.rem == MAX_BYTES
+    r2 = maybe_wire_reader("no.such.family", b"\x01", rem=7)
+    assert r2.rem == 7  # legacy seam budgets survive sanitizer-off
+
+
+def test_wire_charge_is_a_noop_when_disabled(monkeypatch):
+    from hyperdrive_tpu.analysis.sanitizer import wire_charge
+
+    monkeypatch.setenv("HD_SANITIZE", "0")
+    assert wire_charge("no.such.family", 1 << 40) == 1 << 40
+
+    monkeypatch.setenv("HD_SANITIZE", "1")
+    with pytest.raises(SanitizerError, match="HDS005"):
+        wire_charge("no.such.family", 1)
+
+
+def test_registered_budgets_match_the_annotations(monkeypatch):
+    # The runtime resolves the SAME budget the registration declared:
+    # the min across a tag's specs (a family is as strict as its
+    # tightest registration).
+    from hyperdrive_tpu.analysis.annotations import (
+        WIRE_CODECS,
+        wire_budget_for,
+    )
+    from hyperdrive_tpu.analysis.sanitizer import WireBudget
+
+    monkeypatch.setenv("HD_SANITIZE", "1")
+    import hyperdrive_tpu.messages  # noqa: F401 (registers msg.*)
+
+    for tag, specs in WIRE_CODECS.items():
+        assert WireBudget(tag).max_bytes == wire_budget_for(tag)
+        assert wire_budget_for(tag) == min(s.max_bytes for s in specs)

@@ -401,6 +401,48 @@ def test_proxy_duplicate_delivers_twice():
         node.stop()
 
 
+def test_proxy_fuzz_mutates_on_cadence_and_keeps_framing():
+    # ISSUE 18: fuzz_every=3 mutates exactly every 3rd forwarded frame's
+    # PAYLOAD while keeping the stream parseable — the target's read
+    # loop survives all mutants, delivers every clean frame (FIFO), and
+    # never counts an oversize frame (the corruption is the payload's,
+    # never the length prefix's).
+    from hyperdrive_tpu.transport import encode_frame
+
+    node, received = _sink_node()
+    proxy = ChaosProxy(
+        "127.0.0.1", node.port, seed=7, fuzz_every=3
+    ).start()
+    try:
+        frame = encode_frame(_signed_prevote())
+        with socket.create_connection(("127.0.0.1", proxy.port)) as s:
+            for _ in range(12):
+                s.sendall(frame)
+            assert _await(lambda: proxy.forwarded == 12)
+            assert proxy.fuzzed == 4
+            # 8 clean frames must all deliver; mutants may or may not
+            # decode, and a decoded wire Timeout is silently dropped.
+            assert _await(lambda: len(received) >= 8)
+            assert len(received) <= 12
+            # The read loop is still alive: one more clean frame
+            # (13 % 3 != 0) delivers on the same connection.
+            before = len(received)
+            s.sendall(frame)
+            assert _await(lambda: len(received) > before)
+        assert node.oversize_frames == 0
+        assert node.malformed_frames <= proxy.fuzzed
+    finally:
+        proxy.stop()
+        node.stop()
+
+
+def test_proxy_fuzz_rejects_negative_cadence():
+    import pytest
+
+    with pytest.raises(ValueError, match="fuzz_every"):
+        ChaosProxy("127.0.0.1", 1, fuzz_every=-1)
+
+
 def test_transparent_proxy_consensus_smoke():
     # Four single-replica nodes over real sockets, with every inbound
     # frame to node 3 routed through a faultless ChaosProxy: the proxy

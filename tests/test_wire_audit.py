@@ -1,0 +1,510 @@
+"""Wire-audit corpus: registry closure + seeded structure-aware fuzzing.
+
+Two contracts, both jax-free and fully deterministic:
+
+1. **Closure** — every tag in the ``@wire_codec`` registry has a seed
+   sample here (this file is what ``--wire-report``'s roundtrip-test
+   column points at). A new codec that registers without adding a
+   sample fails ``test_registry_closure``; a codec that never registers
+   fails HD009 in strict lint. Between the two, there is no way to add
+   a frame family without fuzz coverage.
+2. **Decode totality** — for every registered codec, >= 1000 seeded
+   byte-level mutations of its canonical samples (truncate / extend /
+   bitflip / tag-swap) must either raise a TYPED error (SerdeError,
+   ValueError, or the sanitizer's HDS005) or decode to a value whose
+   re-encoding is a fixpoint (encode(decode(x)) re-decodes to the same
+   bytes). Any other exception is a decoder crash — the bug class this
+   corpus exists to keep extinct.
+
+``HD_SANITIZE=1`` (the conftest default) arms the HDS005 budget reader
+under every decode, so the fuzz also proves the per-family budgets
+never misfire on honest frames.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from hyperdrive_tpu.analysis.annotations import (
+    WIRE_BUDGETS,
+    WIRE_CODECS,
+    wire_budget_for,
+)
+from hyperdrive_tpu.analysis.sanitizer import SanitizerError, maybe_wire_reader
+from hyperdrive_tpu.certificates import (
+    QuorumCertificate,
+    marshal_certificate,
+    unmarshal_certificate,
+)
+from hyperdrive_tpu.codec import SerdeError, Writer
+from hyperdrive_tpu.crypto.shamir import (
+    decode_share_bundle,
+    encode_share_bundle,
+)
+from hyperdrive_tpu.epochs import (
+    EpochProof,
+    marshal_epoch_proof,
+    unmarshal_epoch_proof,
+)
+from hyperdrive_tpu.messages import (
+    Precommit,
+    Prevote,
+    Propose,
+    Timeout,
+    marshal_message,
+    unmarshal_message,
+)
+from hyperdrive_tpu.ops.merkle import MerkleProof
+from hyperdrive_tpu.parallel.service import (
+    STATUS_COMMITTED,
+    STATUS_NO_QUORUM,
+    decode_proof,
+    decode_request,
+    decode_result,
+    encode_hello,
+    encode_proof,
+    encode_query,
+    encode_result,
+    encode_submit,
+)
+from hyperdrive_tpu.state import State
+from hyperdrive_tpu.types import MessageType
+
+#: The full set of deliberate decode rejections. SanitizerError covers
+#: HDS005 budget raises under HD_SANITIZE; everything else escaping a
+#: decoder is a crash and fails the corpus.
+TYPED_ERRORS = (SerdeError, ValueError, SanitizerError)
+
+#: Seeded mutations per codec tag (the acceptance floor is 1000).
+N_MUTATIONS = 1000
+
+
+# ------------------------------------------------------------ seed values
+
+
+def _propose() -> Propose:
+    return Propose(height=7, round=2, valid_round=1, value=b"\x11" * 32,
+                   sender=b"\x22" * 32, payload=b"xyz",
+                   signature=b"\x33" * 64)
+
+
+def _prevote() -> Prevote:
+    return Prevote(height=7, round=2, value=b"\x11" * 32,
+                   sender=b"\x22" * 32, signature=b"\x44" * 64)
+
+
+def _precommit() -> Precommit:
+    return Precommit(height=7, round=2, value=b"\x11" * 32,
+                     sender=b"\x22" * 32, signature=b"\x55" * 64)
+
+
+def _timeout() -> Timeout:
+    return Timeout(message_type=MessageType.PREVOTE, height=7, round=2)
+
+
+def _cert() -> QuorumCertificate:
+    return QuorumCertificate(height=7, round=2, value_digest=b"\x66" * 32,
+                             signers=b"\x0b", transcript=b"\x77" * 32,
+                             binding=b"\x88" * 32, agg_sig=b"")
+
+
+def _epoch_proof() -> EpochProof:
+    return EpochProof(epoch=3, prev_set_digest=b"\x99" * 32,
+                      next_set_digest=b"\xaa" * 32,
+                      next_signatories=(b"\x01" * 32, b"\x02" * 32),
+                      cert=_cert())
+
+
+def _merkle_proof() -> MerkleProof:
+    return MerkleProof(height=7, account=5, balance=100, stake=10,
+                       prev_root=b"\xbb" * 32,
+                       digest=tuple(range(8)),
+                       siblings=((0, 1, 2, 3), (4, 5, 6, 7)))
+
+
+def _obj_bytes(obj, rem=None) -> bytes:
+    """marshal-method objects (Propose, State, ScenarioRecord, ...)."""
+    w = Writer() if rem is None else Writer(rem=rem)
+    obj.marshal(w)
+    return w.data()
+
+
+def _fn_bytes(marshal_fn, obj) -> bytes:
+    """marshal-function pairs (certificates, epochs, envelopes)."""
+    w = Writer()
+    marshal_fn(obj, w)
+    return w.data()
+
+
+def _reencode_request(req) -> bytes:
+    kind = req[0]
+    if kind == "hello":  # ("hello", name, f, signatories)
+        return encode_hello(req[1], req[3], req[2])
+    if kind == "submit":  # ("submit", req_id, h, r, value, gen, rows)
+        return encode_submit(req[1], req[2], req[3], req[4], req[6],
+                             generation=req[5])
+    return encode_query(req[1], req[2])  # ("query", req_id, account)
+
+
+def _reencode_result(res) -> bytes:
+    req_id, status, mask, cert, root = res
+    return encode_result(req_id, status, len(mask), mask, cert=cert,
+                         root=root)
+
+
+def _reencode_proof(res) -> bytes:
+    req_id, status, proof = res
+    return encode_proof(req_id, status, proof)
+
+
+# -------------------------------------------------------------- the table
+#
+# tag -> (decode: bytes -> value, reencode: value -> bytes, seed frames).
+# decode takes raw frame bytes (through maybe_wire_reader where the
+# production seam does, so HD_SANITIZE budgets are exercised);
+# reencode(decode(seed)) == seed for every canonical seed, and
+# encode-after-decode is a fixpoint for any mutant that still decodes.
+# Entries of None are built lazily by their own test below (tmp_path /
+# deferred imports).
+
+SAMPLES = {
+    "msg.propose": (
+        lambda b: Propose.unmarshal(maybe_wire_reader("msg.propose", b)),
+        _obj_bytes,
+        [_obj_bytes(_propose())],
+    ),
+    "msg.prevote": (
+        lambda b: Prevote.unmarshal(maybe_wire_reader("msg.prevote", b)),
+        _obj_bytes,
+        [_obj_bytes(_prevote())],
+    ),
+    "msg.precommit": (
+        lambda b: Precommit.unmarshal(
+            maybe_wire_reader("msg.precommit", b)
+        ),
+        _obj_bytes,
+        [_obj_bytes(_precommit())],
+    ),
+    "msg.timeout": (
+        lambda b: Timeout.unmarshal(maybe_wire_reader("msg.timeout", b)),
+        _obj_bytes,
+        [_obj_bytes(_timeout())],
+    ),
+    "msg.envelope": (
+        lambda b: unmarshal_message(maybe_wire_reader("msg.envelope", b)),
+        lambda m: _fn_bytes(marshal_message, m),
+        [_fn_bytes(marshal_message, _propose()),
+         _fn_bytes(marshal_message, _prevote()),
+         _fn_bytes(marshal_message, _precommit()),
+         _fn_bytes(marshal_message, _timeout())],
+    ),
+    "cert.quorum": (
+        lambda b: unmarshal_certificate(
+            maybe_wire_reader("cert.quorum", b)
+        ),
+        lambda c: _fn_bytes(marshal_certificate, c),
+        [_fn_bytes(marshal_certificate, _cert())],
+    ),
+    "epoch.proof": (
+        lambda b: unmarshal_epoch_proof(
+            maybe_wire_reader("epoch.proof", b)
+        ),
+        lambda p: _fn_bytes(marshal_epoch_proof, p),
+        [_fn_bytes(marshal_epoch_proof, _epoch_proof())],
+    ),
+    "shamir.bundle": (
+        decode_share_bundle,
+        encode_share_bundle,
+        [encode_share_bundle([[(1, 5), (2, 9)], [(1, 3), (2, 4)]])],
+    ),
+    "service.hello": (
+        decode_request,
+        _reencode_request,
+        [encode_hello("tenant-a", [b"\x01" * 32, b"\x02" * 32], 0)],
+    ),
+    "service.submit": (
+        decode_request,
+        _reencode_request,
+        [encode_submit(9, 7, 2, b"\x11" * 32,
+                       [(b"\x22" * 32, b"\x33" * 64)])],
+    ),
+    "service.query": (
+        decode_request,
+        _reencode_request,
+        [encode_query(9, 5)],
+    ),
+    "service.result": (
+        decode_result,
+        _reencode_result,
+        [encode_result(9, STATUS_COMMITTED, 3, [True, False, True],
+                       cert=_cert(), root=b"\xcc" * 32),
+         encode_result(9, STATUS_NO_QUORUM, 0, [])],
+    ),
+    "service.proof": (
+        decode_proof,
+        _reencode_proof,
+        [encode_proof(9, STATUS_COMMITTED, _merkle_proof()),
+         encode_proof(9, STATUS_NO_QUORUM)],
+    ),
+    "state.checkpoint": (
+        lambda b: State.unmarshal(
+            maybe_wire_reader("state.checkpoint", b, rem=1 << 28)
+        ),
+        _obj_bytes,
+        [_obj_bytes(State())],
+    ),
+    "process.checkpoint": (None, None, None),  # fresh-Process fixture
+    "scenario.record": (None, None, None),     # deferred harness import
+    "flight.record": (None, None, None),       # tmp_path file framing
+}
+
+
+def _process_sample():
+    from hyperdrive_tpu.process import Process
+    from hyperdrive_tpu.utils.checkpoint import (
+        checkpoint_bytes,
+        restore_bytes,
+    )
+
+    def decode(data):
+        # Restoring IS the decode; re-checkpointing the restored process
+        # is the canonical re-encode, so decode returns bytes and
+        # reencode is the identity.
+        proc = Process(whoami=b"\x01" * 32, f=1)
+        restore_bytes(proc, data)
+        return checkpoint_bytes(proc)
+
+    return decode, lambda data: data, [
+        checkpoint_bytes(Process(whoami=b"\x01" * 32, f=1))
+    ]
+
+
+def _scenario_sample():
+    from hyperdrive_tpu.harness.sim import ScenarioRecord
+
+    rec = ScenarioRecord(seed=1, n=4, f=1, target_height=2)
+    rec.signatories = [bytes([i + 1]) * 32 for i in range(4)]
+    rec.messages = [(0, _prevote()), (1, _timeout())]
+    rec.bursts = [2]
+    rec.batch_ingest = False
+
+    def decode(b):
+        return ScenarioRecord.unmarshal(
+            maybe_wire_reader("scenario.record", b, rem=1 << 30)
+        )
+
+    return decode, lambda r: _obj_bytes(r, rem=1 << 30), [
+        _obj_bytes(rec, rem=1 << 30)
+    ]
+
+
+def _flight_sample(tmp_path):
+    from hyperdrive_tpu.transport import FlightRecorder
+
+    rec = FlightRecorder()
+    rec.record(_prevote())
+    rec.record(_precommit())
+
+    def decode(b):
+        p = tmp_path / "flight.bin"
+        p.write_bytes(b)
+        return FlightRecorder.load(str(p))
+
+    def reencode(msgs):
+        out = FlightRecorder()
+        for m in msgs:
+            out.record(m)
+        return b"".join(out.frames)
+
+    return decode, reencode, [b"".join(rec.frames)]
+
+
+# -------------------------------------------------------------- the fuzz
+
+
+def _mutations(tag: str, seeds, n: int):
+    """Deterministic mutation stream: per index, a seeded RNG picks a
+    seed frame and one of truncate / extend / bitflip / tag-swap."""
+    for i in range(n):
+        rng = random.Random(f"wire-fuzz:{tag}:{i}")
+        base = seeds[rng.randrange(len(seeds))]
+        kind = i % 4
+        if kind == 0 and base:  # truncate
+            yield base[: rng.randrange(len(base))]
+        elif kind == 1:  # extend with junk
+            yield base + bytes(
+                rng.randrange(256) for _ in range(1 + rng.randrange(16))
+            )
+        elif kind == 2 and base:  # bitflip
+            pos = rng.randrange(len(base))
+            mutated = bytearray(base)
+            mutated[pos] ^= 1 << rng.randrange(8)
+            yield bytes(mutated)
+        elif base:  # tag-swap: smash the frame's leading byte
+            yield bytes([rng.randrange(256)]) + base[1:]
+        else:
+            yield b""
+
+
+def _fuzz_one(tag, decode, reencode, seeds):
+    # Exactness on every canonical seed first.
+    for seed in seeds:
+        assert reencode(decode(seed)) == seed, f"{tag}: seed not canonical"
+    escapes = []
+    for frame in _mutations(tag, seeds, N_MUTATIONS):
+        try:
+            value = decode(frame)
+        except TYPED_ERRORS:
+            continue
+        except Exception as e:  # noqa: BLE001 - the corpus contract
+            escapes.append((frame[:40].hex(), repr(e)))
+            continue
+        # Survived decoding: must re-encode to a canonical fixpoint.
+        e1 = reencode(value)
+        e2 = reencode(decode(e1))
+        assert e1 == e2, f"{tag}: decoded mutant is not canonical"
+    assert not escapes, f"{tag}: decoder crashes escaped: {escapes[:5]}"
+
+
+# ------------------------------------------------------------------ tests
+
+
+def test_registry_closure():
+    """Every registered codec tag has a fuzz sample; every sample names
+    a registered tag. A tag in neither table is untested attack
+    surface — add the SAMPLES entry with the registration, not later."""
+    # Force the registries that populate on module import.
+    import hyperdrive_tpu.harness.sim  # noqa: F401
+    import hyperdrive_tpu.overlay.runtime  # noqa: F401
+    import hyperdrive_tpu.transport  # noqa: F401
+
+    registered = set(WIRE_CODECS) | set(WIRE_BUDGETS)
+    known = set(SAMPLES) | {"overlay.partial"}  # object seam: no bytes
+    missing = registered - known
+    assert not missing, f"registered codecs without fuzz samples: {missing}"
+    stale = known - registered
+    assert not stale, f"fuzz samples for unregistered tags: {stale}"
+    for tag in registered:
+        assert wire_budget_for(tag) is not None, tag
+
+
+@pytest.mark.parametrize("tag", sorted(
+    t for t, row in SAMPLES.items() if row[0] is not None
+))
+def test_codec_fuzz(tag):
+    decode, reencode, seeds = SAMPLES[tag]
+    _fuzz_one(tag, decode, reencode, seeds)
+
+
+def test_codec_fuzz_process_checkpoint():
+    _fuzz_one("process.checkpoint", *_process_sample())
+
+
+def test_codec_fuzz_scenario():
+    _fuzz_one("scenario.record", *_scenario_sample())
+
+
+def test_codec_fuzz_flight(tmp_path):
+    decode, reencode, seeds = _flight_sample(tmp_path)
+    for seed in seeds:
+        assert reencode(decode(seed)) == seed
+    for frame in _mutations("flight.record", seeds, N_MUTATIONS):
+        try:
+            msgs = decode(frame)
+        except TYPED_ERRORS:
+            continue
+        # Flight logs tolerate truncation by contract (a partial
+        # trailing frame = the recorder was killed mid-write): the
+        # decoded prefix must itself be a canonical log.
+        e1 = reencode(msgs)
+        assert reencode(decode(e1)) == e1
+
+
+def test_unregistered_tag_is_a_sanitizer_error(monkeypatch):
+    monkeypatch.setenv("HD_SANITIZE", "1")
+    with pytest.raises(SanitizerError, match="HDS005"):
+        maybe_wire_reader("no.such.codec", b"\x00")
+
+
+# ------------------------------------------------- pinned decode fixes
+
+
+def test_envelope_rejects_oversized_signature():
+    """unmarshal_message caps the detached signature (HD008 fix): a
+    peer cannot ride megabytes of junk behind a valid vote."""
+    w = Writer()
+    w.i8(int(MessageType.PREVOTE))
+    _prevote().marshal(w)
+    w.raw(b"\x00" * 4096)
+    with pytest.raises(SerdeError, match="signature too wide"):
+        unmarshal_message(maybe_wire_reader("msg.envelope", w.data()))
+
+
+def test_request_rejects_trailing_garbage():
+    """decode_request rejects a frame with bytes after the request body
+    (typed, never silently half-decoded)."""
+    pad = Writer()
+    pad.u32(0)
+    for frame in (encode_query(9, 5),
+                  encode_hello("t", [], 0),
+                  encode_submit(9, 7, 2, b"\x11" * 32, [])):
+        with pytest.raises(SerdeError, match="trailing bytes"):
+            decode_request(frame + pad.data())
+
+
+def test_request_rejects_oversized_name_and_row_sig():
+    with pytest.raises(SerdeError, match="name too long"):
+        decode_request(encode_hello("x" * 300, [], 0))
+    with pytest.raises(SerdeError, match="signature too wide"):
+        decode_request(encode_submit(
+            9, 7, 2, b"\x11" * 32, [(b"\x22" * 32, b"\x00" * 200)]
+        ))
+
+
+def test_result_rejects_noncanonical_bitmap():
+    """The result bitmap must be exactly ceil(n/8) bytes — wider is as
+    malformed as narrower."""
+    w = Writer()
+    w.u8(3)  # TAG_RESULT
+    w.u64(9)
+    w.u8(STATUS_COMMITTED)
+    w.u32(3)
+    w.raw(b"\x05\x00")  # 2 bytes for n=3; canonical is 1
+    w.raw(b"")  # root
+    w.raw(b"")  # cert
+    with pytest.raises(SerdeError, match="bitmap width"):
+        decode_result(w.data())
+    # ... and the canonical frame still decodes.
+    ok = encode_result(9, STATUS_COMMITTED, 3, [True, False, True])
+    assert decode_result(ok)[2] == [True, False, True]
+
+
+def test_proof_rejects_trailing_garbage():
+    with pytest.raises(SerdeError, match="trailing bytes"):
+        decode_proof(encode_proof(9, STATUS_NO_QUORUM) + b"\x00")
+
+
+def test_overlay_rejects_wide_mask_and_extras_flood():
+    """on_frame's Byzantine shape caps: a mask wider than the committee
+    or an extras flood is counted, scored, and dropped before any state
+    grows — never merged, never a crash."""
+    from hyperdrive_tpu.harness.sim import Simulation
+    from hyperdrive_tpu.overlay import OverlayConfig, OverlayFrame
+
+    sim = Simulation(n=8, seed=5, target_height=1, delivery_cost=1e-3,
+                     overlay=OverlayConfig())
+    sim.run(max_steps=50_000)
+    rt = sim._overlay
+    assert rt.frame_rejects == 0  # honest runs never trip the caps
+    slot = next(iter(rt._slots))
+    invalid = rt.scores.charges["invalid"]
+    rt.on_frame(1, OverlayFrame(2, slot, 0, mask=1 << (rt.n + 40)))
+    assert rt.frame_rejects == 1
+    rt.on_frame(1, OverlayFrame(
+        2, slot, 0, mask=0,
+        extras=tuple(_prevote() for _ in range(rt.n + 1)),
+    ))
+    assert rt.frame_rejects == 2
+    assert rt.scores.charges["invalid"] == invalid + 2
