@@ -688,7 +688,8 @@ class MetricNameRule:
     #: literal under one of these must appear in EVENT_KINDS verbatim.
     _CLOSED_PREFIXES = ("sched.launch.", "verify.occupancy.", "metrics.",
                         "load.", "admission.", "bls.", "tenant.drain.",
-                        "service.", "exec.", "merkle.", "proof.")
+                        "service.", "exec.", "merkle.", "proof.",
+                        "trace.", "slo.")
 
     def check(self, ctx):
         findings: list = []

@@ -7,7 +7,8 @@ Usage::
         [--pipelined-every K] [--certs-every K] [--bls-certs-every K]
         [--churn-every K] [--overload-every K] [--overlay-every K]
         [--tenants-every K] [--exec-every K] [--exec-pipeline-every K]
-        [--proofs-every K] [--fuzz-frames-every K] [--dump-ok DIR]
+        [--proofs-every K] [--fuzz-frames-every K] [--metrics-every K]
+        [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -621,6 +622,176 @@ def _wire_fuzz_probe(scen_seed: int) -> dict:
         node.stop()
 
 
+def _metrics_probe(scen_seed: int) -> dict:
+    """The live-metrics fault family (ISSUE 19, jax-free): a real
+    :class:`~hyperdrive_tpu.parallel.service.ServicePort` serving
+    remote tenants over real sockets, scraped over TAG_METRICS.
+    Invariants:
+
+    - a scrape after real traffic answers STATUS_COMMITTED with valid
+      Prometheus exposition text (every non-comment line parses as
+      ``name{labels} value``) that already carries the commit-latency
+      histogram the tenant's own submits fed;
+    - shed ORDERING (the metrics-plane doctrine): with the admission
+      floor forced to SHED_LOW_PRIORITY, the scrape answers
+      STATUS_SHED while a second tenant's consensus submits — run
+      under the SAME floor — all still commit. The observability
+      plane sheds strictly before any consensus class, and no submit
+      row is shed while the scrape is;
+    - pressure released, the retried scrape serves again (scrapes are
+      flow-controlled reads, never lost), and the SLO burn-rate
+      checks (obs/slo.py) evaluate over the run's registry snapshot
+      and journal: finality_p99 and shed_rate must both be MEASURED
+      (a missing signal is not evidence of health) and finality must
+      hold its ceiling on an unloaded local run.
+    """
+    import re
+    import threading
+    import time
+
+    from hyperdrive_tpu.load.backpressure import SHED_LOW_PRIORITY
+    from hyperdrive_tpu.obs.metrics import Registry
+    from hyperdrive_tpu.obs.recorder import Recorder
+    from hyperdrive_tpu.obs.slo import evaluate_slos
+    from hyperdrive_tpu.parallel.service import (
+        RemoteServiceClient,
+        STATUS_COMMITTED,
+        STATUS_SHED,
+        ShardVerifyService,
+        TenantShard,
+    )
+    from hyperdrive_tpu.verifier import NullVerifier
+
+    rng = random.Random(scen_seed * _SEED_STRIDE + 29)
+    target = rng.randrange(3, 6)
+    rec = Recorder(threadsafe=True)
+    obs = rec.scoped(-1)
+    svc = ShardVerifyService(
+        NullVerifier(), max_depth=0, registry=Registry(), obs=obs
+    )
+    port = svc.remote_port(obs=obs)
+
+    def _run_tenant(name: str, heights: int):
+        client = RemoteServiceClient(*port.address)
+        shard = TenantShard(name, target_height=heights, sign=False)
+        shard.attach_remote(client)
+        t = threading.Thread(target=shard.run_remote, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10.0
+        while not shard.done and time.monotonic() < deadline:
+            port.pump()
+            svc.drain()
+            time.sleep(0.001)
+        t.join(timeout=5.0)
+        if not shard.done or shard.rejected:
+            raise InvariantViolation(
+                "metrics-liveness",
+                f"tenant {name} stalled (done={shard.done} "
+                f"rejected={shard.rejected}) — consensus traffic did "
+                f"not survive the probe's load profile",
+            )
+        return client
+
+    def _scrape(client):
+        fut = client.metrics()
+        deadline = time.monotonic() + 5.0
+        while not fut.done() and time.monotonic() < deadline:
+            port.pump()
+            svc.drain()
+            time.sleep(0.001)
+        return fut.metrics_result(timeout=1.0)
+
+    prom_line = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? \S+$"
+    )
+    clients = []
+    try:
+        clients.append(_run_tenant(f"mx-{scen_seed % 977}", target))
+        status, text = _scrape(clients[0])
+        if status != STATUS_COMMITTED or not text:
+            raise InvariantViolation(
+                "metrics-serve",
+                f"unloaded scrape refused (status={status}) — the "
+                f"metrics plane failed with zero pressure on the gate",
+            )
+        for line in text.splitlines():
+            if line and not line.startswith("#") and \
+                    not prom_line.match(line):
+                raise InvariantViolation(
+                    "metrics-format",
+                    f"scrape line is not Prometheus exposition "
+                    f"text: {line!r}",
+                )
+        if "hd_tenant_commit_latency" not in text:
+            raise InvariantViolation(
+                "metrics-serve",
+                "scrape is missing the commit-latency histogram the "
+                "tenant's own traffic fed",
+            )
+        port.controller.floor = SHED_LOW_PRIORITY
+        port.controller.poll()
+        status2, text2 = _scrape(clients[0])
+        if status2 != STATUS_SHED or text2 is not None:
+            raise InvariantViolation(
+                "metrics-shed-order",
+                f"scrape under SHED_LOW_PRIORITY answered "
+                f"status={status2} — metrics must be the FIRST class "
+                f"shed, before any consensus frame queues behind them",
+            )
+        # The ordering half: under the SAME floor that just shed the
+        # scrape, a fresh tenant's consensus submits must all commit.
+        clients.append(_run_tenant(f"my-{scen_seed % 977}", 2))
+        if port.remote_sheds:
+            raise InvariantViolation(
+                "metrics-shed-order",
+                f"{port.remote_sheds} consensus submits shed at the "
+                f"floor that sheds metrics — the shed order inverted",
+            )
+        port.controller.floor = 0
+        for _ in range(port.controller.hysteresis):
+            port.controller.poll()
+        status3, text3 = _scrape(clients[0])
+        if status3 != STATUS_COMMITTED or not text3:
+            raise InvariantViolation(
+                "metrics-serve",
+                f"scrape after pressure release refused "
+                f"(status={status3}) — sheds must be retryable, "
+                f"never a lost read",
+            )
+        slos = evaluate_slos(
+            snapshot=svc.registry.snapshot(),
+            events=rec.snapshot(), obs=obs,
+        )
+        by_name = {r.name: r for r in slos}
+        for needed in ("finality_p99", "shed_rate"):
+            if needed not in by_name:
+                raise InvariantViolation(
+                    "metrics-slo",
+                    f"{needed} was not measured — its input signal "
+                    f"went missing from a run that produced it",
+                )
+        if not by_name["finality_p99"].ok:
+            raise InvariantViolation(
+                "metrics-slo",
+                f"finality_p99 burned "
+                f"{by_name['finality_p99'].burn:.2f}x its budget on "
+                f"an unloaded local run",
+            )
+        return {
+            "target": target,
+            "serves": port.metrics_serves,
+            "sheds": port.metrics_sheds,
+            "bytes": len(text3),
+            "slos": len(slos),
+            "breaches": sum(1 for r in slos if not r.ok),
+        }
+    finally:
+        for client in clients:
+            client.close()
+        port.close()
+        svc.close()
+
+
 def _dump_failure(out: str, scen_seed: int, sim, err) -> str:
     os.makedirs(out, exist_ok=True)
     base = os.path.join(out, f"chaos_seed_{scen_seed}")
@@ -1067,6 +1238,33 @@ def soak(args) -> int:
                 f"malformed={wstats['malformed']} "
                 f"delivered={wstats['delivered']}"
             )
+        if args.metrics_every and k % args.metrics_every == 0:
+            # Every Kth scenario additionally runs the live-metrics
+            # probe (ISSUE 19): a real ServicePort scraped over
+            # TAG_METRICS mid-soak — the scrape must serve valid
+            # Prometheus text carrying the tenant-fed commit-latency
+            # histogram, shed FIRST under a forced admission floor
+            # while consensus submits run under the same floor all
+            # still commit, serve again once pressure releases, and
+            # the SLO burn-rate checks must both measure and hold.
+            try:
+                mstats = _metrics_probe(scen_seed)
+            except (InvariantViolation, AssertionError) as err:
+                failures += 1
+                print(
+                    f"FAIL metrics seed={scen_seed} {err}",
+                    file=sys.stderr,
+                )
+                if not args.keep_going:
+                    return 1
+                continue
+            print(
+                f"ok metrics seed={scen_seed} "
+                f"heights={mstats['target']} "
+                f"serves={mstats['serves']} sheds={mstats['sheds']} "
+                f"bytes={mstats['bytes']} slos={mstats['slos']} "
+                f"breaches={mstats['breaches']}"
+            )
         if args.exec_pipeline_every and k % args.exec_pipeline_every == 0:
             # Every Kth scenario additionally runs the speculative-
             # pipeline family (PR 16): forged-but-well-formed tx
@@ -1295,6 +1493,17 @@ def main(argv=None) -> int:
         "every 3rd payload; clean traffic must all deliver, the read "
         "loop must survive every mutant, and honest frames must never "
         "misparse; 0 = off)",
+    )
+    p.add_argument(
+        "--metrics-every",
+        type=int,
+        default=0,
+        help="additionally run every Kth seed as a live-metrics probe "
+        "(jax-free ServicePort scraped over TAG_METRICS: valid "
+        "Prometheus exposition text, metrics shed FIRST under a "
+        "forced admission floor while consensus submits under the "
+        "same floor all commit, and the SLO burn-rate checks measure "
+        "and hold; 0 = off)",
     )
     p.add_argument(
         "--dump-ok",

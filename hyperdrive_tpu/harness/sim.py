@@ -2046,6 +2046,16 @@ class Simulation:
         fixed-seed runs snapshot to identical bytes
         (``self.registry.digest()``)."""
         self.registry.absorb_tracer(self.tracer)
+        if self._obs_sim is not _OBS_NULL:
+            # Flight-recorder health rides the same snapshot: a
+            # journal that silently overwrote its oldest events would
+            # otherwise present a truncated anatomy as a complete one.
+            self.registry.set_gauge("obs.recorder.dropped",
+                                    self.obs.dropped)
+            self.registry.set_gauge("obs.recorder.capacity",
+                                    self.obs.capacity)
+            self.registry.set_gauge("obs.recorder.total",
+                                    self.obs.total)
         snap = self.registry.snapshot()
         if self._obs_sim is not _OBS_NULL:
             self._obs_sim.emit(

@@ -49,6 +49,7 @@ __all__ = [
     "QUERY",
     "FRAME_CLASSES",
     "QueryFrame",
+    "MetricsFrame",
     "classify_frame",
 ]
 
@@ -70,6 +71,19 @@ class QueryFrame:
     fairness attribution uses the connection's tenant as ``peer``)."""
 
     account: int
+    height: int = -1
+    round: int = -1
+    sender: bytes | None = None
+
+
+@dataclass(frozen=True)
+class MetricsFrame:
+    """One live-metrics scrape at an admission gate: the service
+    port's TAG_METRICS ingress. Classified WITH proof queries (QUERY)
+    — a scrape is an idempotent, retryable read, and the
+    observability plane must be the first thing shed under load,
+    never a reason consensus traffic queues."""
+
     height: int = -1
     round: int = -1
     sender: bytes | None = None
@@ -106,6 +120,11 @@ def classify_frame(msg, *, seen=None, height_fn=None, retired=None):
         # are never deduplicated: an identical re-query after a shed is
         # the client doing exactly what the retry doctrine tells it to.
         return QUERY, ("query", msg.account)
+    if t is MetricsFrame:
+        # Metrics scrapes are the same read-path class: sheddable
+        # first, never deduplicated (a re-scrape after a shed is the
+        # scraper's retry loop working as designed).
+        return QUERY, ("metrics",)
     tag = _TAG.get(t)
     if tag is None or t is Propose:
         return FRESH, None

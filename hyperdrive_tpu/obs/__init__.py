@@ -21,12 +21,35 @@ from hyperdrive_tpu.obs.recorder import (
 )
 from hyperdrive_tpu.obs.report import (
     anatomy,
+    critical_path_summary,
     phase_summary,
+    render_critical_path_table,
     render_table,
     render_tenant_table,
     tenant_summary,
 )
 from hyperdrive_tpu.obs.perfetto import DEVICE_TID, export, to_trace_events
+from hyperdrive_tpu.obs.tracectx import (
+    STAMP_LEN,
+    TRACE_MAGIC,
+    TraceSource,
+    decode_stamp,
+    encode_stamp,
+    note_recv,
+    span_id,
+    split_frame,
+)
+from hyperdrive_tpu.obs.merge import (
+    estimate_offsets,
+    merge_journals,
+    merged_digest,
+    save_merged,
+)
+from hyperdrive_tpu.obs.slo import (
+    DEFAULT_OBJECTIVES,
+    SloResult,
+    evaluate_slos,
+)
 from hyperdrive_tpu.obs.devtel import (
     NULL_DEVTEL,
     DeviceTelemetry,
@@ -53,13 +76,30 @@ __all__ = [
     "Recorder",
     "load_journal",
     "anatomy",
+    "critical_path_summary",
     "phase_summary",
+    "render_critical_path_table",
     "render_table",
     "render_tenant_table",
     "tenant_summary",
     "DEVICE_TID",
     "export",
     "to_trace_events",
+    "STAMP_LEN",
+    "TRACE_MAGIC",
+    "TraceSource",
+    "decode_stamp",
+    "encode_stamp",
+    "note_recv",
+    "span_id",
+    "split_frame",
+    "estimate_offsets",
+    "merge_journals",
+    "merged_digest",
+    "save_merged",
+    "DEFAULT_OBJECTIVES",
+    "SloResult",
+    "evaluate_slos",
     "NULL_DEVTEL",
     "DeviceTelemetry",
     "LaunchRecord",

@@ -7,8 +7,14 @@ benchdiff.
                ``--overload`` for admission/shed posture,
                ``--overlay`` for aggregation-overlay posture,
                ``--exec`` for execution-layer/state-root posture,
-               ``--proofs`` for trustless-read/Merkle posture)
+               ``--proofs`` for trustless-read/Merkle posture,
+               ``--critical-path`` for per-commit finality hop
+               attribution — most useful on a merged journal)
+    merge      fold N per-process journals into one causally-
+               consistent stream (clock-aligned, pid-stamped)
     export     convert a saved journal to Perfetto/Chrome trace JSON
+               (merged journals render per-process tracks + cross-
+               process flow arrows)
     metrics    run a short observed sim, print its metrics-registry
                snapshot (JSON; ``--prometheus FILE`` for exposition text)
     benchdiff  diff two bench artifacts, exit nonzero on a gated
@@ -30,11 +36,13 @@ import sys
 from hyperdrive_tpu.obs.recorder import load_journal
 from hyperdrive_tpu.obs.report import (
     anatomy,
+    critical_path_summary,
     exec_summary,
     overlay_summary,
     overload_summary,
     phase_summary,
     proofs_summary,
+    render_critical_path_table,
     render_exec_table,
     render_proofs_table,
     render_overlay_table,
@@ -76,6 +84,18 @@ def _cmd_record(ns):
 
 def _cmd_report(ns):
     journal = load_journal(ns.journal)
+    if ns.critical_path:
+        summary = critical_path_summary(journal["events"])
+        if ns.json:
+            print(json.dumps({"critical_path": summary}, indent=1))
+            return 0
+        if not summary["rows"]:
+            print("no committed heights with >=2 finality milestones "
+                  "in journal window (merge per-process journals first: "
+                  "python -m hyperdrive_tpu.obs merge ...)")
+            return 1
+        print(render_critical_path_table(summary))
+        return 0
     if ns.exec:
         summary = exec_summary(journal["events"])
         if ns.json:
@@ -172,6 +192,31 @@ def _cmd_report(ns):
             f"(ring dropped {journal['dropped']} oldest events; "
             "raise obs_capacity for full anatomy)"
         )
+    return 0
+
+
+def _cmd_merge(ns):
+    from hyperdrive_tpu.obs.merge import (
+        merge_journals,
+        merged_digest,
+        save_merged,
+    )
+
+    journals = [load_journal(path) for path in ns.journals]
+    merged = merge_journals(journals)
+    save_merged(merged, ns.output)
+    print(
+        json.dumps(
+            {
+                "merged": ns.output,
+                "journals": len(journals),
+                "origins": merged["meta"]["origins"],
+                "events": len(merged["events"]),
+                "orphans": len(merged["meta"]["orphans"]),
+                "digest": merged_digest(merged),
+            }
+        )
+    )
     return 0
 
 
@@ -294,7 +339,25 @@ def main(argv=None):
              "shed, frame sizes, incremental-update posture, per-height "
              "Merkle-root agreement)",
     )
+    rep.add_argument(
+        "--critical-path",
+        dest="critical_path",
+        action="store_true",
+        help="per-commit finality critical path instead: walk each "
+             "committed height's event chain (frame send -> peer recv "
+             "-> verify launch -> cert mint -> gated commit -> apply "
+             "drain) and name the dominating hop",
+    )
     rep.set_defaults(fn=_cmd_report)
+
+    mrg = sub.add_parser(
+        "merge",
+        help="fold N per-process journals into one aligned stream",
+    )
+    mrg.add_argument("journals", nargs="+",
+                     help="per-process journal files (>=1)")
+    mrg.add_argument("-o", "--output", default="merged.json")
+    mrg.set_defaults(fn=_cmd_merge)
 
     exp = sub.add_parser("export", help="journal -> Perfetto trace JSON")
     exp.add_argument("journal")

@@ -188,6 +188,32 @@ EVENT_KINDS = frozenset(
         # family — same three consumers as merkle.*.
         "proof.serve",
         "proof.shed",
+        # Live metrics plane (parallel/service.py TAG_METRICS path):
+        # one mark per Prometheus snapshot served on remote_port()
+        # (detail: rendered bytes) and one per scrape the admission
+        # gate shed ahead of consensus traffic (detail: tenant).
+        # Closed family (the `metrics.` prefix already is) — the lint
+        # (HD005) and OBSERVABILITY.md enumerate exactly these.
+        "metrics.serve",
+        "metrics.shed",
+        # Cross-process causal tracing (obs/tracectx.py): one mark per
+        # stamped frame sent (detail: "origin:seq"), one per stamped
+        # frame received (detail: "origin:seq" of the SENDER's stamp —
+        # the merge CLI pairs send/recv on it), and one per estimated
+        # wall-clock offset from a HELLO echo exchange (detail:
+        # "peer_origin:offset_seconds"). Closed family — the lint
+        # (HD005), obs merge, and OBSERVABILITY.md enumerate exactly
+        # these.
+        "trace.send",
+        "trace.recv",
+        "trace.offset",
+        # SLO burn-rate checks (obs/slo.py): one verdict mark per
+        # objective evaluated against a registry snapshot or merged
+        # journal (detail: "<slo name>:<measured value>"). Closed
+        # family — the lint (HD005) and OBSERVABILITY.md enumerate
+        # exactly these.
+        "slo.ok",
+        "slo.breach",
     }
 )
 
@@ -200,6 +226,10 @@ class Event(tuple):
     A bare tuple subclass (not a dataclass) so ring inserts stay a
     single allocation; the named properties are for report/export code,
     which is off the hot path.
+
+    Merged journals (obs/merge.py) append a seventh slot — the origin
+    process id — so one stream can hold events from N processes; a
+    plain per-process event reads as pid 0.
     """
 
     __slots__ = ()
@@ -210,6 +240,7 @@ class Event(tuple):
     round = property(lambda self: self[3])
     kind = property(lambda self: self[4])
     detail = property(lambda self: self[5])
+    pid = property(lambda self: self[6] if len(self) > 6 else 0)
 
 
 class Recorder:
@@ -311,15 +342,24 @@ class Recorder:
         head = self.total % self.capacity
         return ring[head:] + ring[:head]
 
-    def journal(self):
-        """A JSON-ready dict of the whole journal."""
-        return {
+    def journal(self, meta=None):
+        """A JSON-ready dict of the whole journal.
+
+        ``meta`` (optional dict) rides along verbatim — the serve CLI
+        stamps each per-process journal with its trace origin id so
+        ``obs merge`` can key offset estimates without guessing from
+        filenames. :func:`load_journal` returns it untouched.
+        """
+        data = {
             "version": JOURNAL_VERSION,
             "capacity": self.capacity,
             "total": self.total,
             "dropped": self.dropped,
             "events": [list(ev) for ev in self.snapshot()],
         }
+        if meta:
+            data["meta"] = dict(meta)
+        return data
 
     def digest(self):
         """sha256 over the canonical JSON encoding of the events.
@@ -335,9 +375,9 @@ class Recorder:
         ).encode()
         return hashlib.sha256(blob).hexdigest()
 
-    def save(self, path):
+    def save(self, path, meta=None):
         with open(path, "w") as fh:
-            json.dump(self.journal(), fh, separators=(",", ":"))
+            json.dump(self.journal(meta=meta), fh, separators=(",", ":"))
             fh.write("\n")
 
 

@@ -30,6 +30,8 @@ __all__ = [
     "render_exec_table",
     "proofs_summary",
     "render_proofs_table",
+    "critical_path_summary",
+    "render_critical_path_table",
 ]
 
 _TIMEOUT_FIRES = (
@@ -804,6 +806,134 @@ def render_proofs_table(summary):
                 "MERKLE ROOT FORKS at heights: "
                 + ", ".join(str(h) for h in summary["merkle_forks"])
             )
+    return "\n".join(lines)
+
+
+#: Finality milestones in doctrine order: the merged event kinds that
+#: mark one committed height's journey across the mesh. ``send`` has no
+#: kind of its own — it is the ``trace.send`` paired (by "origin:seq")
+#: to the height's first ``trace.recv``, usually in ANOTHER process's
+#: journal, which is exactly why this report wants merged input.
+_CP_MILESTONES = (
+    ("send", ()),
+    ("recv", ("trace.recv",)),
+    ("submit", ("service.remote.submit",)),
+    ("verify", ("verify.launch", "sched.launch.begin", "tally.launch")),
+    ("cert", ("cert.emit",)),
+    ("resolve", ("service.remote.resolve",)),
+    ("commit", ("commit",)),
+    ("apply", ("exec.apply",)),
+)
+
+
+def critical_path_summary(events):
+    """Finality critical-path attribution over a (merged) journal.
+
+    Walks each committed height's event chain — frame send → peer
+    receive → coalesced verify launch → cert mint → gated commit →
+    apply drain — and names the hop that dominated its wall time.
+    Milestones are the FIRST event of each kind at that height; hops
+    are the gaps between consecutive milestones in time order, so they
+    telescope to exactly the height's first-to-last span (100% of the
+    wall time is attributed to named hops by construction).
+    """
+    kind_to_ms = {}
+    for name, kinds in _CP_MILESTONES:
+        for kind in kinds:
+            kind_to_ms[kind] = name
+    order = {name: i for i, (name, _) in enumerate(_CP_MILESTONES)}
+    send_ts = {}  # trace span key -> earliest (aligned) send ts
+    recv_key = {}  # height -> span key of its first trace.recv
+    marks = {}  # height -> {milestone -> ts}
+    for ev in events:
+        ts, height, kind, detail = ev[0], ev[2], ev[4], ev[5]
+        if kind == "trace.send" and detail:
+            key = str(detail)
+            if key not in send_ts or ts < send_ts[key]:
+                send_ts[key] = ts
+            continue
+        if height < 0:
+            continue
+        name = kind_to_ms.get(kind)
+        if name is None:
+            continue
+        ms = marks.setdefault(height, {})
+        if name not in ms:
+            ms[name] = ts
+            if kind == "trace.recv" and detail:
+                recv_key[height] = str(detail)
+    rows = []
+    aggregate = {}
+    for height in sorted(marks):
+        ms = marks[height]
+        key = recv_key.get(height)
+        if key is not None and key in send_ts:
+            ms["send"] = send_ts[key]
+        if len(ms) < 2:
+            continue
+        # Time order (milestone order as tiebreak) keeps the hops
+        # telescoping even if clock alignment slightly reordered two
+        # milestones — attribution stays exact, never negative.
+        chain = sorted(ms.items(), key=lambda kv: (kv[1], order[kv[0]]))
+        hops = []
+        for (a, ta), (b, tb) in zip(chain, chain[1:]):
+            label = f"{a}→{b}"
+            hops.append((label, tb - ta))
+            aggregate[label] = aggregate.get(label, 0.0) + (tb - ta)
+        total = chain[-1][1] - chain[0][1]
+        dominant, dominant_s = max(hops, key=lambda h: h[1])
+        rows.append({
+            "height": height,
+            "milestones": dict(chain),
+            "hops": hops,
+            "total_s": total,
+            "dominant": dominant,
+            "dominant_s": dominant_s,
+            "attributed": 1.0 if total > 0 else 0.0,
+        })
+    out = {"rows": rows, "aggregate": aggregate}
+    if aggregate:
+        dom = max(aggregate.items(), key=lambda kv: kv[1])
+        out["dominant"] = dom[0]
+        out["dominant_s"] = dom[1]
+    return out
+
+
+def render_critical_path_table(summary):
+    """The critical-path rows as aligned text (the CLI's
+    ``--critical-path``)."""
+    rows = summary["rows"]
+    if not rows:
+        return "no committed heights with ≥2 finality milestones"
+    table = [["ht", "total", "dominant hop", "share", "hops"]]
+    for r in rows:
+        share = r["dominant_s"] / r["total_s"] if r["total_s"] > 0 else 0.0
+        table.append([
+            str(r["height"]),
+            _fmt(r["total_s"]),
+            r["dominant"],
+            f"{share:.0%}",
+            " · ".join(f"{name}={dur:.4f}" for name, dur in r["hops"]),
+        ])
+    widths = [max(len(row[i]) for row in table) for i in range(5)]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    total = sum(r["total_s"] for r in rows)
+    agg = summary["aggregate"]
+    if total > 0 and agg:
+        shares = " · ".join(
+            f"{name}={dur / total:.0%}"
+            for name, dur in sorted(
+                agg.items(), key=lambda kv: -kv[1]
+            )
+        )
+        lines.append(
+            f"aggregate over {len(rows)} heights "
+            f"({total:.4f}s attributed 100% to named hops): {shares}"
+        )
     return "\n".join(lines)
 
 

@@ -7,11 +7,21 @@ Usage::
         [--policy drr|fifo] [--capacity-rows N] [--quantum-rows N]
         [--starve-after K] [--weights T=W,...]
         [--verifier host|null|device] [--max-depth D]
-        [--listen] [--remote-tenants K] [--parity] [--json] [-o FILE]
+        [--listen] [--remote-tenants K] [--parity]
+        [--journal FILE] [--origin N] [--json] [-o FILE]
 
     python -m hyperdrive_tpu.parallel tenant
         --connect HOST:PORT --name NAME
         [--validators V] [--heights H] [--unsigned] [--inflight N]
+        [--journal FILE] [--origin N]
+
+``--journal`` turns on the distributed flight recorder: the process
+records a wall-clock journal (``time.time`` timestamps, so journals
+from different processes share a clock domain up to offset), stamps
+every outbound frame with a causal trace context, and saves the
+journal (meta: its trace origin id) on exit. ``serve --journal`` hands
+each spawned remote tenant its own journal path and origin, so one run
+yields N+1 journals ready for ``python -m hyperdrive_tpu.obs merge``.
 
 ``serve`` runs the deployment shape of ROADMAP item 2: M independent
 shard-consensus instances (each its own deterministic committee)
@@ -54,6 +64,27 @@ def _percentile(values, q: float):
     if not vals:
         return None
     return vals[min(len(vals) - 1, int(q * len(vals)))]
+
+
+def _journal_path_for_child(journal: str, i: int) -> str:
+    """remote child i's journal path, derived from the serve journal
+    (``foo.json`` -> ``foo.remote-0.json``)."""
+    base, dot, ext = journal.rpartition(".")
+    if not dot:
+        return f"{journal}.remote-{i}"
+    return f"{base}.remote-{i}.{ext}"
+
+
+def _build_observer(origin: int):
+    """One process's distributed-tracing kit: a threadsafe wall-clock
+    Recorder (IO threads emit), its bound handle on the sim track, and
+    the stamp mint."""
+    from hyperdrive_tpu.obs.recorder import Recorder
+    from hyperdrive_tpu.obs.tracectx import TraceSource
+
+    rec = Recorder(time_fn=time.time, threadsafe=True)
+    obs = rec.scoped(-1)
+    return rec, obs, TraceSource(origin, obs=obs)
 
 
 def _build_verifier(kind: str):
@@ -111,11 +142,19 @@ def serve(args) -> int:
     sign = args.verifier != "null"
     devtel = DeviceTelemetry(keep=4096)
     policy = _build_policy(args)
+    flight_rec = obs = trace = registry = None
+    if args.journal:
+        from hyperdrive_tpu.obs.metrics import Registry
+
+        flight_rec, obs, trace = _build_observer(args.origin)
+        registry = Registry()
     service = ShardVerifyService(
         _build_verifier(args.verifier),
         max_depth=args.max_depth,
         devtel=devtel,
         policy=policy,
+        obs=obs,
+        registry=registry,
     )
     tenants = [
         TenantShard(
@@ -127,8 +166,9 @@ def serve(args) -> int:
 
     port = None
     children = []
+    child_journals = []
     if args.listen or args.remote_tenants:
-        port = service.remote_port()
+        port = service.remote_port(obs=obs, trace=trace)
         host, pnum = port.address
         for i in range(args.remote_tenants):
             cmd = [
@@ -140,6 +180,13 @@ def serve(args) -> int:
             ]
             if not sign:
                 cmd.append("--unsigned")
+            if args.journal:
+                child_path = _journal_path_for_child(args.journal, i)
+                child_journals.append(child_path)
+                cmd += [
+                    "--journal", child_path,
+                    "--origin", str(args.origin + 1 + i),
+                ]
             children.append(
                 subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
             )
@@ -241,6 +288,8 @@ def serve(args) -> int:
             "submits": port.remote_submits,
             "resolves": port.remote_resolves,
             "sheds": port.remote_sheds,
+            "metrics_serves": port.metrics_serves,
+            "metrics_sheds": port.metrics_sheds,
             "children": child_reports,
         },
         "policy_stats": None if policy is None else {
@@ -250,6 +299,14 @@ def serve(args) -> int:
         },
         "parity_ok": parity_ok,
     }
+    if flight_rec is not None:
+        flight_rec.save(args.journal, meta={"origin": args.origin})
+        summary["journal"] = args.journal
+        summary["journals"] = [args.journal] + child_journals
+        summary["trace_origin"] = args.origin
+        summary["trace_events"] = sum(
+            1 for ev in flight_rec.snapshot() if ev[4].startswith("trace.")
+        )
     text = json.dumps(summary, indent=None if args.json else 2)
     if args.out:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
@@ -267,7 +324,12 @@ def serve(args) -> int:
 
 def tenant(args) -> int:
     host, _, pnum = args.connect.rpartition(":")
-    client = RemoteServiceClient(host or "127.0.0.1", int(pnum))
+    rec = obs = trace = None
+    if args.journal:
+        rec, obs, trace = _build_observer(args.origin)
+    client = RemoteServiceClient(
+        host or "127.0.0.1", int(pnum), obs=obs, trace=trace
+    )
     shard = TenantShard(
         args.name, n_validators=args.validators,
         target_height=args.heights, sign=not args.unsigned,
@@ -275,7 +337,7 @@ def tenant(args) -> int:
     t0 = time.perf_counter()
     shard.run_remote(max_inflight=args.inflight, timeout=args.timeout)
     client.close()
-    print(json.dumps({
+    report = {
         "name": shard.name,
         "done": shard.done,
         "commits": len(shard.commits),
@@ -284,7 +346,15 @@ def tenant(args) -> int:
         "rejected": shard.rejected,
         "shed_retries": shard.shed_retries,
         "commit_latency_p95_s": _percentile(shard.commit_latencies, 0.95),
-    }))
+    }
+    if rec is not None:
+        rec.save(args.journal, meta={"origin": args.origin})
+        report["journal"] = args.journal
+        report["trace_origin"] = args.origin
+        report["clock_offsets"] = {
+            str(o): off for o, off in sorted(client.clock_offsets.items())
+        }
+    print(json.dumps(report))
     return 0 if shard.done else 1
 
 
@@ -314,6 +384,13 @@ def main(argv=None) -> int:
                    help="spawn K remote tenant subprocesses over TCP")
     p.add_argument("--parity", action="store_true",
                    help="assert shared-service digests == per-tenant-queue")
+    p.add_argument("--journal", default="",
+                   help="record a causal-trace journal here (children get "
+                        "derived paths); enables frame stamping and the "
+                        "TAG_METRICS plane")
+    p.add_argument("--origin", type=int, default=1,
+                   help="this process's trace origin id (children get "
+                        "origin+1..origin+K)")
     p.add_argument("--timeout", type=float, default=120.0)
     p.add_argument("--json", action="store_true",
                    help="single-line JSON summary")
@@ -331,6 +408,10 @@ def main(argv=None) -> int:
     p.add_argument("--unsigned", action="store_true")
     p.add_argument("--inflight", type=int, default=4)
     p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--journal", default="",
+                   help="record a causal-trace journal here")
+    p.add_argument("--origin", type=int, default=2,
+                   help="this process's trace origin id")
     p.set_defaults(fn=tenant)
 
     args = ap.parse_args(argv)

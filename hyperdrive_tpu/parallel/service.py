@@ -55,6 +55,11 @@ from hyperdrive_tpu.codec import SerdeError, Writer
 from hyperdrive_tpu.crypto.keys import KeyRing
 from hyperdrive_tpu.messages import Precommit
 from hyperdrive_tpu.obs.recorder import NULL_BOUND
+from hyperdrive_tpu.obs.tracectx import (
+    TRACE_MAGIC,
+    note_recv as note_trace_recv,
+    split_frame as split_trace_frame,
+)
 from hyperdrive_tpu.ops.merkle import MAX_DEPTH, MerkleProof
 from hyperdrive_tpu.transport import _LEN, _MAX_FRAME, _recv_exact
 
@@ -70,9 +75,15 @@ __all__ = [
     "STATUS_UNKNOWN_TENANT",
     "STATUS_NO_STATE",
     "TAG_QUERY",
+    "TAG_METRICS",
     "encode_query",
     "encode_proof",
     "decode_proof",
+    "encode_metrics_request",
+    "encode_metrics_reply",
+    "decode_metrics_reply",
+    "encode_hello_ack",
+    "decode_hello_ack",
 ]
 
 # ------------------------------------------------------------ wire format
@@ -90,6 +101,11 @@ TAG_RESULT = 3
 #: client and this port interoperate on the submit path unchanged
 #: (tests/test_service.py pins the cross-version roundtrip).
 TAG_QUERY = 4
+#: Live-metrics scrape (request) / Prometheus snapshot (response) — the
+#: observability read path. Classed WITH proof queries at the admission
+#: gate (first-shed at SHED_LOW_PRIORITY), so a scrape storm can never
+#: displace consensus traffic.
+TAG_METRICS = 5
 
 STATUS_COMMITTED = 0
 STATUS_NO_QUORUM = 1
@@ -113,7 +129,13 @@ _MAX_ROW_SIG = 96
 
 
 @wire_codec(tag="service.hello", max_bytes=1 << 18)
-def encode_hello(name: str, signatories, f: int) -> bytes:
+def encode_hello(name: str, signatories, f: int, t0: float = 0.0) -> bytes:
+    """``t0`` (optional trailing f64) is the client's wall-clock send
+    stamp: the port echoes it back in the hello-ack so the client can
+    estimate the server's clock offset NTP-style (``obs merge`` aligns
+    per-process journals on those estimates). Pre-echo clients simply
+    omit it — :func:`decode_request` reads 0.0 and the ack degrades to
+    a no-offset handshake."""
     w = Writer()
     w.u8(TAG_HELLO)
     w.raw(name.encode("utf-8"))
@@ -121,7 +143,37 @@ def encode_hello(name: str, signatories, f: int) -> bytes:
     w.u32(len(signatories))
     for s in signatories:
         w.bytes32(s)
+    if t0:
+        w.f64(float(t0))
     return w.data()
+
+
+@wire_codec(tag="service.hello.ack", max_bytes=64)
+def encode_hello_ack(t0: float, t1: float, origin: int) -> bytes:
+    """The port's answer to HELLO: the client's echoed send stamp, the
+    server's own receive stamp, and the server's trace origin id. From
+    ``(t0, t1, t3=now)`` the client estimates the server clock offset
+    as ``t1 - (t0 + t3) / 2`` — half the round trip cancels out."""
+    w = Writer()
+    w.u8(TAG_HELLO)
+    w.f64(float(t0))
+    w.f64(float(t1))
+    w.u32(int(origin))
+    return w.data()
+
+
+@wire_codec(tag="service.hello.ack", max_bytes=64)
+def decode_hello_ack(payload: bytes):
+    """Client-side decode: ``(t0, t1, origin)``."""
+    r = maybe_wire_reader("service.hello.ack", payload)
+    if r.u8() != TAG_HELLO:
+        raise SerdeError("expected a hello-ack frame")
+    t0 = r.f64()
+    t1 = r.f64()
+    origin = r.u32()
+    if not r.done():
+        raise SerdeError("trailing bytes after hello-ack frame")
+    return t0, t1, origin
 
 
 @wire_codec(tag="service.submit", max_bytes=_MAX_FRAME)
@@ -187,6 +239,49 @@ def encode_query(req_id: int, account: int) -> bytes:
     w.u64(req_id)
     w.u32(int(account))
     return w.data()
+
+
+@wire_codec(tag="service.metrics", max_bytes=64)
+def encode_metrics_request(req_id: int) -> bytes:
+    """A live-metrics scrape: request the Registry's Prometheus
+    snapshot over the service port. Carries nothing but the request id
+    — the cheapest frame in the protocol, and the first one shed."""
+    w = Writer()
+    w.u8(TAG_METRICS)
+    w.u64(req_id)
+    return w.data()
+
+
+@wire_codec(tag="service.metrics.reply", max_bytes=1 << 18)
+def encode_metrics_reply(req_id: int, status: int, text: str = "") -> bytes:
+    """ONE metrics answer: the rendered Prometheus exposition text (or
+    an empty body for refusals). The 256 KiB budget bounds what a
+    Byzantine server can make a scraper buffer."""
+    w = Writer()
+    w.u8(TAG_METRICS)
+    w.u64(req_id)
+    w.u8(int(status))
+    if status == STATUS_COMMITTED:
+        w.raw(text.encode("utf-8"))
+    return w.data()
+
+
+@wire_codec(tag="service.metrics.reply", max_bytes=1 << 18)
+def decode_metrics_reply(payload: bytes):
+    """Client-side decode: ``(req_id, status, text_or_None)``."""
+    r = maybe_wire_reader("service.metrics.reply", payload)
+    if r.u8() != TAG_METRICS:
+        raise SerdeError("expected a metrics reply frame")
+    req_id = r.u64()
+    status = r.u8()
+    if status != STATUS_COMMITTED:
+        if not r.done():
+            raise SerdeError("trailing bytes after metrics status")
+        return req_id, status, None
+    text = r.raw().decode("utf-8", "replace")
+    if not r.done():
+        raise SerdeError("trailing bytes after metrics reply")
+    return req_id, status, text
 
 
 @wire_codec(tag="service.proof", max_bytes=4096)
@@ -268,19 +363,22 @@ _REQUEST_FAMILIES = {
     TAG_HELLO: "service.hello",
     TAG_SUBMIT: "service.submit",
     TAG_QUERY: "service.query",
+    TAG_METRICS: "service.metrics",
 }
 
 
 @wire_codec(tag="service.hello", max_bytes=1 << 18)
 @wire_codec(tag="service.submit", max_bytes=_MAX_FRAME)
 @wire_codec(tag="service.query", max_bytes=64)
+@wire_codec(tag="service.metrics", max_bytes=64)
 def decode_request(payload: bytes):
-    """Server-side decode: ``("hello", name, f, signatories)``,
+    """Server-side decode: ``("hello", name, f, signatories, t0)``,
     ``("submit", req_id, height, round, value, generation, rows)`` with
-    ``rows`` as ``(sender, signature)`` pairs, or
-    ``("query", req_id, account)``. Raises SerdeError on anything
-    malformed, over the width caps, or carrying trailing garbage — a
-    truncated or padded frame is rejected typed, never half-decoded."""
+    ``rows`` as ``(sender, signature)`` pairs,
+    ``("query", req_id, account)``, or ``("metrics", req_id)``. Raises
+    SerdeError on anything malformed, over the width caps, or carrying
+    trailing garbage — a truncated or padded frame is rejected typed,
+    never half-decoded."""
     if not payload:
         raise SerdeError("empty service frame")
     family = _REQUEST_FAMILIES.get(payload[0])
@@ -298,9 +396,12 @@ def decode_request(payload: bytes):
         if n > _MAX_SIGNATORIES:
             raise SerdeError(f"committee too wide: {n}")
         sigs = [r.bytes32() for _ in range(n)]
+        # Pre-echo hellos end here; echo-era clients append their
+        # wall-clock send stamp (the offset-estimation seed).
+        t0 = 0.0 if r.done() else r.f64()
         if not r.done():
             raise SerdeError("trailing bytes after hello frame")
-        return ("hello", name, f, sigs)
+        return ("hello", name, f, sigs, t0)
     if tag == TAG_SUBMIT:
         req_id = r.u64()
         height = r.i64()
@@ -320,6 +421,11 @@ def decode_request(payload: bytes):
         if not r.done():
             raise SerdeError("trailing bytes after submit frame")
         return ("submit", req_id, height, rnd, value, generation, rows)
+    if tag == TAG_METRICS:
+        req = ("metrics", r.u64())
+        if not r.done():
+            raise SerdeError("trailing bytes after metrics frame")
+        return req
     req = ("query", r.u64(), r.u32())
     if not r.done():
         raise SerdeError("trailing bytes after query frame")
@@ -388,8 +494,14 @@ class ShardVerifyService:
 
     def __init__(self, verifier, queue=None, max_depth: int = 8,
                  obs=None, tracer=None, devtel=None, policy=None,
-                 cert_keep=None):
+                 cert_keep=None, registry=None):
         from hyperdrive_tpu.devsched import DeviceWorkQueue
+
+        #: Optional metrics :class:`~hyperdrive_tpu.obs.metrics.
+        #: Registry` — the live metrics plane: when set, the remote
+        #: port answers TAG_METRICS scrapes with its rendered
+        #: Prometheus snapshot (admission-gated with the read path).
+        self.registry = registry
 
         self.verifier = verifier
         self.queue = (
@@ -628,12 +740,13 @@ class ShardVerifyService:
         )
 
     def remote_port(self, host: str = "127.0.0.1", port: int = 0,
-                    controller=None, obs=None) -> "ServicePort":
+                    controller=None, obs=None, trace=None) -> "ServicePort":
         """Open the cross-process submit path: replicas in other
         processes connect a :class:`RemoteServiceClient` here and their
         windows coalesce into the same launches as local tenants'."""
         return ServicePort(
-            self, host=host, port=port, controller=controller, obs=obs
+            self, host=host, port=port, controller=controller, obs=obs,
+            trace=trace,
         )
 
     def drain(self) -> int:
@@ -923,11 +1036,17 @@ class ServicePort:
 
     def __init__(self, service: ShardVerifyService,
                  host: str = "127.0.0.1", port: int = 0,
-                 controller=None, obs=None):
+                 controller=None, obs=None, trace=None):
         from hyperdrive_tpu.load.backpressure import BackpressureController
 
         self.service = service
         self.obs = obs if obs is not None else service.obs
+        #: Optional :class:`~hyperdrive_tpu.obs.tracectx.TraceSource`:
+        #: when set, every answer frame carries a causal stamp and the
+        #: hello-ack advertises this origin id for offset estimation.
+        #: Inbound stamped requests are stripped + marked ``trace.recv``
+        #: regardless (stamp recognition costs one byte compare).
+        self.trace = trace
         if controller is None:
             controller = BackpressureController()
             controller.watch(service.queue)
@@ -944,6 +1063,8 @@ class ServicePort:
         self.remote_sheds = 0
         self.remote_queries = 0
         self.query_sheds = 0
+        self.metrics_serves = 0
+        self.metrics_sheds = 0
         self.bad_frames = 0
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -1008,6 +1129,8 @@ class ServicePort:
                 return
 
     def _send(self, conn: _RemoteConn, payload: bytes) -> None:
+        if self.trace is not None:
+            payload = self.trace.stamp(payload)
         try:
             conn.outbox.put_nowait(_LEN.pack(len(payload)) + payload)
         except queue_mod.Full:
@@ -1028,28 +1151,105 @@ class ServicePort:
                 break
             handled += 1
             try:
+                ctx = None
+                if payload and payload[0] == TRACE_MAGIC:
+                    ctx, payload = split_trace_frame(payload)
                 req = decode_request(payload)
             except SerdeError:
                 self.bad_frames += 1
                 continue
+            if ctx is not None and self.obs is not NULL_BOUND:
+                note_trace_recv(
+                    self.obs, ctx,
+                    req[2] if req[0] == "submit" else -1,
+                )
             if req[0] == "hello":
                 self._handle_hello(conn, *req[1:])
             elif req[0] == "query":
                 self._handle_query(conn, *req[1:])
+            elif req[0] == "metrics":
+                self._handle_metrics(conn, *req[1:])
             else:
                 self._handle_submit(conn, *req[1:])
         return handled
 
-    def _handle_hello(self, conn, name, f, signatories) -> None:
+    def _handle_hello(self, conn, name, f, signatories,
+                      t0: float = 0.0) -> None:
         from hyperdrive_tpu.load.backpressure import AdmissionGate
 
         conn.tenant = name
         conn.f = int(f)
-        conn.certifier = self.service.certifier(signatories, f)
+        # The port's obs handle rides into the certifier so cert.emit
+        # marks land in the journal at the minted height — the
+        # critical-path report's "cert" milestone.
+        conn.certifier = self.service.certifier(
+            signatories, f, obs=self.obs
+        )
         watermarks = self.service.watermarks
         conn.gate = AdmissionGate(
             self.controller,
             height_fn=lambda name=name: watermarks.get(name, 0) + 1,
+        )
+        # Echo handshake: hand back the client's send stamp plus our
+        # own wall-clock so it can place this process on its offset
+        # graph. Answered for every hello — a pre-echo client's read
+        # loop drops the unexpected frame as a typed decode miss.
+        origin = self.trace.origin if self.trace is not None else 0
+        self._send(
+            conn, encode_hello_ack(t0, time.time(), origin)
+        )
+
+    def _handle_metrics(self, conn, req_id) -> None:
+        """One TAG_METRICS request → ONE Prometheus-text frame (or a
+        status-only refusal). Scrapes ride the tenant's admission gate
+        classed WITH proof queries: at SHED_LOW_PRIORITY and above the
+        port answers STATUS_SHED without rendering anything — the
+        observability plane is the first load shed, never a reason
+        consensus traffic queues."""
+        from hyperdrive_tpu.load.frames import MetricsFrame
+
+        if conn.tenant is None:
+            self._send(
+                conn,
+                encode_metrics_reply(req_id, STATUS_UNKNOWN_TENANT),
+            )
+            return
+        self.controller.poll()
+        if not conn.gate.admit(MetricsFrame(), peer=conn.tenant):
+            self.metrics_sheds += 1
+            if self.obs is not NULL_BOUND:
+                self.obs.emit("metrics.shed", -1, -1, conn.tenant)
+            self._send(conn, encode_metrics_reply(req_id, STATUS_SHED))
+            return
+        registry = self.service.registry
+        if registry is None:
+            self._send(
+                conn, encode_metrics_reply(req_id, STATUS_NO_STATE)
+            )
+            return
+        from hyperdrive_tpu.obs.metrics import to_prometheus
+
+        # Refresh the service-posture gauges at scrape time so every
+        # answer reflects live state (a pull-model scrape, not a stale
+        # copy). Commit latency lands in the registry on each resolve.
+        registry.set_gauge("service.queue.depth",
+                           self.service.queue.depth)
+        registry.set_gauge("service.queue.launches",
+                           self.service.queue.launches)
+        registry.set_gauge("service.queue.coalesced",
+                           self.service.queue.coalesced)
+        registry.set_gauge("service.remote.submits", self.remote_submits)
+        registry.set_gauge("service.remote.resolves",
+                           self.remote_resolves)
+        registry.set_gauge("service.remote.sheds", self.remote_sheds)
+        registry.set_gauge("service.metrics.serves", self.metrics_serves)
+        registry.set_gauge("service.metrics.sheds", self.metrics_sheds)
+        text = to_prometheus(registry.snapshot())
+        self.metrics_serves += 1
+        if self.obs is not NULL_BOUND:
+            self.obs.emit("metrics.serve", -1, -1, len(text))
+        self._send(
+            conn, encode_metrics_reply(req_id, STATUS_COMMITTED, text)
         )
 
     def _handle_query(self, conn, req_id, account) -> None:
@@ -1147,17 +1347,20 @@ class ServicePort:
             self.obs.emit(
                 "service.remote.submit", height, rnd, len(items)
             )
+        t_sub = time.time()
         fut = self.service.submit(conn.tenant, items, generation)
         fut.add_done_callback(
             lambda f, conn=conn, req_id=req_id, height=height, rnd=rnd,
-            value=value, rows=rows, admitted_idx=admitted_idx:
+            value=value, rows=rows, admitted_idx=admitted_idx,
+            t_sub=t_sub:
             self._resolve(
-                f, conn, req_id, height, rnd, value, rows, admitted_idx
+                f, conn, req_id, height, rnd, value, rows, admitted_idx,
+                t_sub,
             )
         )
 
     def _resolve(self, fut, conn, req_id, height, rnd, value, rows,
-                 admitted_idx) -> None:
+                 admitted_idx, t_sub=None) -> None:
         """Queue-drain callback: fold the launch verdict back into a
         full-window mask, mint the certificate if the quorum stands,
         and answer with ONE O(1) certificate frame — never the 2f+1
@@ -1183,6 +1386,16 @@ class ServicePort:
             self.obs.emit(
                 "service.remote.resolve", height, rnd,
                 STATUS_NAMES[status],
+            )
+        # The finality-SLO source: per-tenant submit→certificate wall
+        # time, same histogram name the device-telemetry leg uses so
+        # slo.evaluate_slos reads one series either way.
+        registry = self.service.registry
+        if (registry is not None and t_sub is not None
+                and status == STATUS_COMMITTED):
+            registry.observe(
+                "tenant.commit.latency", time.time() - t_sub,
+                label=conn.tenant,
             )
         root = None
         if status == STATUS_COMMITTED:
@@ -1221,7 +1434,8 @@ class RemoteFuture:
     """Resolution handle for one remote window: a thread event the
     client's reader sets when the certificate frame lands."""
 
-    __slots__ = ("_event", "status", "mask", "cert", "root", "proof")
+    __slots__ = ("_event", "status", "mask", "cert", "root", "proof",
+                 "text")
 
     def __init__(self):
         self._event = threading.Event()
@@ -1236,6 +1450,9 @@ class RemoteFuture:
         #: :class:`~hyperdrive_tpu.ops.merkle.MerkleProof` for a
         #: TAG_QUERY request (None on submit futures and refusals).
         self.proof = None
+        #: Prometheus exposition text for a TAG_METRICS request (None
+        #: on every other future and on refusals).
+        self.text = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -1254,6 +1471,12 @@ class RemoteFuture:
             raise TimeoutError("remote proof query timed out")
         return self.status, self.proof
 
+    def metrics_result(self, timeout: float = 30.0):
+        """``(status, text_or_None)`` for a TAG_METRICS request."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("remote metrics scrape timed out")
+        return self.status, self.text
+
 
 class RemoteServiceClient:
     """One remote tenant's connection to a :class:`ServicePort`.
@@ -1263,10 +1486,20 @@ class RemoteServiceClient:
     can keep several windows on the wire — which is exactly what lets
     the serving host coalesce them with other tenants' work."""
 
-    def __init__(self, host: str, port: int, timeout: float = 10.0):
+    def __init__(self, host: str, port: int, timeout: float = 10.0,
+                 obs=None, trace=None):
         self.sock = socket.create_connection((host, port), timeout=timeout)
         self.sock.settimeout(None)
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        #: Flight-recorder handle for ``trace.recv`` / ``trace.offset``
+        #: marks (the reader thread emits, so bind a threadsafe
+        #: Recorder) and :class:`~hyperdrive_tpu.obs.tracectx.
+        #: TraceSource` for stamping outbound requests.
+        self.obs = obs if obs is not None else NULL_BOUND
+        self.trace = trace
+        #: server trace-origin id -> estimated clock offset (seconds,
+        #: ``server_clock - local_clock``) from the hello-ack echo.
+        self.clock_offsets: dict = {}
         self._send_lock = threading.Lock()
         self._pending_lock = threading.Lock()
         self._pending: dict = {}
@@ -1277,7 +1510,7 @@ class RemoteServiceClient:
         self._reader.start()
 
     def hello(self, name: str, signatories, f: int) -> None:
-        self._send(encode_hello(name, signatories, f))
+        self._send(encode_hello(name, signatories, f, t0=time.time()))
 
     def submit(self, height: int, round: int, value: bytes, rows,
                generation: int = 0) -> RemoteFuture:
@@ -1304,7 +1537,22 @@ class RemoteServiceClient:
         self._send(encode_query(req_id, account))
         return fut
 
+    def metrics(self) -> RemoteFuture:
+        """Scrape the serving host's metrics Registry: one TAG_METRICS
+        request → the rendered Prometheus snapshot. Resolve with
+        :meth:`RemoteFuture.metrics_result`; STATUS_SHED answers are
+        retryable — and by doctrine the FIRST thing shed under load."""
+        fut = RemoteFuture()
+        with self._pending_lock:
+            req_id = self._next_req
+            self._next_req += 1
+            self._pending[req_id] = fut
+        self._send(encode_metrics_request(req_id))
+        return fut
+
     def _send(self, payload: bytes) -> None:
+        if self.trace is not None:
+            payload = self.trace.stamp(payload)
         frame = _LEN.pack(len(payload)) + payload
         with self._send_lock:
             self.sock.sendall(frame)
@@ -1322,9 +1570,22 @@ class RemoteServiceClient:
                 if payload is None:
                     return
                 try:
+                    if payload and payload[0] == TRACE_MAGIC:
+                        ctx, payload = split_trace_frame(payload)
+                        if self.obs is not NULL_BOUND:
+                            note_trace_recv(self.obs, ctx)
+                    text = None
                     if payload and payload[0] == TAG_QUERY:
                         req_id, status, proof = decode_proof(payload)
                         mask = cert = root = None
+                    elif payload and payload[0] == TAG_HELLO:
+                        self._note_offset(*decode_hello_ack(payload))
+                        continue
+                    elif payload and payload[0] == TAG_METRICS:
+                        req_id, status, text = decode_metrics_reply(
+                            payload
+                        )
+                        mask = cert = root = proof = None
                     else:
                         req_id, status, mask, cert, root = decode_result(
                             payload
@@ -1340,9 +1601,26 @@ class RemoteServiceClient:
                     fut.cert = cert
                     fut.root = root
                     fut.proof = proof
+                    fut.text = text
                     fut._event.set()
         except OSError:
             return
+
+    def _note_offset(self, t0: float, t1: float, origin: int) -> None:
+        """Fold one hello-ack echo into the offset table: NTP-style,
+        ``offset ≈ t1 - (t0 + t3) / 2`` — the server's receive stamp
+        against the midpoint of the round trip. A pre-echo server (t0
+        never stamped) or an untraced port (origin 0) contributes
+        nothing."""
+        if not t0 or not origin:
+            return
+        t3 = time.time()
+        offset = t1 - (t0 + t3) / 2.0
+        self.clock_offsets[origin] = offset
+        if self.obs is not NULL_BOUND:
+            self.obs.emit(
+                "trace.offset", -1, -1, f"{origin}:{offset:.6f}"
+            )
 
     def close(self) -> None:
         try:

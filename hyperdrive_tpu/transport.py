@@ -39,6 +39,11 @@ from hyperdrive_tpu.messages import (
     marshal_message,
     unmarshal_message,
 )
+from hyperdrive_tpu.obs.tracectx import (
+    TRACE_MAGIC,
+    note_recv as note_trace_recv,
+    split_frame as split_trace_frame,
+)
 from hyperdrive_tpu.utils.log import get_logger, kv as _kv
 
 __all__ = [
@@ -130,10 +135,17 @@ class TcpNode:
 
     def __init__(self, listen_port: int = 0, host: str = "127.0.0.1",
                  obs=None, admission=None, registry=None, seed: int = 0,
-                 backoff=None):
+                 backoff=None, trace=None):
         from hyperdrive_tpu.obs.recorder import NULL_BOUND
 
         self._host = host
+        #: Optional :class:`~hyperdrive_tpu.obs.tracectx.TraceSource`:
+        #: when set, every outbound frame carries a 21-byte causal
+        #: stamp ahead of the envelope (emitting ``trace.send``) and
+        #: inbound stamped frames are stripped + marked ``trace.recv``.
+        #: Unstamped peers interoperate unchanged — the stamp magic
+        #: byte cannot begin a legal envelope.
+        self.trace = trace
         #: Reconnect-backoff shaping overrides (``base`` / ``factor`` /
         #: ``cap`` / ``jitter`` kwargs of :func:`reconnect_schedule`).
         #: The cap is a per-node deployment knob: a LAN mesh wants a
@@ -319,6 +331,9 @@ class TcpNode:
                 except OSError:
                     return
                 try:
+                    ctx = None
+                    if payload and payload[0] == TRACE_MAGIC:
+                        ctx, payload = split_trace_frame(payload)
                     msg = unmarshal_message(
                         maybe_wire_reader("msg.envelope", payload,
                                           obs=self.obs)
@@ -330,6 +345,11 @@ class TcpNode:
                         self.obs.emit("wire.frame.malformed", -1, -1,
                                       len(payload))
                     continue  # malformed envelope: drop the frame
+                if ctx is not None and self.obs is not self._obs_null:
+                    note_trace_recv(
+                        self.obs, ctx, msg.height,
+                        getattr(msg, "round", -1),
+                    )
                 if self._stop.is_set():
                     return
                 self._deliver(msg, peer=peer)
@@ -446,6 +466,14 @@ class TcpNode:
         (``wire.frame.shed``), and emits the obs pair."""
         self._deliver(msg, local=True)
         frame = encode_frame(msg)
+        if self.trace is not None:
+            # Stamp INSIDE the length framing: strip encode_frame's
+            # header, prefix the 21-byte trace context, re-frame.
+            body = self.trace.stamp(
+                frame[_LEN.size:], height=msg.height,
+                round_=getattr(msg, "round", -1),
+            )
+            frame = _LEN.pack(len(body)) + body
         # Frames queue with the class they would shed under: prevotes are
         # the low-priority tier; everything else only ever sheds as
         # best-effort backlog eviction.

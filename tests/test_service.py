@@ -332,9 +332,10 @@ def test_remote_replay_of_committed_height_sheds_as_stale():
 
 def test_wire_roundtrip_hello_submit_result():
     shard = TenantShard("w", n_validators=5, target_height=1, sign=False)
-    kind, name, f, sigs = decode_request(
+    kind, name, f, sigs, t0 = decode_request(
         encode_hello("w", shard.ring.signatories, shard.f)
     )
+    assert t0 == 0.0
     assert (kind, name, f) == ("hello", "w", shard.f)
     assert sigs == list(shard.ring.signatories)
 

@@ -1,0 +1,295 @@
+"""Distributed tracing: stamp codec, journal merge determinism,
+critical-path attribution, SLO evaluation, and the merged Perfetto
+export. All jax-free — the trace plane is pure stdlib by design.
+
+The determinism contract mirrors the recorder's own: fixed inputs →
+byte-identical merged journals (``merged_digest``) and identical
+critical-path tables. Virtual-clock journals carry no ``trace.offset``
+events, so their merge is a pure deterministic interleave; wall-clock
+merges align on the HELLO echo estimates but the causal clamp keeps
+``trace.recv`` from ever preceding its ``trace.send``.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from hyperdrive_tpu.codec import SerdeError
+from hyperdrive_tpu.obs.merge import (
+    estimate_offsets,
+    merge_journals,
+    merged_digest,
+    save_merged,
+)
+from hyperdrive_tpu.obs.perfetto import to_trace_events
+from hyperdrive_tpu.obs.recorder import Event, Recorder, load_journal
+from hyperdrive_tpu.obs.report import (
+    critical_path_summary,
+    render_critical_path_table,
+)
+from hyperdrive_tpu.obs.slo import evaluate_slos
+from hyperdrive_tpu.obs.tracectx import (
+    STAMP_LEN,
+    TRACE_MAGIC,
+    TraceSource,
+    decode_stamp,
+    encode_stamp,
+    span_id,
+    split_frame,
+)
+
+
+# ------------------------------------------------------------ stamp codec
+
+
+def test_stamp_roundtrip_and_length():
+    frame = encode_stamp(7, 1234, span_id(3, 9))
+    assert len(frame) == STAMP_LEN
+    assert frame[0] == TRACE_MAGIC
+    assert decode_stamp(frame) == (7, 1234, (3 << 32) | 9)
+
+
+def test_stamp_rejects_bad_magic_and_trailing():
+    frame = encode_stamp(1, 1)
+    with pytest.raises(SerdeError):
+        decode_stamp(b"\x00" + frame[1:])
+    with pytest.raises(SerdeError):
+        decode_stamp(frame + b"\x00")
+
+
+def test_split_frame_passthrough_for_unstamped():
+    # Consensus envelopes open with a small i8 tag, service frames with
+    # 1..5 — none collide with the magic, so unstamped frames pass
+    # through byte-identically (the interop guarantee).
+    for payload in (b"\x01rest-of-frame", b"\x05xyz", b""):
+        ctx, rest = split_frame(payload)
+        assert ctx is None and rest == payload
+    stamped = encode_stamp(2, 5) + b"\x01rest"
+    ctx, rest = split_frame(stamped)
+    assert ctx == (2, 5, 0) and rest == b"\x01rest"
+
+
+def test_trace_source_monotone_and_emitting():
+    rec = Recorder()
+    src = TraceSource(4, obs=rec.scoped(-1))
+    out = src.stamp(b"payload", height=7)
+    assert split_frame(out) == ((4, 1, 0), b"payload")
+    src.stamp(b"x")
+    kinds = [(ev[4], ev[5]) for ev in rec.snapshot()]
+    assert kinds == [("trace.send", "4:1"), ("trace.send", "4:2")]
+    with pytest.raises(ValueError):
+        TraceSource(0)
+
+
+# ---------------------------------------------------- synthetic journals
+
+
+def _journal(origin, events, **extra):
+    data = {
+        "version": 1,
+        "capacity": 65536,
+        "total": len(events),
+        "dropped": 0,
+        "events": [list(ev) for ev in events],
+        "meta": {"origin": origin},
+    }
+    data.update(extra)
+    return data
+
+
+def _two_process_run(skew=0.0, drop_sender=False):
+    """A hand-built 2-process exchange: the server (origin 1) commits
+    height 1 after the client (origin 2) submits; the client's clock
+    runs ``skew`` seconds ahead of the server's."""
+    server = [
+        (10.000, -1, 1, -1, "trace.recv", "2:1"),
+        (10.001, -1, 1, 0, "service.remote.submit", 4),
+        (10.003, -1, 1, 0, "cert.emit", None),
+        (10.004, -1, 1, 0, "service.remote.resolve", "committed"),
+        (10.005, -1, -1, -1, "trace.send", "1:1"),
+    ]
+    client = [
+        (9.998 + skew, -1, -1, -1, "trace.send", "2:1"),
+        # The echo handshake's estimate: server clock = client - skew.
+        (9.999 + skew, -1, -1, -1, "trace.offset", f"1:{-skew:.6f}"),
+        (10.006 + skew, -1, -1, -1, "trace.recv", "1:1"),
+        (10.007 + skew, -1, 1, -1, "commit", None),
+    ]
+    if drop_sender:
+        server = [ev for ev in server if ev[4] != "trace.send"]
+    return [_journal(1, server), _journal(2, client)]
+
+
+def test_merge_is_deterministic_and_digest_stable():
+    a = merge_journals(_two_process_run())
+    b = merge_journals(_two_process_run())
+    assert merged_digest(a) == merged_digest(b)
+    assert a["events"] == b["events"]
+    assert a["meta"]["origins"] == [1, 2]
+    assert a["meta"]["orphans"] == []
+    # pid stamping: every merged event carries its origin in slot 7.
+    pids = {Event(tuple(ev)).pid for ev in a["events"]}
+    assert pids == {1, 2}
+
+
+def test_merge_aligns_skewed_clocks():
+    skewed = merge_journals(_two_process_run(skew=5.0))
+    flat = merge_journals(_two_process_run(skew=0.0))
+    # Offset estimation maps the skewed client back onto the server
+    # clock, so the merged ORDER matches the zero-skew merge exactly.
+    order = lambda m: [(ev[4], ev[6]) for ev in m["events"]]
+    assert order(skewed) == order(flat)
+    assert skewed["meta"]["offsets"]["2"] == pytest.approx(-5.0)
+
+
+def test_merge_clamps_causality():
+    # A wildly-wrong offset estimate cannot order a recv before its
+    # send: detail-matched spans are clamped, so the server's recv of
+    # "2:1" never precedes the client's send of "2:1".
+    journals = _two_process_run(skew=5.0)
+    # Corrupt the estimate: claim the clocks agree when they don't.
+    journals[1]["events"] = [
+        list(ev) if ev[4] != "trace.offset" else
+        [ev[0], ev[1], ev[2], ev[3], ev[4], "1:5.0"]
+        for ev in journals[1]["events"]
+    ]
+    merged = merge_journals(journals)
+    by_kind = {}
+    for ev in merged["events"]:
+        if ev[4].startswith("trace.") and ev[5] == "2:1":
+            by_kind[ev[4]] = ev[0]
+    assert by_kind["trace.recv"] >= by_kind["trace.send"]
+
+
+def test_merge_flags_orphans_never_drops():
+    merged = merge_journals(_two_process_run(drop_sender=True))
+    # The client's recv of "1:1" lost its sender — flagged, kept.
+    assert merged["meta"]["orphans"] == ["2<-1:1"]
+    kinds = [ev[4] for ev in merged["events"]]
+    assert "trace.recv" in kinds  # the orphaned event is still there
+
+
+def test_merge_rejects_duplicate_origins():
+    j = _two_process_run()
+    j[1]["meta"]["origin"] = 1
+    with pytest.raises(ValueError, match="duplicate"):
+        merge_journals(j)
+
+
+def test_estimate_offsets_bfs_from_lowest_origin():
+    journals = {
+        1: [],
+        2: [(0.0, -1, -1, -1, "trace.offset", "1:-3.0")],
+        3: [(0.0, -1, -1, -1, "trace.offset", "2:1.0")],
+    }
+    deltas = estimate_offsets(journals)
+    assert deltas[1] == 0.0  # the reference clock
+    assert deltas[2] == pytest.approx(-3.0)
+    assert deltas[3] == pytest.approx(-2.0)  # composed through 2
+
+
+def test_merged_journal_roundtrips_through_load(tmp_path):
+    merged = merge_journals(_two_process_run())
+    path = tmp_path / "merged.json"
+    save_merged(merged, path)
+    loaded = load_journal(path)
+    assert loaded["meta"]["merged"] is True
+    assert [list(ev) for ev in loaded["events"]] == merged["events"]
+    assert merged_digest(loaded) == merged_digest(merged)
+
+
+# --------------------------------------------------------- critical path
+
+
+def test_critical_path_attributes_every_hop():
+    merged = merge_journals(_two_process_run())
+    summary = critical_path_summary(merged["events"])
+    assert len(summary["rows"]) == 1
+    row = summary["rows"][0]
+    assert row["height"] == 1
+    # Full chain: send -> recv -> submit -> cert -> resolve -> commit.
+    names = list(row["milestones"])
+    assert names[0] == "send" and names[-1] == "commit"
+    # Telescoping hops attribute exactly 100% of first-to-last span.
+    assert row["attributed"] == pytest.approx(1.0)
+    assert row["total_s"] == pytest.approx(
+        sum(dt for _, dt in row["hops"])
+    )
+    assert summary["dominant"]  # some hop dominates
+    table = render_critical_path_table(summary)
+    assert "dominant hop" in table and "100%" in table
+
+
+def test_critical_path_table_identical_across_merges():
+    t1 = render_critical_path_table(
+        critical_path_summary(merge_journals(_two_process_run())["events"])
+    )
+    t2 = render_critical_path_table(
+        critical_path_summary(merge_journals(_two_process_run())["events"])
+    )
+    assert t1 == t2
+
+
+# ------------------------------------------------------- perfetto export
+
+
+def test_perfetto_merged_draws_cross_process_arrows():
+    merged = merge_journals(_two_process_run())
+    evs = to_trace_events([Event(tuple(ev)) for ev in merged["events"]])
+    pids = {e["pid"] for e in evs if e["ph"] != "M"}
+    assert pids == {1, 2}
+    flows = [e for e in evs if e.get("cat") == "traceflow"]
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], set()).add(e["pid"])
+    # Both spans ("2:1" and "1:1") cross the process boundary.
+    assert sum(1 for v in by_id.values() if len(v) > 1) == 2
+    procs = [e for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"]
+    assert len(procs) == 2
+
+
+def test_perfetto_single_process_journal_unchanged():
+    # 6-tuple journals (no pid slot) still render under pid 0.
+    evs = to_trace_events([
+        (1.0, 0, 1, 0, "round.start", None),
+        (2.0, 0, 1, 0, "commit", None),
+    ])
+    assert {e["pid"] for e in evs} == {0}
+
+
+# ------------------------------------------------------------------- slo
+
+
+def test_slo_evaluation_and_journal_marks():
+    rec = Recorder()
+    snapshot = {
+        "counters": {}, "gauges": {},
+        "histograms": {"tenant.commit.latency": {
+            "t-a": {"count": 10, "sum": 1.0, "mean": 0.1,
+                    "p50": 0.1, "p95": 0.2, "p99": 0.3},
+        }},
+    }
+    events = [
+        (1.0, -1, -1, -1, "service.remote.submit", 1),
+        (2.0, -1, -1, -1, "service.remote.shed", "t-a"),
+        (3.0, -1, -1, -1, "metrics.serve", 100),
+        (4.0, -1, -1, -1, "metrics.shed", "t-a"),
+    ]
+    results = evaluate_slos(snapshot=snapshot, events=events,
+                            obs=rec.scoped(-1))
+    by_name = {r.name: r for r in results}
+    assert by_name["finality_p99"].measured == pytest.approx(0.3)
+    assert by_name["finality_p99"].ok  # 0.3 <= 0.75 ceiling
+    assert by_name["shed_rate"].measured == pytest.approx(0.5)
+    assert not by_name["shed_rate"].ok  # 0.5 > 0.25 ceiling
+    assert "rollback_rate" not in by_name  # no speculation: skipped
+    marks = {ev[4] for ev in rec.snapshot()}
+    assert marks == {"slo.ok", "slo.breach"}
+
+
+def test_slo_missing_inputs_are_skipped_not_passed():
+    assert evaluate_slos() == []
+    assert evaluate_slos(snapshot={"histograms": {}}) == []

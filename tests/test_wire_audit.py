@@ -57,13 +57,20 @@ from hyperdrive_tpu.messages import (
     unmarshal_message,
 )
 from hyperdrive_tpu.ops.merkle import MerkleProof
+from hyperdrive_tpu.obs.tracectx import decode_stamp, encode_stamp
 from hyperdrive_tpu.parallel.service import (
     STATUS_COMMITTED,
     STATUS_NO_QUORUM,
+    STATUS_SHED,
+    decode_hello_ack,
+    decode_metrics_reply,
     decode_proof,
     decode_request,
     decode_result,
     encode_hello,
+    encode_hello_ack,
+    encode_metrics_reply,
+    encode_metrics_request,
     encode_proof,
     encode_query,
     encode_result,
@@ -140,12 +147,19 @@ def _fn_bytes(marshal_fn, obj) -> bytes:
 
 def _reencode_request(req) -> bytes:
     kind = req[0]
-    if kind == "hello":  # ("hello", name, f, signatories)
-        return encode_hello(req[1], req[3], req[2])
+    if kind == "hello":  # ("hello", name, f, signatories, t0)
+        return encode_hello(req[1], req[3], req[2], t0=req[4])
     if kind == "submit":  # ("submit", req_id, h, r, value, gen, rows)
         return encode_submit(req[1], req[2], req[3], req[4], req[6],
                              generation=req[5])
+    if kind == "metrics":  # ("metrics", req_id)
+        return encode_metrics_request(req[1])
     return encode_query(req[1], req[2])  # ("query", req_id, account)
+
+
+def _reencode_metrics_reply(res) -> bytes:
+    req_id, status, text = res
+    return encode_metrics_reply(req_id, status, text or "")
 
 
 def _reencode_result(res) -> bytes:
@@ -222,7 +236,32 @@ SAMPLES = {
     "service.hello": (
         decode_request,
         _reencode_request,
-        [encode_hello("tenant-a", [b"\x01" * 32, b"\x02" * 32], 0)],
+        [encode_hello("tenant-a", [b"\x01" * 32, b"\x02" * 32], 0),
+         encode_hello("tenant-b", [b"\x01" * 32], 0, t0=12345.625)],
+    ),
+    "service.hello.ack": (
+        decode_hello_ack,
+        lambda v: encode_hello_ack(*v),
+        [encode_hello_ack(12345.625, 12345.75, 7),
+         encode_hello_ack(0.0, 0.0, 0)],
+    ),
+    "service.metrics": (
+        decode_request,
+        _reencode_request,
+        [encode_metrics_request(9)],
+    ),
+    "service.metrics.reply": (
+        decode_metrics_reply,
+        _reencode_metrics_reply,
+        [encode_metrics_reply(9, STATUS_COMMITTED,
+                              "# TYPE hd_x counter\nhd_x 1\n"),
+         encode_metrics_reply(9, STATUS_SHED)],
+    ),
+    "trace.ctx": (
+        decode_stamp,
+        lambda v: encode_stamp(*v),
+        [encode_stamp(1, 1, 0),
+         encode_stamp(3, 512, (2 << 32) | 41)],
     ),
     "service.submit": (
         decode_request,
@@ -444,14 +483,19 @@ def test_envelope_rejects_oversized_signature():
 
 def test_request_rejects_trailing_garbage():
     """decode_request rejects a frame with bytes after the request body
-    (typed, never silently half-decoded)."""
+    (typed, never silently half-decoded). A hello's last 8 bytes are
+    the optional t0 echo stamp, so its garbage lands AFTER a stamped
+    frame; a partial (non-f64-sized) hello tail is a typed short read."""
     pad = Writer()
     pad.u32(0)
     for frame in (encode_query(9, 5),
-                  encode_hello("t", [], 0),
-                  encode_submit(9, 7, 2, b"\x11" * 32, [])):
+                  encode_hello("t", [], 0, t0=1.5),
+                  encode_submit(9, 7, 2, b"\x11" * 32, []),
+                  encode_metrics_request(9)):
         with pytest.raises(SerdeError, match="trailing bytes"):
             decode_request(frame + pad.data())
+    with pytest.raises(SerdeError):
+        decode_request(encode_hello("t", [], 0) + pad.data())
 
 
 def test_request_rejects_oversized_name_and_row_sig():
