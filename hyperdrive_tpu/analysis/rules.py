@@ -689,7 +689,7 @@ class MetricNameRule:
     _CLOSED_PREFIXES = ("sched.launch.", "verify.occupancy.", "metrics.",
                         "load.", "admission.", "bls.", "tenant.drain.",
                         "service.", "exec.", "merkle.", "proof.",
-                        "trace.", "slo.")
+                        "trace.", "slo.", "campaign.")
 
     def check(self, ctx):
         findings: list = []

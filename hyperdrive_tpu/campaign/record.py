@@ -1,0 +1,128 @@
+"""CampaignRecord: the replayable dump of one attack campaign.
+
+Same contract as the harness ScenarioRecord: the record carries the
+full :class:`~hyperdrive_tpu.campaign.CampaignConfig` (as its u64
+trailer), the outcome digest the live run produced, and the canonical
+summary blob — everything :func:`~hyperdrive_tpu.campaign.runner
+.replay_campaign` needs to re-derive the identical trajectory and
+prove it, and everything ``obs report --campaign`` needs to decode a
+dump without importing the campaign engines.
+
+The file format rides the wire-codec machinery (``@wire_codec`` /
+``@wire_entry``), so HD_SANITIZE=1 runs parse dumps under the same
+byte-budget reader the network decoders use.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass
+
+from hyperdrive_tpu.analysis.annotations import wire_codec, wire_entry
+from hyperdrive_tpu.analysis.sanitizer import maybe_wire_reader
+from hyperdrive_tpu.codec import Reader, SerdeError, Writer
+
+from hyperdrive_tpu.campaign import CampaignConfig
+
+__all__ = ["CampaignRecord", "summary_digest", "MAGIC", "VERSION"]
+
+#: "HYDC" — distinct from ScenarioRecord's magic so a mixed-up file
+#: fails loudly at the first u32, not at trailer parse.
+MAGIC = 0x48594443
+VERSION = 1
+
+_MAX_RECORD = 1 << 20
+
+
+def summary_digest(summary: dict) -> bytes:
+    """Digest of a campaign summary: sha256 of its canonical JSON.
+
+    Canonical = sorted keys, no whitespace — the same dict always maps
+    to the same bytes, so live-vs-replay digest equality is exactly
+    trajectory equality (seat trajectories, shed counts, reputation
+    state, per-epoch roots all live in the summary).
+    """
+    blob = json.dumps(
+        summary, sort_keys=True, separators=(",", ":")
+    ).encode()
+    return hashlib.sha256(blob).digest()
+
+
+@wire_codec(tag="campaign.record", max_bytes=_MAX_RECORD)
+@dataclass(frozen=True)
+class CampaignRecord:
+    config: CampaignConfig
+    digest: bytes
+    summary: dict
+
+    # -- wire ---------------------------------------------------------
+
+    def marshal(self, w: Writer) -> None:
+        w.u32(MAGIC)
+        w.u16(VERSION)
+        ints = self.config.as_ints()
+        w.u16(len(ints))
+        for v in ints:
+            w.u64(int(v))
+        w.bytes32(self.digest)
+        w.raw(
+            json.dumps(
+                self.summary, sort_keys=True, separators=(",", ":")
+            ).encode()
+        )
+
+    @classmethod
+    def unmarshal(cls, r: Reader) -> "CampaignRecord":
+        if r.u32() != MAGIC:
+            raise SerdeError("not a campaign record (bad magic)")
+        version = r.u16()
+        if version != VERSION:
+            raise SerdeError(f"unsupported campaign record v{version}")
+        n = r.u16()
+        ints = tuple(r.u64() for _ in range(n))
+        config = CampaignConfig.from_ints(ints)
+        digest = r.bytes32()
+        summary = json.loads(r.raw().decode())
+        if not isinstance(summary, dict):
+            raise SerdeError("campaign summary must be a JSON object")
+        rec = cls(config=config, digest=digest, summary=summary)
+        if summary_digest(summary) != digest:
+            raise SerdeError(
+                "campaign record digest does not match its summary"
+            )
+        return rec
+
+    # -- files --------------------------------------------------------
+
+    def dump(self, path) -> None:
+        w = Writer(rem=_MAX_RECORD)
+        self.marshal(w)
+        with open(path, "wb") as f:
+            f.write(w.data())
+
+    @classmethod
+    @wire_entry
+    def load(cls, payload: bytes, *, obs=None) -> "CampaignRecord":
+        r = maybe_wire_reader(
+            "campaign.record", payload, obs=obs, rem=_MAX_RECORD
+        )
+        return cls.unmarshal(r)
+
+    @classmethod
+    def load_file(cls, path, *, obs=None) -> "CampaignRecord":
+        with open(path, "rb") as f:
+            payload = f.read()
+        return cls.load(payload, obs=obs)
+
+    # -- convenience --------------------------------------------------
+
+    @classmethod
+    def capture(
+        cls, config: CampaignConfig, summary: dict
+    ) -> "CampaignRecord":
+        return cls(
+            config=config,
+            digest=summary_digest(summary),
+            summary=summary,
+        )

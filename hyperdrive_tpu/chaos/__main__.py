@@ -8,7 +8,7 @@ Usage::
         [--churn-every K] [--overload-every K] [--overlay-every K]
         [--tenants-every K] [--exec-every K] [--exec-pipeline-every K]
         [--proofs-every K] [--fuzz-frames-every K] [--metrics-every K]
-        [--dump-ok DIR]
+        [--campaign-every K] [--dump-ok DIR]
     python -m hyperdrive_tpu.chaos replay DUMP.bin
 
 ``soak`` runs N seeded scenarios — each a fresh
@@ -1334,6 +1334,72 @@ def soak(args) -> int:
                 f"{max(e.spec_rollback_depth for e in ssim._exec_unique)} "
                 f"seq-digest=ok replay=ok"
             )
+        if args.campaign_every and k % args.campaign_every == 0:
+            # Every Kth scenario additionally runs the attack-campaign
+            # family (campaign/): a budgeted validator-set-capture
+            # attempt ground through the real ledger + epoch schedule,
+            # judged by the monitor's trajectory proportionality bound,
+            # then round-tripped through its CampaignRecord dump — the
+            # replay-from-dump must re-derive the identical trajectory
+            # digest with zero stored state beyond the config.
+            import tempfile
+
+            from hyperdrive_tpu.campaign import CampaignConfig
+            from hyperdrive_tpu.campaign.record import CampaignRecord
+            from hyperdrive_tpu.campaign.runner import (
+                replay_campaign,
+                run_campaign,
+            )
+
+            ccfg = CampaignConfig(
+                family="capture", seed=scen_seed, validators=128,
+                committee_size=16, attackers=4, sybils=8,
+            )
+            clive = None
+            try:
+                clive = run_campaign(ccfg)
+                if clive.violations:
+                    kind, detail = clive.violations[0]
+                    raise InvariantViolation(kind, detail)
+                with tempfile.TemporaryDirectory() as td:
+                    cpath = os.path.join(td, "campaign.bin")
+                    clive.record.dump(cpath)
+                    loaded = CampaignRecord.load_file(cpath)
+                    same, cfresh = replay_campaign(loaded)
+                if not same:
+                    raise InvariantViolation(
+                        "replay",
+                        "campaign replay-from-dump diverges from the "
+                        f"live run ({cfresh.digest[:8].hex()} vs "
+                        f"{clive.digest[:8].hex()})",
+                    )
+            except (InvariantViolation, AssertionError) as err:
+                failures += 1
+                os.makedirs(args.out, exist_ok=True)
+                cbase = os.path.join(
+                    args.out, f"campaign_seed_{scen_seed}"
+                )
+                if clive is not None:
+                    clive.record.dump(cbase + ".bin")
+                with open(cbase + ".txt", "w") as fh:
+                    fh.write(f"seed={scen_seed}\nviolation={err}\n")
+                print(
+                    f"FAIL campaign seed={scen_seed} {err}\n"
+                    f"  dumped {cbase}.bin\n"
+                    f"  reproduce: python -m hyperdrive_tpu.campaign "
+                    f"replay {cbase}.bin",
+                    file=sys.stderr,
+                )
+                if not args.keep_going:
+                    return 1
+                continue
+            print(
+                f"ok campaign seed={scen_seed} family=capture "
+                f"epochs={ccfg.epochs} "
+                f"seats={clive.summary['seats_total']} "
+                f"passive={clive.summary['passive_total']} "
+                f"digest={clive.digest[:8].hex()} replay=ok"
+            )
     if failures:
         print(f"soak FAILED: {failures}/{args.scenarios}", file=sys.stderr)
         return 1
@@ -1504,6 +1570,17 @@ def main(argv=None) -> int:
         "forced admission floor while consensus submits under the "
         "same floor all commit, and the SLO burn-rate checks measure "
         "and hold; 0 = off)",
+    )
+    p.add_argument(
+        "--campaign-every",
+        type=int,
+        default=0,
+        help="additionally run every Kth seed as an attack-campaign "
+        "scenario (jax-free: a budgeted validator-set-capture attempt "
+        "through the real ledger and epoch schedule, the trajectory "
+        "proportionality bound armed, and a replay-from-dump digest "
+        "identity self-check through the CampaignRecord codec; "
+        "0 = off)",
     )
     p.add_argument(
         "--dump-ok",

@@ -411,6 +411,145 @@ class InvariantMonitor:
                 f"fired for it",
             )
 
+    # --------------------------------------------------- campaign checks
+    # Sim-free staticmethods (the check_tenant_fairness precedent): the
+    # campaign runner hands them the campaign summary, and they speak
+    # InvariantViolation like every other probe, so the chaos soak, the
+    # campaign CLI and the tests all share one failure currency.
+
+    @staticmethod
+    def check_campaign_proportionality(
+        trajectory, *, grind_width: int = 1
+    ) -> None:
+        """The arXiv:2004.12990 proportionality bound over a WHOLE
+        capture trajectory: cumulative adversary committee seats must
+        not exceed cumulative proportional expectation plus a
+        concentration allowance plus the grinding uplift.
+
+        Per epoch ``e`` with realized adversary stake share ``p_e`` and
+        committee size ``k``, a proportional election seats the
+        adversary ``k * p_e`` in expectation with per-epoch deviation
+        ``sigma_e = sqrt(k * p_e * (1 - p_e))``. A grinder choosing the
+        best of ``W`` candidate boundary blocks takes the max of ``W``
+        roughly-independent draws — worth at most
+        ``sigma_e * sqrt(2 ln W)`` extra per epoch (the Gaussian
+        max bound; LOGARITHMIC in grinding effort, which is the whole
+        point of the anchor chain). On top, a 3-sigma allowance over
+        the campaign's summed variance covers ordinary luck. Exceeding
+        the total means the election machinery leaks more than
+        grinding theory permits — a real disproportionality bug, not
+        adversary luck."""
+        import math
+
+        seats = 0.0
+        expected = 0.0
+        var = 0.0
+        grind_slack = 0.0
+        uplift = math.sqrt(2.0 * math.log(max(grind_width, 2)))
+        for row in trajectory:
+            k = row["committee"]
+            p = row["adv_stake"] / row["total_stake"]
+            sigma = math.sqrt(k * p * (1.0 - p))
+            seats += row["seats"]
+            expected += k * p
+            var += k * p * (1.0 - p)
+            grind_slack += sigma * uplift
+        bound = expected + grind_slack + 3.0 * math.sqrt(var)
+        if seats > bound:
+            raise InvariantViolation(
+                "capture-proportionality",
+                f"adversary took {seats:.0f} committee seats over "
+                f"{len(list(trajectory))} epochs; proportional "
+                f"expectation {expected:.1f} + grinding allowance "
+                f"{grind_slack:.1f} (width {grind_width}) + 3-sigma "
+                f"{3.0 * math.sqrt(var):.1f} bounds it at {bound:.1f}",
+            )
+
+    @staticmethod
+    def check_storm_hygiene(summary: dict) -> None:
+        """Signed-vote-storm invariants over a storm (or coincidence)
+        gate summary: verify failures and demotions attribute ONLY to
+        attackers (an honest signer must never fail batch verify or be
+        reputation-shed), shed classes stay inside the closed
+        vocabulary, and — when the reputation loop is on — repeat
+        forgers actually demote and stop reaching the verifier by the
+        final wave (the loop's entire reason to exist)."""
+        from hyperdrive_tpu.load.backpressure import SHED_CLASSES
+
+        gate = summary["gate"]
+        honest = set(summary["honest"])
+        attackers = set(summary["attackers"])
+        for cls in gate["shed"]:
+            if cls not in SHED_CLASSES:
+                raise InvariantViolation(
+                    "storm-shed-class",
+                    f"gate shed under unknown class {cls!r}",
+                )
+        leaked = sorted(set(gate["verify_failed"]) & honest)
+        if leaked:
+            raise InvariantViolation(
+                "storm-attribution",
+                f"honest signers {leaked} charged with verify "
+                "failures — misattribution would let a storm demote "
+                "bystanders",
+            )
+        bad_demotions = sorted(set(gate["demoted"]) - attackers)
+        if bad_demotions:
+            raise InvariantViolation(
+                "storm-attribution",
+                f"non-attackers {bad_demotions} reputation-demoted",
+            )
+        if summary.get("reputation"):
+            if gate["demotions"] < 1 or not gate["shed"].get(
+                "reputation"
+            ):
+                raise InvariantViolation(
+                    "storm-reputation",
+                    "reputation loop on, yet no forger was demoted "
+                    "or reputation-shed across the storm",
+                )
+            last = summary["waves"][-1]
+            if last["attacker_rows_verified"]:
+                raise InvariantViolation(
+                    "storm-reputation",
+                    f"{last['attacker_rows_verified']} forged rows "
+                    "still reached batch verify in the final wave — "
+                    "the reputation loop failed to move the shed "
+                    "ahead of the verifier",
+                )
+            if last["admitted"] < summary["honest_rows"]:
+                raise InvariantViolation(
+                    "storm-liveness",
+                    f"final wave admitted {last['admitted']} rows but "
+                    f"the honest workload alone is "
+                    f"{summary['honest_rows']} — the storm starved "
+                    "honest prevotes instead of shedding forgers",
+                )
+
+    @staticmethod
+    def check_campaign_economy(summary: dict) -> None:
+        """Coincidence-family overlay invariants: the never-starve
+        doctrine holds under the slice (every epoch that exhausted
+        retry windows engaged the ranked fallback), and after the heal
+        runway no HONEST validator is still contribution-demoted —
+        partitions are forgiven, only persistent misbehavior is not."""
+        for row in summary.get("overlay", ()):
+            if row["windows_exhausted"] and not row["fallback_engaged"]:
+                raise InvariantViolation(
+                    "campaign-starvation",
+                    f"epoch {row['epoch']}: {row['windows_exhausted']} "
+                    "slots exhausted their retry windows with no "
+                    "fallback engagement",
+                )
+        stuck = summary.get("honest_demoted_final", [])
+        if stuck:
+            raise InvariantViolation(
+                "campaign-demotion",
+                f"honest validators {stuck} still demoted after the "
+                "heal runway — amnesty plus contribution credit must "
+                "always repay a partition's debt",
+            )
+
     def _check_journal(self) -> None:
         """Cross-check the obs flight recorder against the chain: every
         journalled commit event's value prefix must match what the

@@ -2056,6 +2056,25 @@ class Simulation:
                                     self.obs.capacity)
             self.registry.set_gauge("obs.recorder.total",
                                     self.obs.total)
+        gates = [r.admission for r in self.replicas
+                 if getattr(r, "admission", None) is not None]
+        if gates:
+            # Admission-gate health gauges: how many distinct peers the
+            # gates have charged sheds to, and how many signers stand
+            # reputation-demoted right now — the metrics plane alerts
+            # on the latter (per-peer detail rides the labeled
+            # ``admission.shed_by_peer`` / ``admission.verify_failed``
+            # counters the gates feed live).
+            demoted: set = set()
+            peers_shed = 0
+            for g in gates:
+                peers_shed += len(g.shed_by_peer)
+                if g.reputation is not None:
+                    demoted |= g.reputation.demoted
+            self.registry.set_gauge("admission.shed_peers", peers_shed)
+            self.registry.set_gauge(
+                "admission.reputation.demoted", len(demoted)
+            )
         snap = self.registry.snapshot()
         if self._obs_sim is not _OBS_NULL:
             self._obs_sim.emit(

@@ -53,7 +53,9 @@ __all__ = [
     "CRITICAL_ONLY",
     "LEVEL_NAMES",
     "SHED_CLASSES",
+    "REPUTATION_WEIGHTS",
     "BackpressureController",
+    "SignerReputation",
     "AdmissionGate",
 ]
 
@@ -71,11 +73,20 @@ LEVEL_NAMES = ("accept", "shed_duplicates", "shed_low_priority",
 #: / ``panic`` trade prevote liveness for survival; ``query`` is the
 #: read path — proof queries shed from SHED_LOW_PRIORITY up, always
 #: ahead of any consensus frame (reads are idempotent and retryable, so
-#: a read storm must never starve certificates). There is deliberately
-#: no class for proposals, precommits, or certificates — they are never
+#: a read storm must never starve certificates); ``reputation`` is the
+#: economic path — prevotes from signers whose signatures keep FAILING
+#: device batch verify shed at EVERY level once the signer is demoted
+#: (ROBUSTNESS.md "Adversarial economy"). There is deliberately no
+#: class for proposals, precommits, or certificates — they are never
 #: shed, and the soak asserts the counters for them stay absent.
 SHED_CLASSES = ("duplicate", "stale_height", "low_priority", "panic",
-                "query")
+                "query", "reputation")
+
+#: Integer reputation deltas, mirroring the overlay's CHARGE_WEIGHTS
+#: (overlay/score.py): ``verify_failed`` is the expensive verdict — the
+#: frame passed every cheap admission check and died at device batch
+#: verify, so it outweighs everything else the gate can observe.
+REPUTATION_WEIGHTS = {"verify_failed": 6, "shed_while_demoted": 0}
 
 # Classification (duplicate / stale detection and the dedup key shape)
 # is shared with the overlay contribution scorer through
@@ -245,6 +256,148 @@ class BackpressureController:
             self.obs.emit("admission.level", -1, -1, LEVEL_NAMES[level])
 
 
+class SignerReputation:
+    """Per-signer verify-failure reputation: the admission gate's
+    economic memory (ROBUSTNESS.md "Adversarial economy").
+
+    A forged-but-well-formed signature passes every cheap admission
+    check and dies only at device batch verify — the most expensive
+    verdict in the pipeline. This table closes the loop: the drain path
+    reports each signer's per-row verify outcome back here
+    (:meth:`AdmissionGate.note_verify`), repeat offenders cross
+    ``demote_at`` and their SUBSEQUENT prevotes shed at the gate — at
+    every admission level — under the ``reputation`` class, before the
+    verifier ever sees them.
+
+    The mechanism deliberately mirrors the overlay's
+    :class:`~hyperdrive_tpu.overlay.score.ContributionScores`: integer
+    arithmetic only (scores feed shed decisions, which feed digests),
+    demotion at a threshold above a clamping floor so debt stays
+    repayable, per-commit amnesty (:meth:`rehabilitate`) so no verdict
+    is forever, and recovery credit for verified signatures. The
+    doctrine asymmetry carries over too: an attacker re-earns its debt
+    6 per failed row while amnesty forgives 1 per committed height.
+    Scope is narrower than the overlay's advisory demotion, on purpose:
+    only PREVOTES are reputation-shed — proposals, precommits and
+    certificates stay never-shed, so a mis-charged honest signer loses
+    redundant-vote bandwidth, never safety-critical reach.
+    """
+
+    def __init__(
+        self,
+        *,
+        credit: int = 1,
+        demote_at: int = -8,
+        floor: int = -64,
+        registry=None,
+        obs=None,
+    ):
+        if demote_at <= floor:
+            raise ValueError("demote_at must sit above the score floor")
+        self.credit_per_verify = int(credit)
+        self.demote_at = int(demote_at)
+        self.floor = int(floor)
+        self.registry = registry
+        self.obs = obs if obs is not None else NULL_BOUND
+        #: peer -> integer score (absent = 0). Peers are whatever the
+        #: gate attributes frames to: validator indices in the campaign
+        #: engines, signatory bytes at a real transport ingress.
+        self.scores: dict = {}
+        self.demoted: set = set()
+        self.demotions = 0
+        self.recoveries = 0
+        #: class -> total charges (REPUTATION_WEIGHTS keys only).
+        self.charges = {k: 0 for k in REPUTATION_WEIGHTS}
+        #: peer -> charge count, the per-peer view metrics export.
+        self.charges_by_peer: dict = {}
+
+    def charge(self, peer, cls: str = "verify_failed") -> int:
+        """Debit ``peer`` for one failed verify row; clamps at the
+        floor so a long storm stays repayable in bounded credit."""
+        weight = REPUTATION_WEIGHTS[cls]
+        self.charges[cls] += 1
+        self.charges_by_peer[peer] = self.charges_by_peer.get(peer, 0) + 1
+        s = max(self.floor, self.scores.get(peer, 0) - weight)
+        self.scores[peer] = s
+        if self.registry is not None:
+            self.registry.count("admission.reputation.charges", label=cls)
+        if self.obs is not NULL_BOUND:
+            self.obs.emit("admission.reputation.charge", -1, -1, cls)
+        if s <= self.demote_at and peer not in self.demoted:
+            self.demoted.add(peer)
+            self.demotions += 1
+            if self.registry is not None:
+                self.registry.count("admission.reputation.demotions")
+                self.registry.set_gauge(
+                    "admission.reputation.demoted", len(self.demoted)
+                )
+            if self.obs is not NULL_BOUND:
+                self.obs.emit(
+                    "admission.reputation.demote", -1, -1, _peer_label(peer)
+                )
+        return s
+
+    def credit(self, peer, rows: int = 1) -> int:
+        """Reward ``peer`` for ``rows`` signatures that VERIFIED —
+        the recovery path out of demotion."""
+        if rows <= 0:
+            return self.scores.get(peer, 0)
+        s = min(0, self.scores.get(peer, 0) + self.credit_per_verify * rows)
+        self.scores[peer] = s
+        self._maybe_recover(peer, s)
+        return s
+
+    def rehabilitate(self, amount: int = 1) -> None:
+        """Per-commit amnesty: pull every debt ``amount`` toward zero.
+        Bounds how long any verdict stays on the books — an attacker
+        that stops forging eventually sheds its demotion, exactly like
+        the overlay's per-height rehabilitation."""
+        if amount <= 0:
+            return
+        for peer in list(self.scores):
+            s = self.scores[peer]
+            if s >= 0:
+                continue
+            s = min(0, s + amount)
+            self.scores[peer] = s
+            self._maybe_recover(peer, s)
+
+    def _maybe_recover(self, peer, s: int) -> None:
+        if peer in self.demoted and s > self.demote_at:
+            self.demoted.discard(peer)
+            self.recoveries += 1
+            if self.registry is not None:
+                self.registry.count("admission.reputation.recoveries")
+                self.registry.set_gauge(
+                    "admission.reputation.demoted", len(self.demoted)
+                )
+            if self.obs is not NULL_BOUND:
+                self.obs.emit(
+                    "admission.reputation.recover", -1, -1, _peer_label(peer)
+                )
+
+    def is_demoted(self, peer) -> bool:
+        return peer in self.demoted
+
+    def snapshot(self) -> dict:
+        return {
+            "demoted": sorted(self.demoted, key=_peer_label),
+            "demotions": self.demotions,
+            "recoveries": self.recoveries,
+            "charges": dict(self.charges),
+            "min": min(self.scores.values()) if self.scores else 0,
+        }
+
+
+def _peer_label(peer) -> str:
+    """Stable short label for a peer key (int index or signatory
+    bytes) — the one rendering metrics labels, journal details and
+    snapshots share, so the three views join on equal strings."""
+    if isinstance(peer, (bytes, bytearray, memoryview)):
+        return bytes(peer)[:4].hex()
+    return str(peer)
+
+
 class AdmissionGate:
     """Classify one message against the controller's level and decide
     admit/shed. One gate per ingress point (a TcpNode, a replica);
@@ -257,6 +410,13 @@ class AdmissionGate:
     and saves the decode/buffer work). ``peer`` attribution on
     :meth:`admit` feeds per-peer fairness at SHED_LOW_PRIORITY; callers
     without transport-level peer identity fall back to the sender.
+
+    ``reputation`` (optional) attaches a :class:`SignerReputation`:
+    the drain path reports per-row verify outcomes via
+    :meth:`note_verify`, and prevotes from demoted signers shed under
+    the ``reputation`` class at EVERY level — the feedback loop that
+    moves repeat forgers from the expensive post-verify shed to the
+    cheap pre-verify one.
     """
 
     def __init__(
@@ -267,6 +427,7 @@ class AdmissionGate:
         dedup_capacity: int = 65536,
         fair_window: int = 1024,
         fair_share: float = 0.5,
+        reputation: "SignerReputation | None" = None,
         registry=None,
         obs=None,
         threadsafe: bool = False,
@@ -276,6 +437,7 @@ class AdmissionGate:
         self.dedup_capacity = int(dedup_capacity)
         self.fair_window = max(1, int(fair_window))
         self.fair_share = float(fair_share)
+        self.reputation = reputation
         self.registry = registry
         self.obs = obs if obs is not None else NULL_BOUND
         self._lock = threading.Lock() if threadsafe else None
@@ -290,6 +452,11 @@ class AdmissionGate:
         self.admitted = 0
         #: shed-class name -> count. Only SHED_CLASSES names ever appear.
         self.shed: dict = {}
+        #: peer -> total sheds attributed to that peer (any class).
+        self.shed_by_peer: dict = {}
+        #: peer -> rows of that peer's signatures batch verify REJECTED
+        #: (the post-verify shed cost the reputation loop exists to cut).
+        self.verify_failed_by_peer: dict = {}
 
     # ------------------------------------------------------------- admit
 
@@ -321,26 +488,55 @@ class AdmissionGate:
             # remembered: reads dedup to nothing and must not evict
             # vote keys from the bounded memory.
             if level >= SHED_LOW_PRIORITY:
-                return self._shed(msg, "query")
+                return self._shed(msg, "query", peer)
             self._admitted()
             return True
         if level >= SHED_DUPLICATES and cls is not FRESH:
             # cls is the shed class verbatim: the classifier's closed
             # vocabulary intersects SHED_CLASSES on exactly the two
             # behavior-neutral classes the gate polices.
-            return self._shed(msg, cls)
+            return self._shed(msg, cls, peer)
         if type(msg) is Prevote:
+            who = peer if peer is not None else msg.sender
+            rep = self.reputation
+            if rep is not None and rep.is_demoted(who):
+                # The economic shed: level-independent (a demoted
+                # forger is expensive at ANY load) and prevote-only
+                # (proposals / precommits / certificates stay
+                # never-shed, so a mis-charge costs redundant votes,
+                # never quorum reach).
+                rep.charges["shed_while_demoted"] += 1
+                return self._shed(msg, "reputation", who)
             if level >= CRITICAL_ONLY:
-                return self._shed(msg, "panic")
+                return self._shed(msg, "panic", who)
             if level >= SHED_LOW_PRIORITY:
-                who = peer if peer is not None else msg.sender
                 budget = max(1, int(self.fair_share * self.fair_window))
                 if self._fair.get(who, 0) >= budget:
-                    return self._shed(msg, "low_priority")
+                    return self._shed(msg, "low_priority", who)
                 self._fair_note(who)
         self._remember(key)
         self._admitted()
         return True
+
+    def note_verify(self, peer, ok: bool, rows: int = 1) -> None:
+        """Batch-verify feedback for ``rows`` of ``peer``'s signatures:
+        the drain loop calls this per (signer, verdict) after the
+        device/host verifier resolves a window. Failures charge the
+        attached reputation (and count toward the per-peer post-verify
+        cost the loop exists to cut); successes repay debt."""
+        if not ok:
+            self.verify_failed_by_peer[peer] = (
+                self.verify_failed_by_peer.get(peer, 0) + rows
+            )
+            if self.registry is not None:
+                self.registry.count(
+                    "admission.verify_failed", rows, label=_peer_label(peer)
+                )
+            if self.reputation is not None:
+                for _ in range(rows):
+                    self.reputation.charge(peer, "verify_failed")
+        elif self.reputation is not None:
+            self.reputation.credit(peer, rows)
 
     # ---------------------------------------------------------- plumbing
 
@@ -364,11 +560,17 @@ class AdmissionGate:
             self.registry.count("admission.offered")
             self.registry.count("admission.admitted")
 
-    def _shed(self, msg, cls: str) -> bool:
+    def _shed(self, msg, cls: str, peer=None) -> bool:
         self.shed[cls] = self.shed.get(cls, 0) + 1
+        if peer is not None:
+            self.shed_by_peer[peer] = self.shed_by_peer.get(peer, 0) + 1
         if self.registry is not None:
             self.registry.count("admission.offered")
             self.registry.count("admission.shed", label=cls)
+            if peer is not None:
+                self.registry.count(
+                    "admission.shed_by_peer", label=_peer_label(peer)
+                )
         if self.obs is not NULL_BOUND:
             self.obs.emit(
                 "admission.shed", msg.height, getattr(msg, "round", -1), cls
@@ -377,9 +579,14 @@ class AdmissionGate:
 
     def snapshot(self) -> dict:
         """Counter view for soak assertions and the overload report."""
-        return {
+        snap = {
             "offered": self.offered,
             "admitted": self.admitted,
             "shed": dict(self.shed),
             "level": self.controller.level,
+            "shed_by_peer": dict(self.shed_by_peer),
+            "verify_failed_by_peer": dict(self.verify_failed_by_peer),
         }
+        if self.reputation is not None:
+            snap["reputation"] = self.reputation.snapshot()
+        return snap

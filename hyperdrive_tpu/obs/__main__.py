@@ -8,6 +8,7 @@ benchdiff.
                ``--overlay`` for aggregation-overlay posture,
                ``--exec`` for execution-layer/state-root posture,
                ``--proofs`` for trustless-read/Merkle posture,
+               ``--campaign`` for attack-campaign posture,
                ``--critical-path`` for per-commit finality hop
                attribution — most useful on a merged journal)
     merge      fold N per-process journals into one causally-
@@ -36,12 +37,14 @@ import sys
 from hyperdrive_tpu.obs.recorder import load_journal
 from hyperdrive_tpu.obs.report import (
     anatomy,
+    campaign_summary,
     critical_path_summary,
     exec_summary,
     overlay_summary,
     overload_summary,
     phase_summary,
     proofs_summary,
+    render_campaign_table,
     render_critical_path_table,
     render_exec_table,
     render_proofs_table,
@@ -84,6 +87,20 @@ def _cmd_record(ns):
 
 def _cmd_report(ns):
     journal = load_journal(ns.journal)
+    if ns.campaign:
+        summary = campaign_summary(journal["events"])
+        if ns.json:
+            print(json.dumps({"campaign": summary}, indent=1))
+            return 0
+        if not (summary["families"] or summary["waves"]
+                or summary["epochs"]
+                or summary["reputation"]["charge_total"]):
+            print("no campaign.* events in journal window "
+                  "(record one: python -m hyperdrive_tpu.campaign run "
+                  "— violation dumps ship a sidecar journal)")
+            return 1
+        print(render_campaign_table(summary))
+        return 0
     if ns.critical_path:
         summary = critical_path_summary(journal["events"])
         if ns.json:
@@ -338,6 +355,14 @@ def main(argv=None):
              "(the closed merkle.*/proof.* families: proofs served vs "
              "shed, frame sizes, incremental-update posture, per-height "
              "Merkle-root agreement)",
+    )
+    rep.add_argument(
+        "--campaign",
+        action="store_true",
+        help="attack-campaign posture summary instead "
+             "(the closed campaign.*/admission.reputation.* families: "
+             "storm waves, per-epoch adversary seat trajectory, grind "
+             "candidates, partitions, reputation loop, violations)",
     )
     rep.add_argument(
         "--critical-path",

@@ -214,6 +214,32 @@ EVENT_KINDS = frozenset(
         # exactly these.
         "slo.ok",
         "slo.breach",
+        # Admission-gate reputation loop (load/backpressure.py
+        # SignerReputation): one mark per verify-failure charge (detail:
+        # charge class), one per signer demotion and one per recovery
+        # (detail: peer label). Closed family — the lint (HD005), the
+        # --campaign report decoder, and OBSERVABILITY.md enumerate
+        # exactly these.
+        "admission.reputation.charge",
+        "admission.reputation.demote",
+        "admission.reputation.recover",
+        # Attack-campaign workloads (campaign/): one mark per family
+        # launch (detail: family name), per storm wave reaching batch
+        # verify (detail: admitted rows), per capture epoch (detail:
+        # adversary seats), per grinding pick (detail: candidate
+        # index), per overlay slice engaged/healed in a coincidence run
+        # (detail: level), per invariant violation (detail: kind), and
+        # one closing mark carrying the campaign digest prefix. Closed
+        # family — the lint (HD005), the --campaign report decoder, and
+        # OBSERVABILITY.md enumerate exactly these.
+        "campaign.family",
+        "campaign.wave",
+        "campaign.epoch",
+        "campaign.grind",
+        "campaign.partition",
+        "campaign.heal",
+        "campaign.violation",
+        "campaign.done",
     }
 )
 

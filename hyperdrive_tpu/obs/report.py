@@ -937,6 +937,180 @@ def render_critical_path_table(summary):
     return "\n".join(lines)
 
 
+def _detail_ints(detail):
+    """Parse a ``k=v`` event detail string into an int dict.
+
+    Campaign events carry compact ``rows=352 failed=256 level=2``
+    payloads; ``seats=4/64`` splits into ``seats`` and ``of``. Tokens
+    that don't parse are skipped so the decoder never throws on a
+    journal written by a newer engine.
+    """
+    out = {}
+    if not isinstance(detail, str):
+        return out
+    for tok in detail.split():
+        if "=" not in tok:
+            continue
+        key, _, val = tok.partition("=")
+        if "/" in val:
+            val, _, denom = val.partition("/")
+            try:
+                out["of"] = int(denom)
+            except ValueError:
+                pass
+        try:
+            out[key] = int(val)
+        except ValueError:
+            pass
+    return out
+
+
+def campaign_summary(events):
+    """Attack-campaign posture from the journal alone.
+
+    Decodes the closed ``campaign.*`` family the campaign engines emit
+    (hyperdrive_tpu/campaign/families.py) plus the admission gate's
+    ``admission.reputation.*`` feedback loop, so a journal saved by
+    ``python -m hyperdrive_tpu.campaign run`` (or a violation dump's
+    sidecar journal) is diagnosable offline: which families ran, how
+    each storm wave degraded and recovered, the adversary's per-epoch
+    committee-seat trajectory vs its passive baseline, partition slices
+    and the heal runway, reputation charges/demotions/recoveries, and
+    any monitor violations with their final digests.
+    """
+    out = {
+        "families": [],
+        "waves": [],
+        "epochs": [],
+        "grinds": [],
+        "partitions": [],
+        "heal_runway": None,
+        "violations": [],
+        "done": [],
+        "reputation": {
+            "charges": {},
+            "charge_total": 0,
+            "demotions": 0,
+            "recoveries": 0,
+        },
+    }
+    rep = out["reputation"]
+    for ev in events:
+        height, kind, detail = ev[2], ev[4], ev[5]
+        if kind == "campaign.family":
+            out["families"].append(str(detail))
+        elif kind == "campaign.wave":
+            d = _detail_ints(detail)
+            d["height"] = height
+            out["waves"].append(d)
+        elif kind == "campaign.epoch":
+            d = _detail_ints(detail)
+            d["height"] = height
+            out["epochs"].append(d)
+        elif kind == "campaign.grind":
+            d = _detail_ints(detail)
+            d["height"] = height
+            out["grinds"].append(d)
+        elif kind == "campaign.partition":
+            d = _detail_ints(detail)
+            d["height"] = height
+            out["partitions"].append(d)
+        elif kind == "campaign.heal":
+            d = _detail_ints(detail)
+            out["heal_runway"] = d.get("runway")
+        elif kind == "campaign.violation":
+            out["violations"].append(str(detail))
+        elif kind == "campaign.done":
+            out["done"].append(str(detail))
+        elif kind == "admission.reputation.charge":
+            cls = detail if isinstance(detail, str) else "?"
+            rep["charges"][cls] = rep["charges"].get(cls, 0) + 1
+            rep["charge_total"] += 1
+        elif kind == "admission.reputation.demote":
+            rep["demotions"] += 1
+        elif kind == "admission.reputation.recover":
+            rep["recoveries"] += 1
+    return out
+
+
+def render_campaign_table(summary):
+    """The campaign summary as aligned text (the CLI's ``--campaign``)."""
+    lines = []
+    if summary["families"]:
+        lines.append("families: " + " · ".join(summary["families"]))
+    waves = summary["waves"]
+    if waves:
+        table = [["wave", "ht", "verified", "failed", "level"]]
+        for i, w in enumerate(waves):
+            table.append([
+                str(i),
+                str(w.get("height", "-")),
+                str(w.get("rows", "-")),
+                str(w.get("failed", "-")),
+                str(w.get("level", "-")),
+            ])
+        widths = [max(len(r[i]) for r in table) for i in range(5)]
+        for i, r in enumerate(table):
+            lines.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+        failed = sum(w.get("failed", 0) for w in waves)
+        lines.append(
+            f"storm: {len(waves)} waves · "
+            f"{failed} forged rows died at batch verify"
+        )
+    epochs = summary["epochs"]
+    if epochs:
+        grind_by_h = {g.get("height"): g for g in summary["grinds"]}
+        part_by_h = {p.get("height"): p for p in summary["partitions"]}
+        table = [["epoch", "ht", "adv seats", "grind", "partition"]]
+        for e in epochs:
+            h = e.get("height")
+            g = grind_by_h.get(h)
+            p = part_by_h.get(h)
+            table.append([
+                str(e.get("e", "-")),
+                str(h),
+                "%s/%s" % (e.get("seats", "-"), e.get("of", "-")),
+                "cand=%s +%s" % (
+                    g.get("cand", "-"),
+                    g.get("seats", 0) - g.get("passive", 0),
+                ) if g else "-",
+                "lvl=%s sliced=%s" % (
+                    p.get("level", "-"), p.get("sliced", "-"),
+                ) if p else "-",
+            ])
+        widths = [max(len(r[i]) for r in table) for i in range(5)]
+        for i, r in enumerate(table):
+            lines.append("  ".join(c.rjust(w) for c, w in zip(r, widths)))
+            if i == 0:
+                lines.append("  ".join("-" * w for w in widths))
+        seats = sum(e.get("seats", 0) for e in epochs)
+        lines.append(
+            f"capture: {len(epochs)} epochs · "
+            f"{seats} adversary seats total"
+        )
+    if summary["heal_runway"] is not None:
+        lines.append(f"heal runway: {summary['heal_runway']} heights")
+    rep = summary["reputation"]
+    if rep["charge_total"] or rep["demotions"] or rep["recoveries"]:
+        by_cls = ", ".join(
+            f"{c}={n}" for c, n in sorted(rep["charges"].items())
+        )
+        lines.append(
+            f"reputation: {rep['charge_total']} charges ({by_cls}) · "
+            f"{rep['demotions']} demotions · "
+            f"{rep['recoveries']} recoveries"
+        )
+    for v in summary["violations"]:
+        lines.append(f"VIOLATION: {v}")
+    for d in summary["done"]:
+        lines.append(f"done: {d}")
+    if not lines:
+        return "no campaign.* events in journal window"
+    return "\n".join(lines)
+
+
 def _fmt(v):
     if v is None:
         return "-"

@@ -44,6 +44,12 @@ class Pipeline:
     def bad_unknown_proof(self, t):
         self.obs.emit("proof.refused", -1, -1, -1, t)  # BAD: fork
 
+    def bad_unknown_campaign(self, w):
+        self.obs.emit("campaign.started", -1, -1, -1, w)  # BAD: fork
+
+    def bad_unknown_reputation(self, p):
+        self.obs.emit("admission.reputation.reset", -1, -1, -1, p)  # BAD: fork
+
     def good_taxonomy_members(self, lid, pct):
         self.obs.emit("sched.launch.begin", -2, -1, -1, lid)
         self.obs.emit("verify.occupancy.pct", -1, -1, -1, pct)
@@ -62,6 +68,10 @@ class Pipeline:
         self.obs.emit("merkle.update", -1, -1, -1, 0)
         self.obs.emit("proof.serve", -1, -1, -1, 0)
         self.obs.emit("proof.shed", -1, -1, -1, 0)
+        self.obs.emit("campaign.family", -1, -1, -1, 0)
+        self.obs.emit("campaign.wave", -1, -1, -1, 0)
+        self.obs.emit("admission.reputation.charge", -1, -1, -1, 0)
+        self.obs.emit("admission.reputation.demote", -1, -1, -1, 0)
 
     def good_open_family(self):
         # Families outside the closed prefixes stay grep-audited only:

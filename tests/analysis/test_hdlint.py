@@ -93,10 +93,10 @@ def test_hd005_taxonomy_fixture_flags_closed_family_forks():
     assert {f.rule for f in findings} == {"HD005"}
     # One unknown name per closed family (sched.launch.*,
     # verify.occupancy.*, metrics.*, bls.*, tenant.drain.*, service.*,
-    # exec.*, merkle.*, proof.*, plus an exec.spec.* speculation fork)
-    # — and none of the GOOD members, open-family literals, or non-emit
-    # methods.
-    assert len(findings) == 10
+    # exec.*, merkle.*, proof.*, campaign.*, plus an exec.spec.*
+    # speculation fork and an admission.reputation.* fork) — and none
+    # of the GOOD members, open-family literals, or non-emit methods.
+    assert len(findings) == 12
     src = open(path).read()
     bad_lines = {
         i + 1 for i, text in enumerate(src.splitlines()) if "# BAD" in text

@@ -66,6 +66,43 @@ def test_elect_committee_is_stake_proportional():
         assert 0 not in elect_committee(stakes, 3, b"z%d" % i)
 
 
+def test_elect_committee_sybil_splitting_gains_no_expected_seats():
+    # Adversarial stake splitting at the stake-floor boundary
+    # (ROBUSTNESS.md "Adversarial economy"): an adversary holding total
+    # stake S wins the same expected committee share whether it stands
+    # as one account or splits into N floor-sized sybils — proportional
+    # sampling weighs stake, not identities (arXiv:2004.12990). Seeded
+    # multi-epoch property: anchors chain like EpochSchedule's.
+    import hashlib
+
+    honest = (10,) * 48
+    k, epochs = 12, 192
+
+    def seats(stakes, adversary_accounts):
+        total, anchor = 0, b"sybil-split-genesis"
+        for _ in range(epochs):
+            anchor = hashlib.sha256(anchor).digest()
+            committee = elect_committee(stakes, k, anchor)
+            total += sum(i < adversary_accounts for i in committee)
+        return total
+
+    # Unsplit: one account holding 10 (a full honest validator's worth,
+    # small enough that the one-seat-per-account cap never binds).
+    unsplit = seats((10,) + honest, 1)
+    # Split: ten sybils of 1 — each exactly at the floor, same total.
+    split = seats((1,) * 10 + honest, 10)
+    expected = epochs * k * 10 / 490.0  # ~47 over the campaign
+    sigma = (epochs * k * (10 / 490.0)) ** 0.5
+    assert abs(unsplit - expected) <= 4 * sigma
+    assert abs(split - expected) <= 4 * sigma
+    # The split trajectory gains nothing over the unsplit one beyond
+    # sampling noise — splitting buys identities, never share.
+    assert split - unsplit <= 4 * sigma
+    # Splitting BELOW the floor forfeits everything: sub-floor stake
+    # rounds to zero and zero-stake candidates are never seated.
+    assert seats((0,) * 10 + honest, 10) == 0
+
+
 def test_elect_committee_rejects_oversized():
     with pytest.raises(ValueError):
         elect_committee((1, 0, 1), 3, b"m")  # only 2 staked candidates

@@ -222,6 +222,76 @@ def test_gate_accounting_identity():
     assert snap["offered"] == snap["admitted"] + sum(snap["shed"].values())
 
 
+# --------------------------------------------------------------- reputation
+
+
+def test_reputation_charges_demote_and_amnesty_recovers():
+    from hyperdrive_tpu.load.backpressure import SignerReputation
+
+    rep = SignerReputation()  # weight 6, demote_at -8, floor -64
+    assert rep.charge(b"\x05" * 32) == -6
+    assert not rep.is_demoted(b"\x05" * 32)
+    assert rep.charge(b"\x05" * 32) == -12  # crosses -8
+    assert rep.is_demoted(b"\x05" * 32) and rep.demotions == 1
+    # Per-commit amnesty repays 1 per height: demotion lifts only once
+    # the score climbs back ABOVE the threshold (-7), never at it.
+    for _ in range(4):
+        rep.rehabilitate(1)
+    assert rep.is_demoted(b"\x05" * 32)  # -8: still demoted
+    rep.rehabilitate(1)
+    assert not rep.is_demoted(b"\x05" * 32)  # -7: recovered
+    assert rep.recoveries == 1
+    # The floor clamps: a long storm's debt stays repayable.
+    for _ in range(50):
+        rep.charge(b"\x05" * 32)
+    assert rep.scores[b"\x05" * 32] == -64
+
+
+def test_reputation_credit_repays_verified_rows():
+    from hyperdrive_tpu.load.backpressure import SignerReputation
+
+    rep = SignerReputation()
+    rep.charge(b"\x06" * 32)
+    rep.charge(b"\x06" * 32)  # -12, demoted
+    assert rep.credit(b"\x06" * 32, rows=4) == -8  # at threshold: demoted
+    assert rep.is_demoted(b"\x06" * 32)
+    assert rep.credit(b"\x06" * 32, rows=1) == -7
+    assert not rep.is_demoted(b"\x06" * 32)
+    # Credit never banks a positive balance for future forgery.
+    assert rep.credit(b"\x06" * 32, rows=100) == 0
+
+
+def test_gate_note_verify_feedback_sheds_demoted_prevotes_only():
+    from hyperdrive_tpu.load.backpressure import SignerReputation
+
+    rep = SignerReputation()
+    gate = AdmissionGate(_pinned(ACCEPT), reputation=rep, height_fn=lambda: 5)
+    forger = b"\x04" * 32
+    pv = _pv(sender=b"\x04")
+    assert gate.admit(pv, peer=forger)
+    gate.note_verify(forger, False, 2)  # two failed rows -> demoted
+    assert rep.is_demoted(forger)
+    assert gate.verify_failed_by_peer[forger] == 2
+    # Demoted prevotes shed under the reputation class even at ACCEPT.
+    assert not gate.admit(_pv(sender=b"\x04", value=b"\x09"), peer=forger)
+    assert gate.shed == {"reputation": 1}
+    assert gate.shed_by_peer[forger] == 1
+    # Scope is prevote-only: the same demoted peer's proposals and
+    # precommits stay never-shed — demotion costs redundant votes,
+    # never safety-critical reach.
+    pp = Propose(
+        height=5, round=0, valid_round=-1, value=b"\x07" * 32,
+        sender=forger, payload=b"",
+    )
+    pc = Precommit(height=5, round=0, value=b"\x07" * 32, sender=forger)
+    assert gate.admit(pp, peer=forger)
+    assert gate.admit(pc, peer=forger)
+    # Successful verifies repay the debt and reopen the gate.
+    gate.note_verify(forger, True, 12)
+    assert not rep.is_demoted(forger)
+    assert gate.admit(_pv(sender=b"\x04", value=b"\x0a"), peer=forger)
+
+
 # ---------------------------------------------------------------- sim storm
 
 

@@ -33,6 +33,8 @@ from hyperdrive_tpu.analysis.annotations import (
     wire_budget_for,
 )
 from hyperdrive_tpu.analysis.sanitizer import SanitizerError, maybe_wire_reader
+from hyperdrive_tpu.campaign import CampaignConfig
+from hyperdrive_tpu.campaign.record import CampaignRecord
 from hyperdrive_tpu.certificates import (
     QuorumCertificate,
     marshal_certificate,
@@ -122,6 +124,18 @@ def _epoch_proof() -> EpochProof:
                       next_set_digest=b"\xaa" * 32,
                       next_signatories=(b"\x01" * 32, b"\x02" * 32),
                       cert=_cert())
+
+
+def _campaign_record() -> CampaignRecord:
+    cfg = CampaignConfig(
+        family="storm", seed=7, validators=64, committee_size=16,
+        epochs=4, epoch_length=2, attackers=4, waves=3, wave_votes=2,
+        attack_rate=4, sybils=8, budget_milli=200, grind_width=2,
+    )
+    return CampaignRecord.capture(
+        cfg, {"family": "storm", "waves": [[3, 48, 0, 0]],
+              "violations": []},
+    )
 
 
 def _merkle_proof() -> MerkleProof:
@@ -286,6 +300,11 @@ SAMPLES = {
         _reencode_proof,
         [encode_proof(9, STATUS_COMMITTED, _merkle_proof()),
          encode_proof(9, STATUS_NO_QUORUM)],
+    ),
+    "campaign.record": (
+        CampaignRecord.load,
+        lambda rec: _obj_bytes(rec, rem=1 << 20),
+        [_obj_bytes(_campaign_record(), rem=1 << 20)],
     ),
     "state.checkpoint": (
         lambda b: State.unmarshal(
